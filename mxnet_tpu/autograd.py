@@ -1,0 +1,306 @@
+"""Imperative autograd — tape + jax.vjp.
+
+Reference: include/mxnet/imperative.h (Imperative::RecordOp/Backward, AGInfo),
+python/mxnet/autograd.py (record/pause/train_mode/predict_mode/backward/grad,
+mark_variables, custom Function).
+
+Design: while recording, every op invocation appends a TapeNode holding the
+pure jitted function, the input/output jax arrays and NDArray identities.
+``backward`` walks the tape in reverse, calling jax.vjp per node — which
+re-traces the op's forward (XLA-cached by shape) to get the cotangent rule.
+This is the eager path; the fused path (Gluon ``hybridize``/CachedOp, Module)
+instead differentiates the whole graph with one jax.value_and_grad, which is
+where training throughput comes from.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "set_recording", "set_training", "mark_variables",
+           "backward", "grad", "get_symbol", "Function"]
+
+
+class _TapeNode:
+    __slots__ = ("fn", "in_arrays", "in_nds", "out_nds", "n_outs", "visited")
+
+    def __init__(self, fn, in_arrays, in_nds, out_nds):
+        self.fn = fn
+        self.in_arrays = list(in_arrays)
+        self.in_nds = list(in_nds)     # NDArray refs (or None for raw keys)
+        self.out_nds = list(out_nds)
+        self.n_outs = len(out_nds)
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.recording = False
+        self.training = False
+
+
+_state = _State()
+
+
+def is_recording() -> bool:
+    return _state.recording
+
+
+def is_training() -> bool:
+    return _state.training
+
+
+def set_recording(is_record: bool) -> bool:
+    prev, _state.recording = _state.recording, is_record
+    return prev
+
+
+def set_training(train_mode_: bool) -> bool:
+    prev, _state.training = _state.training, train_mode_
+    return prev
+
+
+class _RecordingStateScope:
+    def __init__(self, is_record: Optional[bool], train_mode_: Optional[bool]):
+        self._enter_is_record = is_record
+        self._enter_train_mode = train_mode_
+        self._prev_is_record = None
+        self._prev_train_mode = None
+
+    def __enter__(self):
+        if self._enter_is_record is not None:
+            self._prev_is_record = set_recording(self._enter_is_record)
+        if self._enter_train_mode is not None:
+            self._prev_train_mode = set_training(self._enter_train_mode)
+        return self
+
+    def __exit__(self, *args):
+        if self._enter_is_record is not None:
+            set_recording(self._prev_is_record)
+        if self._enter_train_mode is not None:
+            set_training(self._prev_train_mode)
+
+
+def record(train_mode: bool = True):
+    """``with autograd.record():`` (reference autograd.py:122)"""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode: bool = False):
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach grad buffers to arrays (reference autograd.py:216)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, g, req in zip(variables, gradients, grad_reqs):
+        var._grad = g if req != "null" else None
+        var._grad_req = req
+        var._autograd_node = None  # leaf
+
+
+def _record_op(fn, in_arrays, in_nds, out_nds):
+    """Called by the NDArray invoke path while recording."""
+    node = _TapeNode(fn, in_arrays, in_nds, out_nds)
+    for i, nd in enumerate(out_nds):
+        nd._autograd_node = (node, i)
+    return node
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Compute gradients of heads w.r.t. marked variables, accumulating into
+    their .grad buffers (reference autograd.py:243 / Imperative::Backward)."""
+    from .ndarray.ndarray import NDArray
+    if isinstance(heads, NDArray):
+        heads = [heads]
+        if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+            head_grads = [head_grads]
+
+    # 1. collect reachable tape nodes (reverse topological via DFS)
+    topo: List[_TapeNode] = []
+    seen = set()
+
+    def dfs(node):
+        if node is None or id(node) in seen:
+            return
+        seen.add(id(node))
+        for nd in node.in_nds:
+            if nd is not None and getattr(nd, "_autograd_node", None) is not None:
+                dfs(nd._autograd_node[0])
+        topo.append(node)
+
+    for h in heads:
+        entry = getattr(h, "_autograd_node", None)
+        if entry is not None:
+            dfs(entry[0])
+
+    # 2. cotangent accumulation keyed by NDArray identity
+    cots: Dict[int, object] = {}
+
+    def add_cot(nd, val):
+        k = id(nd)
+        if k in cots:
+            cots[k] = cots[k] + val
+        else:
+            cots[k] = val
+
+    for i, h in enumerate(heads):
+        if head_grads is None or head_grads[i] is None:
+            add_cot(h, jnp.ones_like(h._handle))
+        else:
+            g = head_grads[i]
+            add_cot(h, g._handle if isinstance(g, NDArray) else jnp.asarray(g))
+
+    # 3. reverse sweep
+    for node in reversed(topo):
+        out_cots = []
+        any_set = False
+        for nd in node.out_nds:
+            c = cots.get(id(nd))
+            if c is None:
+                c = jnp.zeros_like(nd._handle)
+            else:
+                any_set = True
+            out_cots.append(c)
+        if not any_set:
+            continue
+        in_cots = _node_vjp(node, out_cots)
+        for nd, c in zip(node.in_nds, in_cots):
+            if nd is None or c is None:
+                continue
+            if hasattr(c, "dtype") and c.dtype == jax.dtypes.float0:
+                continue
+            add_cot(nd, c)
+
+    # 4. write into .grad of marked variables
+    _flush_grads(topo, heads, cots)
+
+
+def _flush_grads(topo, heads, cots):
+    leaves = {}
+    for node in topo:
+        for nd in node.in_nds:
+            if nd is not None and getattr(nd, "_grad", None) is not None:
+                leaves[id(nd)] = nd
+    for h in heads:
+        if getattr(h, "_grad", None) is not None:
+            leaves[id(h)] = h
+    for k, nd in leaves.items():
+        if k not in cots:
+            continue
+        val = cots[k].astype(nd._grad._handle.dtype)
+        if getattr(nd, "_grad_req", "write") == "add":
+            nd._grad._handle = nd._grad._handle + val
+        else:
+            nd._grad._handle = val
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Return gradients instead of accumulating (reference autograd.py:270)."""
+    from .ndarray.ndarray import NDArray
+    single = isinstance(variables, NDArray)
+    if single:
+        variables = [variables]
+    saved = [(getattr(v, "_grad", None), getattr(v, "_grad_req", "write"))
+             for v in variables]
+    from . import ndarray as _nd
+    for v in variables:
+        v._grad = _nd.zeros(v.shape, dtype=v.dtype, ctx=v.context)
+        v._grad_req = "write"
+    backward(heads, head_grads, retain_graph=bool(retain_graph),
+             train_mode=train_mode)
+    out = [v._grad for v in variables]
+    for v, (g, req) in zip(variables, saved):
+        v._grad, v._grad_req = g, req
+    return out[0] if single else out
+
+
+def get_symbol(x):
+    """Trace the tape producing `x` into a Symbol (reference autograd.py:306).
+    Minimal parity: returns None graph info is unavailable."""
+    raise NotImplementedError(
+        "get_symbol: use gluon.HybridBlock/hybridize for graph capture")
+
+
+class Function:
+    """Customizable differentiable function (reference autograd.py:364).
+
+    Subclass and override forward/backward; operates on NDArrays eagerly.
+    """
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *args):
+        self._saved = args
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray
+        with pause():
+            outputs = self.forward(*inputs)
+        single = isinstance(outputs, NDArray)
+        outs = [outputs] if single else list(outputs)
+        if is_recording():
+            func = self
+
+            class _CustomNode(_TapeNode):
+                def __init__(self):
+                    self.in_arrays = [i._handle for i in inputs]
+                    self.in_nds = list(inputs)
+                    self.out_nds = outs
+                    self.n_outs = len(outs)
+                    self.func = func
+
+            node = _CustomNode()
+
+            # monkey-style fn providing custom vjp through NDArray backward
+            def fn(*arrays):
+                raise MXNetError("custom Function cannot be re-traced")
+            node.fn = fn
+            # override the vjp path: wrap via special marker consumed in backward
+            node._custom = True
+            for i, nd in enumerate(outs):
+                nd._autograd_node = (node, i)
+        return outputs if single else outs
+
+
+# patch backward() to honour custom Function nodes
+_orig_vjp = jax.vjp
+
+
+def _node_vjp(node, out_cots):
+    if getattr(node, "_custom", False):
+        from .ndarray.ndarray import NDArray, array as _arr
+        grads = node.func.backward(*[_arr(np.asarray(c)) for c in out_cots])
+        if isinstance(grads, NDArray):
+            grads = [grads]
+        return [g._handle if g is not None else None for g in grads]
+    _, vjp_fn = jax.vjp(node.fn, *node.in_arrays)
+    cots = vjp_fn(tuple(out_cots) if node.n_outs > 1 else out_cots[0])
+    return list(cots)

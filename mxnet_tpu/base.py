@@ -1,0 +1,216 @@
+"""Core shared utilities for mxnet_tpu.
+
+TPU-native re-imagination of the reference's ``python/mxnet/base.py`` +
+``dmlc-core`` parameter machinery (reference: include/mxnet/base.h,
+dmlc::Parameter usage e.g. src/operator/rnn-inl.h:89).  There is no C handle
+layer here: arrays are jax.Array, graphs are Python objects lowered to a
+single XLA computation, so "base" is just errors, dtype tables and the typed
+attribute-parsing machinery (the dmlc::Parameter analog).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "MXNetError", "NotSupportedForSparseNDArray", "_Null", "string_types",
+    "numeric_types", "integer_types", "dtype_np", "dtype_name", "AttrScope",
+    "attr_bool", "attr_int", "attr_float", "attr_str", "attr_shape",
+    "attr_dtype", "Param",
+]
+
+
+class MXNetError(Exception):
+    """Error raised by mxnet_tpu (parity with the reference's MXNetError)."""
+
+
+class NotSupportedForSparseNDArray(MXNetError):
+    def __init__(self, function, alias, *args):
+        super().__init__(
+            "Function {}{} is not supported for sparse NDArray".format(
+                function.__name__, " (alias %s)" % alias if alias else ""))
+
+
+class _NullType:
+    """Placeholder for missing attribute values (reference `_Null`)."""
+    _inst = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+    def __repr__(self):
+        return "_Null"
+
+    def __bool__(self):
+        return False
+
+
+_Null = _NullType()
+
+string_types = (str,)
+numeric_types = (float, int, np.generic)
+integer_types = (int, np.integer)
+
+# dtype string <-> numpy mapping (reference: python/mxnet/base.py _DTYPE_NP_TO_MX)
+_DTYPE_TABLE = {
+    "float32": np.float32,
+    "float64": np.float64,
+    "float16": np.float16,
+    "bfloat16": None,  # resolved lazily to ml_dtypes/jnp bfloat16
+    "uint8": np.uint8,
+    "int8": np.int8,
+    "int32": np.int32,
+    "int64": np.int64,
+    "bool": np.bool_,
+}
+
+
+def dtype_np(dtype) -> Any:
+    """Normalise a dtype spec (str/np.dtype/type) to a numpy-compatible dtype."""
+    if dtype is None or dtype is _Null:
+        return None
+    if isinstance(dtype, str):
+        if dtype == "bfloat16":
+            import ml_dtypes
+            return np.dtype(ml_dtypes.bfloat16)
+        if dtype in _DTYPE_TABLE:
+            return np.dtype(_DTYPE_TABLE[dtype])
+        return np.dtype(dtype)
+    return np.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    """Canonical string name for a dtype."""
+    if isinstance(dtype, str):
+        return dtype
+    return np.dtype(dtype).name
+
+
+# ---------------------------------------------------------------------------
+# Typed attribute parsing — the dmlc::Parameter analog.
+#
+# Ops declare a schema {name: attr_<type>(default)}; values arriving from the
+# Symbol layer are strings, from the imperative layer native Python.  Both are
+# normalised to hashable canonical values so they can key jit caches.
+# ---------------------------------------------------------------------------
+
+class Param:
+    """One typed op attribute: parser + default (+ required flag)."""
+
+    __slots__ = ("parse", "default", "required", "kind")
+
+    def __init__(self, parse: Callable[[Any], Any], default: Any = _Null,
+                 required: bool = False, kind: str = "str"):
+        self.parse = parse
+        self.default = default
+        self.required = required
+        self.kind = kind
+
+    def __call__(self, value):
+        if value is None or value is _Null:
+            return self.default
+        return self.parse(value)
+
+
+def _parse_bool(v) -> bool:
+    if isinstance(v, str):
+        return v.strip().lower() in ("1", "true", "yes")
+    return bool(v)
+
+
+def _parse_int(v) -> int:
+    if isinstance(v, str):
+        v = v.strip()
+        if v.lower() in ("none", ""):
+            return None
+    return int(v)
+
+
+def _parse_float(v) -> float:
+    return float(v)
+
+
+def _parse_str(v) -> str:
+    return str(v)
+
+
+def _parse_shape(v) -> Optional[Tuple[int, ...]]:
+    """Parse '(2,3)' / [2,3] / 2 → tuple of ints; 'None' → None."""
+    if v is None:
+        return None
+    if isinstance(v, str):
+        v = v.strip()
+        if v.lower() in ("none", ""):
+            return None
+        v = ast.literal_eval(v)
+    if isinstance(v, (int, np.integer)):
+        return (int(v),)
+    return tuple(int(x) for x in v)
+
+
+def _parse_dtype(v) -> Optional[str]:
+    if v is None:
+        return None
+    return dtype_name(v)
+
+
+def attr_bool(default=_Null, required=False):
+    return Param(_parse_bool, default, required, "boolean")
+
+
+def attr_int(default=_Null, required=False):
+    return Param(_parse_int, default, required, "int")
+
+
+def attr_float(default=_Null, required=False):
+    return Param(_parse_float, default, required, "float")
+
+
+def attr_str(default=_Null, required=False):
+    return Param(_parse_str, default, required, "string")
+
+
+def attr_shape(default=_Null, required=False):
+    return Param(_parse_shape, default, required, "Shape(tuple)")
+
+
+def attr_dtype(default=_Null, required=False):
+    return Param(_parse_dtype, default, required, "dtype")
+
+
+class AttrScope:
+    """``with AttrScope(ctx_group='dev1'):`` — attributes attached to every
+    symbol created inside the scope (reference: python/mxnet/attribute.py)."""
+
+    _current: Optional["AttrScope"] = None
+
+    def __init__(self, **kwargs):
+        self._attr = {str(k): str(v) for k, v in kwargs.items()}
+        self._old: Optional[AttrScope] = None
+
+    def get(self, attr: Optional[Dict[str, str]]) -> Dict[str, str]:
+        out = dict(self._attr)
+        if attr:
+            out.update(attr)
+        return out
+
+    @classmethod
+    def current(cls) -> "AttrScope":
+        if cls._current is None:
+            cls._current = AttrScope()
+        return cls._current
+
+    def __enter__(self):
+        self._old = AttrScope._current
+        merged = dict(self._old._attr) if self._old else {}
+        merged.update(self._attr)
+        self._attr = merged
+        AttrScope._current = self
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        AttrScope._current = self._old

@@ -1,0 +1,58 @@
+"""mx.nd namespace: NDArray + every registered op as a module function."""
+import sys as _sys
+
+from .ndarray import (NDArray, array, arange, concatenate, empty, eye, full,
+                      imperative_invoke, invoke_with_arrays, load, moveaxis,
+                      ones, populate_module, save, waitall, zeros)
+from .ndarray import stack_nd
+
+populate_module(_sys.modules[__name__])
+
+# name the stacked helper like the reference op
+stack = _sys.modules[__name__].stack  # registered op wrapper
+
+from . import random   # noqa: E402,F401
+from . import linalg   # noqa: E402,F401
+from . import sparse   # noqa: E402,F401
+from .sparse import (BaseSparseNDArray, CSRNDArray, RowSparseNDArray,  # noqa: E402
+                     csr_matrix, row_sparse_array)
+
+
+def maximum(lhs, rhs):
+    from .ndarray import NDArray as _ND, invoke_with_arrays as _inv
+    if isinstance(lhs, _ND) and isinstance(rhs, _ND):
+        name = "_maximum" if lhs.shape == rhs.shape else "broadcast_maximum"
+        return _inv(name, [lhs, rhs], {})
+    if isinstance(lhs, _ND):
+        return _inv("_maximum_scalar", [lhs], dict(scalar=float(rhs)))
+    return _inv("_maximum_scalar", [rhs], dict(scalar=float(lhs)))
+
+
+def minimum(lhs, rhs):
+    from .ndarray import NDArray as _ND, invoke_with_arrays as _inv
+    if isinstance(lhs, _ND) and isinstance(rhs, _ND):
+        name = "_minimum" if lhs.shape == rhs.shape else "broadcast_minimum"
+        return _inv(name, [lhs, rhs], {})
+    if isinstance(lhs, _ND):
+        return _inv("_minimum_scalar", [lhs], dict(scalar=float(rhs)))
+    return _inv("_minimum_scalar", [rhs], dict(scalar=float(lhs)))
+
+
+def add(lhs, rhs):
+    return lhs + rhs
+
+
+def subtract(lhs, rhs):
+    return lhs - rhs
+
+
+def multiply(lhs, rhs):
+    return lhs * rhs
+
+
+def divide(lhs, rhs):
+    return lhs / rhs
+
+
+def power(lhs, rhs):
+    return lhs ** rhs

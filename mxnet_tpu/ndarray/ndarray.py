@@ -1,0 +1,596 @@
+"""NDArray — imperative array type over jax.Array.
+
+Reference: include/mxnet/ndarray.h + python/mxnet/ndarray/ndarray.py.
+
+The reference NDArray is a chunk of device memory plus an engine variable;
+reads/writes are ordered by the dependency engine and python blocks in
+WaitToRead.  Here the handle is a jax.Array: XLA's async dispatch already
+provides the engine's ordering guarantees (single-stream program order per
+device), `asnumpy()` is the WaitToRead sync point, and mutation rebinds the
+handle (functional update via x.at[].set) — the version-counter semantics of
+ThreadedVar fall out for free because old handles are immutable snapshots.
+
+Op dispatch (`invoke`): parse attrs → cached jitted fn → apply → wrap.
+While autograd is recording, the (fn, inputs, outputs) triple is appended to
+the tape (see autograd.py).
+"""
+from __future__ import annotations
+
+import functools
+import sys
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError, dtype_np, dtype_name, _Null
+from ..context import Context, cpu, current_context, device_of
+from ..ops.registry import AttrDict, Operator, get_op, jitted_apply, list_ops
+from .. import autograd as _ag
+from .. import rng as _rng
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
+           "eye", "concatenate", "moveaxis", "waitall", "imperative_invoke",
+           "save", "load", "stack_nd"]
+
+
+class NDArray:
+    __slots__ = ("_handle", "_ctx", "_grad", "_grad_req", "_autograd_node",
+                 "_stype", "__weakref__")
+
+    def __init__(self, handle, ctx: Optional[Context] = None):
+        self._handle = handle  # jax.Array
+        self._ctx = ctx
+        self._grad = None
+        self._grad_req = "null"
+        self._autograd_node = None
+        self._stype = "default"
+
+    # -- basic properties ------------------------------------------------
+    @property
+    def handle(self):
+        return self._handle
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(self._handle.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._handle.dtype)
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    @property
+    def ndim(self) -> int:
+        return self._handle.ndim
+
+    @property
+    def context(self) -> Context:
+        if self._ctx is None:
+            self._ctx = device_of(self._handle)
+        return self._ctx
+
+    ctx = context
+
+    @property
+    def stype(self) -> str:
+        return self._stype
+
+    @property
+    def grad(self) -> Optional["NDArray"]:
+        return self._grad
+
+    @property
+    def T(self) -> "NDArray":
+        return self.transpose()
+
+    def __len__(self):
+        return self.shape[0]
+
+    def __repr__(self):
+        return "%s\n<NDArray %s @%s>" % (
+            str(self.asnumpy()), "x".join(map(str, self.shape)), self.context)
+
+    # -- sync / host transfer -------------------------------------------
+    def asnumpy(self) -> np.ndarray:
+        return np.asarray(self._handle)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self.asnumpy().reshape(())[()]
+
+    def wait_to_read(self):
+        self._handle.block_until_ready()
+
+    def wait_to_write(self):
+        self._handle.block_until_ready()
+
+    # -- conversion / copy ----------------------------------------------
+    def astype(self, dtype, copy=True) -> "NDArray":
+        dt = dtype_np(dtype)
+        if not copy and self.dtype == dt:
+            return self
+        return invoke_with_arrays("Cast", [self], dict(dtype=dtype_name(dt)))
+
+    def copy(self) -> "NDArray":
+        return invoke_with_arrays("_copy", [self], {})
+
+    def copyto(self, other) -> "NDArray":
+        if isinstance(other, NDArray):
+            other._handle = jax.device_put(
+                self._handle, other.context.jax_device).astype(other._handle.dtype)
+            return other
+        if isinstance(other, Context):
+            return NDArray(jax.device_put(self._handle, other.jax_device), other)
+        raise TypeError("copyto does not support type " + str(type(other)))
+
+    def as_in_context(self, context: Context) -> "NDArray":
+        if context == self.context:
+            return self
+        return self.copyto(context)
+
+    def detach(self) -> "NDArray":
+        out = NDArray(self._handle, self._ctx)
+        return out
+
+    def tostype(self, stype: str):
+        if stype == "default":
+            return self
+        from .sparse import cast_storage
+        return cast_storage(self, stype)
+
+    # -- autograd --------------------------------------------------------
+    def attach_grad(self, grad_req: str = "write", stype=None):
+        self._grad = zeros(self.shape, dtype=self.dtype, ctx=self.context)
+        self._grad_req = grad_req
+        self._autograd_node = None
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        _ag.backward([self], [out_grad] if out_grad is not None else None,
+                     retain_graph=retain_graph, train_mode=train_mode)
+
+    # -- shape ops (method forms) ---------------------------------------
+    def reshape(self, *shape, **kwargs) -> "NDArray":
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        return invoke_with_arrays("Reshape", [self],
+                                  dict(shape=shape, **kwargs))
+
+    def reshape_like(self, other) -> "NDArray":
+        return self.reshape(other.shape)
+
+    def transpose(self, *axes) -> "NDArray":
+        if len(axes) == 1 and isinstance(axes[0], (list, tuple)):
+            axes = tuple(axes[0])
+        return invoke_with_arrays("transpose", [self], dict(axes=axes))
+
+    def flatten(self) -> "NDArray":
+        return invoke_with_arrays("Flatten", [self], {})
+
+    def expand_dims(self, axis) -> "NDArray":
+        return invoke_with_arrays("expand_dims", [self], dict(axis=axis))
+
+    def swapaxes(self, dim1, dim2) -> "NDArray":
+        return invoke_with_arrays("swapaxes", [self], dict(dim1=dim1, dim2=dim2))
+
+    def flip(self, axis) -> "NDArray":
+        return invoke_with_arrays("reverse", [self], dict(axis=axis))
+
+    def broadcast_to(self, shape) -> "NDArray":
+        return invoke_with_arrays("broadcast_to", [self], dict(shape=shape))
+
+    def slice(self, begin, end, step=None) -> "NDArray":
+        return invoke_with_arrays("slice", [self],
+                                  dict(begin=begin, end=end, step=step or ()))
+
+    # reductions / misc method forms used across the reference test-suite
+    def sum(self, axis=None, keepdims=False, **kw):
+        return invoke_with_arrays("sum", [self], dict(axis=axis, keepdims=keepdims))
+
+    def mean(self, axis=None, keepdims=False, **kw):
+        return invoke_with_arrays("mean", [self], dict(axis=axis, keepdims=keepdims))
+
+    def max(self, axis=None, keepdims=False, **kw):
+        return invoke_with_arrays("max", [self], dict(axis=axis, keepdims=keepdims))
+
+    def min(self, axis=None, keepdims=False, **kw):
+        return invoke_with_arrays("min", [self], dict(axis=axis, keepdims=keepdims))
+
+    def prod(self, axis=None, keepdims=False, **kw):
+        return invoke_with_arrays("prod", [self], dict(axis=axis, keepdims=keepdims))
+
+    def norm(self, **kw):
+        return invoke_with_arrays("norm", [self], kw)
+
+    def argmax(self, axis=None, **kw):
+        return invoke_with_arrays("argmax", [self], dict(axis=axis))
+
+    def argmin(self, axis=None, **kw):
+        return invoke_with_arrays("argmin", [self], dict(axis=axis))
+
+    def abs(self):
+        return invoke_with_arrays("abs", [self], {})
+
+    def sign(self):
+        return invoke_with_arrays("sign", [self], {})
+
+    def square(self):
+        return invoke_with_arrays("square", [self], {})
+
+    def sqrt(self):
+        return invoke_with_arrays("sqrt", [self], {})
+
+    def exp(self):
+        return invoke_with_arrays("exp", [self], {})
+
+    def log(self):
+        return invoke_with_arrays("log", [self], {})
+
+    def clip(self, a_min, a_max):
+        return invoke_with_arrays("clip", [self], dict(a_min=a_min, a_max=a_max))
+
+    def one_hot(self, depth, **kw):
+        return invoke_with_arrays("one_hot", [self], dict(depth=depth, **kw))
+
+    def astype_like(self, other):
+        return self.astype(other.dtype)
+
+    # -- arithmetic ------------------------------------------------------
+    def _binary(self, other, op_nd, op_sc, rev=False):
+        if isinstance(other, NDArray):
+            name = op_nd if self.shape == other.shape else _BROADCAST_MAP[op_nd]
+            a, b = (other, self) if rev else (self, other)
+            return invoke_with_arrays(name, [a, b], {})
+        if rev and op_sc in _RSCALAR_MAP:
+            return invoke_with_arrays(_RSCALAR_MAP[op_sc], [self],
+                                      dict(scalar=float(other)))
+        return invoke_with_arrays(op_sc, [self], dict(scalar=float(other)))
+
+    def __add__(self, o):
+        return self._binary(o, "elemwise_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary(o, "elemwise_sub", "_minus_scalar")
+
+    def __rsub__(self, o):
+        return self._binary(o, "elemwise_sub", "_minus_scalar", rev=True)
+
+    def __mul__(self, o):
+        return self._binary(o, "elemwise_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binary(o, "elemwise_div", "_div_scalar")
+
+    def __rtruediv__(self, o):
+        return self._binary(o, "elemwise_div", "_div_scalar", rev=True)
+
+    __div__ = __truediv__
+    __rdiv__ = __rtruediv__
+
+    def __mod__(self, o):
+        return self._binary(o, "_mod", "_mod_scalar")
+
+    def __rmod__(self, o):
+        return self._binary(o, "_mod", "_mod_scalar", rev=True)
+
+    def __pow__(self, o):
+        return self._binary(o, "_power", "_power_scalar")
+
+    def __rpow__(self, o):
+        return self._binary(o, "_power", "_power_scalar", rev=True)
+
+    def __neg__(self):
+        return invoke_with_arrays("negative", [self], {})
+
+    def __abs__(self):
+        return invoke_with_arrays("abs", [self], {})
+
+    def __eq__(self, o):
+        if o is None:
+            return False
+        return self._binary(o, "_equal", "_equal_scalar")
+
+    def __ne__(self, o):
+        if o is None:
+            return True
+        return self._binary(o, "_not_equal", "_not_equal_scalar")
+
+    def __gt__(self, o):
+        return self._binary(o, "_greater", "_greater_scalar")
+
+    def __ge__(self, o):
+        return self._binary(o, "_greater_equal", "_greater_equal_scalar")
+
+    def __lt__(self, o):
+        return self._binary(o, "_lesser", "_lesser_scalar")
+
+    def __le__(self, o):
+        return self._binary(o, "_lesser_equal", "_lesser_equal_scalar")
+
+    def __hash__(self):
+        return id(self)
+
+    def __bool__(self):
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise ValueError("The truth value of an NDArray with multiple "
+                         "elements is ambiguous.")
+
+    def __iadd__(self, o):
+        out = self.__add__(o)
+        self._handle = out._handle
+        self._autograd_node = out._autograd_node
+        return self
+
+    def __isub__(self, o):
+        out = self.__sub__(o)
+        self._handle = out._handle
+        self._autograd_node = out._autograd_node
+        return self
+
+    def __imul__(self, o):
+        out = self.__mul__(o)
+        self._handle = out._handle
+        self._autograd_node = out._autograd_node
+        return self
+
+    def __itruediv__(self, o):
+        out = self.__truediv__(o)
+        self._handle = out._handle
+        self._autograd_node = out._autograd_node
+        return self
+
+    # -- indexing --------------------------------------------------------
+    def __getitem__(self, key):
+        if isinstance(key, NDArray):
+            return invoke_with_arrays("take", [self, key], dict(axis=0))
+        out = self._handle[key]
+        return NDArray(out, self._ctx)
+
+    def __setitem__(self, key, value):
+        if isinstance(value, NDArray):
+            value = value._handle
+        elif isinstance(value, (int, float)):
+            pass
+        else:
+            value = jnp.asarray(value, dtype=self._handle.dtype)
+        if isinstance(key, slice) and key == slice(None):
+            self._handle = jnp.broadcast_to(
+                jnp.asarray(value, dtype=self._handle.dtype), self.shape)
+            if hasattr(value, "astype"):
+                self._handle = jnp.broadcast_to(
+                    value.astype(self._handle.dtype), self.shape)
+            return
+        self._handle = self._handle.at[key].set(value)
+
+    def __iter__(self):
+        for i in range(self.shape[0]):
+            yield self[i]
+
+    # numpy protocol
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+
+_BROADCAST_MAP = {
+    "elemwise_add": "broadcast_add", "elemwise_sub": "broadcast_sub",
+    "elemwise_mul": "broadcast_mul", "elemwise_div": "broadcast_div",
+    "_mod": "broadcast_mod", "_power": "broadcast_power",
+    "_maximum": "broadcast_maximum", "_minimum": "broadcast_minimum",
+    "_equal": "broadcast_equal", "_not_equal": "broadcast_not_equal",
+    "_greater": "broadcast_greater", "_greater_equal": "broadcast_greater_equal",
+    "_lesser": "broadcast_lesser", "_lesser_equal": "broadcast_lesser_equal",
+}
+_RSCALAR_MAP = {
+    "_minus_scalar": "_rminus_scalar", "_div_scalar": "_rdiv_scalar",
+    "_mod_scalar": "_rmod_scalar", "_power_scalar": "_rpower_scalar",
+}
+
+
+# ---------------------------------------------------------------------------
+# Imperative invoke
+# ---------------------------------------------------------------------------
+
+def imperative_invoke(op: Operator, inputs: Sequence[NDArray],
+                      kwargs: Dict[str, Any], out=None):
+    attrs = op.parse_attrs(kwargs)
+    if op.mode_dependent:
+        attrs["_train"] = _ag.is_training()
+    fn = jitted_apply(op, attrs)
+
+    in_arrays = [x._handle for x in inputs]
+    in_nds: List[Optional[NDArray]] = list(inputs)
+    if op.needs_rng:
+        in_arrays = [_rng.next_key()] + in_arrays
+        in_nds = [None] + in_nds
+
+    outputs = fn(*in_arrays)
+    if not isinstance(outputs, tuple):
+        outputs = (outputs,)
+    out_nds = [NDArray(o) for o in outputs]
+
+    if _ag.is_recording():
+        _ag._record_op(fn, in_arrays, in_nds, out_nds)
+
+    # functional writeback of "mutated" inputs (BN aux, optimizer states)
+    for i_in, i_out in op.writeback.items():
+        idx = i_in + (1 if op.needs_rng else 0)
+        nd = in_nds[idx]
+        if nd is not None:
+            nd._handle = outputs[i_out]
+
+    n_vis = op.num_visible_outputs(attrs)
+    visible = out_nds[:n_vis]
+    if out is not None:
+        outs = [out] if isinstance(out, NDArray) else list(out)
+        for o, v in zip(outs, visible):
+            o._handle = v._handle
+            o._autograd_node = v._autograd_node
+        return out
+    return visible[0] if n_vis == 1 else tuple(visible)
+
+
+def invoke_with_arrays(op_name: str, inputs, kwargs, out=None):
+    kwargs = {k: v for k, v in kwargs.items()
+              if v is not None and v is not _Null}
+    return imperative_invoke(get_op(op_name), inputs, kwargs, out)
+
+
+# ---------------------------------------------------------------------------
+# module-level op wrappers (the reference generates these at import from the
+# C op registry — ndarray/register.py; we generate from the python registry)
+# ---------------------------------------------------------------------------
+
+def _make_wrapper(op: Operator):
+    def wrapper(*args, out=None, name=None, **kwargs):
+        inputs = [a for a in args if isinstance(a, NDArray)]
+        extra = [a for a in args if not isinstance(a, NDArray)]
+        if extra:
+            raise MXNetError(
+                "op %s: positional args must be NDArrays, got %r (pass "
+                "parameters as keyword arguments)" % (op.name, extra))
+        if op.variadic and "num_args" not in kwargs:
+            kwargs["num_args"] = len(inputs)
+        # inputs may also arrive as keywords (data=..., weight=...)
+        if not inputs:
+            names = op.list_inputs(None)
+            kw_in = [kwargs.pop(n) for n in list(names)
+                     if isinstance(kwargs.get(n), NDArray)]
+            inputs = kw_in
+        return imperative_invoke(op, inputs, kwargs, out)
+
+    wrapper.__name__ = op.name
+    wrapper.__doc__ = op.doc
+    return wrapper
+
+
+def populate_module(mod, symbol_mode=False):
+    """Expose every registered op as a function in `mod`."""
+    for name in list_ops():
+        op = get_op(name)
+        setattr(mod, name, _make_wrapper(op))
+
+
+# ---------------------------------------------------------------------------
+# creation / io helpers
+# ---------------------------------------------------------------------------
+
+def _put(arr, ctx: Optional[Context]):
+    ctx = ctx or current_context()
+    return jax.device_put(arr, ctx.jax_device)
+
+
+def array(source_array, ctx: Optional[Context] = None, dtype=None) -> NDArray:
+    if isinstance(source_array, NDArray):
+        src = source_array.asnumpy()
+    else:
+        src = np.asarray(source_array)
+    if dtype is None:
+        dtype = src.dtype if isinstance(source_array, (np.ndarray, NDArray)) \
+            else np.float32
+    src = src.astype(dtype_np(dtype), copy=False)
+    ctx = ctx or current_context()
+    return NDArray(_put(src, ctx), ctx)
+
+
+def empty(shape, ctx=None, dtype=None) -> NDArray:
+    return zeros(shape, ctx, dtype or "float32")
+
+
+def zeros(shape, ctx=None, dtype=None, **kwargs) -> NDArray:
+    if isinstance(shape, int):
+        shape = (shape,)
+    dt = dtype_np(dtype or "float32")
+    ctx = ctx or current_context()
+    return NDArray(_put(jnp.zeros(shape, dt), ctx), ctx)
+
+
+def ones(shape, ctx=None, dtype=None, **kwargs) -> NDArray:
+    if isinstance(shape, int):
+        shape = (shape,)
+    dt = dtype_np(dtype or "float32")
+    ctx = ctx or current_context()
+    return NDArray(_put(jnp.ones(shape, dt), ctx), ctx)
+
+
+def full(shape, val, ctx=None, dtype=None, out=None) -> NDArray:
+    if isinstance(shape, int):
+        shape = (shape,)
+    dt = dtype_np(dtype or "float32")
+    ctx = ctx or current_context()
+    nd = NDArray(_put(jnp.full(shape, val, dt), ctx), ctx)
+    if out is not None:
+        out._handle = nd._handle
+        return out
+    return nd
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32") -> NDArray:
+    out = np.arange(start, stop, step).astype(dtype_np(dtype))
+    if repeat != 1:
+        out = np.repeat(out, repeat)
+    ctx = ctx or current_context()
+    return NDArray(_put(out, ctx), ctx)
+
+
+def eye(N, M=0, k=0, ctx=None, dtype="float32") -> NDArray:
+    out = np.eye(N, M if M > 0 else N, k).astype(dtype_np(dtype))
+    ctx = ctx or current_context()
+    return NDArray(_put(out, ctx), ctx)
+
+
+def moveaxis(tensor, source, destination) -> NDArray:
+    return NDArray(jnp.moveaxis(tensor._handle, source, destination),
+                   tensor._ctx)
+
+
+def concatenate(arrays, axis=0, always_copy=True) -> NDArray:
+    return invoke_with_arrays("Concat", list(arrays),
+                              dict(num_args=len(arrays), dim=axis))
+
+
+def stack_nd(arrays, axis=0) -> NDArray:
+    return invoke_with_arrays("stack", list(arrays),
+                              dict(num_args=len(arrays), axis=axis))
+
+
+def waitall():
+    """Block until all async computation completes (mx.nd.waitall)."""
+    for d in jax.live_arrays():
+        try:
+            d.block_until_ready()
+        except Exception:
+            pass
+
+
+def save(fname: str, data):
+    """Save NDArrays (list or str->NDArray dict) — reference MXNDArraySave.
+    The reference's binary container becomes an npz archive written at the
+    exact path given (same call signature, same list/dict round-trip)."""
+    if isinstance(data, NDArray):
+        data = [data]
+    with open(fname, "wb") as f:
+        if isinstance(data, dict):
+            np.savez(f, **{"dict:" + k: v.asnumpy() for k, v in data.items()})
+        else:
+            np.savez(f, **{"list:%d" % i: v.asnumpy()
+                           for i, v in enumerate(data)})
+
+
+def load(fname: str):
+    with np.load(fname, allow_pickle=False) as f:
+        keys = list(f.keys())
+        if keys and keys[0].startswith("dict:"):
+            return {k[5:]: array(f[k]) for k in keys}
+        pairs = sorted((int(k.split(":")[1]), f[k]) for k in keys)
+        return [array(v) for _, v in pairs]

@@ -1,0 +1,84 @@
+"""mx.nd.random / mx.random sampling namespace (reference
+python/mxnet/ndarray/random.py)."""
+from __future__ import annotations
+
+from ..base import dtype_name
+from .ndarray import NDArray, invoke_with_arrays
+
+
+def _sample(op_tensor, op_scalar, params, shape, dtype, kwargs):
+    nds = [p for p in params if isinstance(p, NDArray)]
+    if nds:
+        return invoke_with_arrays(op_tensor, nds,
+                                  dict(shape=shape, dtype=dtype, **kwargs))
+    attrs = dict(shape=shape, dtype=dtype, **kwargs)
+    return invoke_with_arrays(op_scalar, [], attrs)
+
+
+def uniform(low=0, high=1, shape=(), dtype="float32", ctx=None, out=None, **kw):
+    if isinstance(low, NDArray) or isinstance(high, NDArray):
+        return invoke_with_arrays("_sample_uniform", [low, high],
+                                  dict(shape=shape, dtype=dtype), out=out)
+    return invoke_with_arrays("_random_uniform", [],
+                              dict(low=low, high=high, shape=shape or (1,),
+                                   dtype=dtype), out=out)
+
+
+def normal(loc=0, scale=1, shape=(), dtype="float32", ctx=None, out=None, **kw):
+    if isinstance(loc, NDArray) or isinstance(scale, NDArray):
+        return invoke_with_arrays("_sample_normal", [loc, scale],
+                                  dict(shape=shape, dtype=dtype), out=out)
+    return invoke_with_arrays("_random_normal", [],
+                              dict(loc=loc, scale=scale, shape=shape or (1,),
+                                   dtype=dtype), out=out)
+
+
+def gamma(alpha=1, beta=1, shape=(), dtype="float32", ctx=None, out=None, **kw):
+    if isinstance(alpha, NDArray) or isinstance(beta, NDArray):
+        return invoke_with_arrays("_sample_gamma", [alpha, beta],
+                                  dict(shape=shape, dtype=dtype), out=out)
+    return invoke_with_arrays("_random_gamma", [],
+                              dict(alpha=alpha, beta=beta, shape=shape or (1,),
+                                   dtype=dtype), out=out)
+
+
+def exponential(scale=1, shape=(), dtype="float32", ctx=None, out=None, **kw):
+    return invoke_with_arrays("_random_exponential", [],
+                              dict(lam=1.0 / scale, shape=shape or (1,),
+                                   dtype=dtype), out=out)
+
+
+def poisson(lam=1, shape=(), dtype="float32", ctx=None, out=None, **kw):
+    return invoke_with_arrays("_random_poisson", [],
+                              dict(lam=lam, shape=shape or (1,), dtype=dtype),
+                              out=out)
+
+
+def negative_binomial(k=1, p=1, shape=(), dtype="float32", ctx=None,
+                      out=None, **kw):
+    return invoke_with_arrays("_random_negative_binomial", [],
+                              dict(k=k, p=p, shape=shape or (1,), dtype=dtype),
+                              out=out)
+
+
+def generalized_negative_binomial(mu=1, alpha=1, shape=(), dtype="float32",
+                                  ctx=None, out=None, **kw):
+    return invoke_with_arrays("_random_generalized_negative_binomial", [],
+                              dict(mu=mu, alpha=alpha, shape=shape or (1,),
+                                   dtype=dtype), out=out)
+
+
+def randint(low, high, shape=(), dtype="int32", ctx=None, out=None, **kw):
+    return invoke_with_arrays("_random_randint", [],
+                              dict(low=low, high=high, shape=shape or (1,),
+                                   dtype=dtype), out=out)
+
+
+def multinomial(data, shape=(), get_prob=False, out=None, dtype="int32", **kw):
+    return invoke_with_arrays("_sample_multinomial", [data],
+                              dict(shape=shape, get_prob=get_prob,
+                                   dtype=dtype), out=out)
+
+
+def shuffle(data, **kw):
+    return invoke_with_arrays("shuffle", [data], {})

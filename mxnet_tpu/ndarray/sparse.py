@@ -1,0 +1,235 @@
+"""Sparse NDArray types: row_sparse and csr.
+
+Reference: python/mxnet/ndarray/sparse.py (BaseSparseNDArray :104,
+CSRNDArray :260, RowSparseNDArray :530) over include/mxnet/ndarray.h storage
+types (ndarray.h:60-65).
+
+TPU design: XLA has no native sparse layouts, so sparse arrays hold their
+component dense arrays (data/indices[/indptr]) in HBM and ops use
+gather/scatter formulations (take / segment_sum) which XLA maps well; any op
+without a sparse rule densifies first — the exact storage-fallback semantics
+of the reference (src/common/exec_utils.h).  The capability the reference
+gets from row_sparse — touching only the active rows of a huge embedding —
+is preserved in `RowSparseNDArray.retain` + sparse optimizer paths.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError, dtype_np
+from ..context import Context, current_context
+from .ndarray import NDArray, array, invoke_with_arrays, zeros
+
+__all__ = ["BaseSparseNDArray", "CSRNDArray", "RowSparseNDArray",
+           "csr_matrix", "row_sparse_array", "cast_storage", "sparse_dot"]
+
+
+class BaseSparseNDArray(NDArray):
+    """Common base; `_handle` lazily materialises the dense form."""
+
+    __slots__ = ("_shape", "_data", "_dense_cache")
+
+    def __init__(self, shape, data):
+        self._shape = tuple(shape)
+        self._data = data
+        self._dense_cache = None
+        self._ctx = None
+        self._grad = None
+        self._grad_req = "null"
+        self._autograd_node = None
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def _handle(self):
+        if self._dense_cache is None:
+            self._dense_cache = self._to_dense_handle()
+        return self._dense_cache
+
+    @_handle.setter
+    def _handle(self, v):
+        self._dense_cache = v
+
+    @property
+    def data(self):
+        return NDArray(self._data)
+
+    def tostype(self, stype):
+        if stype == self.stype:
+            return self
+        return cast_storage(self, stype)
+
+    def todense(self) -> NDArray:
+        return NDArray(self._handle)
+
+    def asnumpy(self):
+        return np.asarray(self._handle)
+
+    def __repr__(self):
+        return "<%s %s @%s>" % (type(self).__name__,
+                                "x".join(map(str, self.shape)), self.context)
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """(data: (nnz_rows, *row_shape), indices: (nnz_rows,)) — reference
+    RowSparseNDArray (sparse.py:530)."""
+
+    __slots__ = ("_indices",)
+
+    def __init__(self, data, indices, shape):
+        super().__init__(shape, data)
+        self._indices = indices
+        self._stype = "row_sparse"
+
+    @property
+    def indices(self):
+        return NDArray(self._indices)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._data.dtype)
+
+    def _to_dense_handle(self):
+        out = jnp.zeros(self._shape, self._data.dtype)
+        return out.at[self._indices.astype(jnp.int32)].set(self._data)
+
+    def retain(self, indices) -> "RowSparseNDArray":
+        """Keep only the given rows (reference sparse_retain op)."""
+        idx = indices._handle.astype(jnp.int32) if isinstance(indices, NDArray) \
+            else jnp.asarray(indices, jnp.int32)
+        # gather rows present in both: implemented as dense row gather of
+        # the dense form restricted to requested indices
+        dense = self._to_dense_handle()
+        data = jnp.take(dense, idx, axis=0)
+        return RowSparseNDArray(data, idx.astype(jnp.int64), self._shape)
+
+    def copyto(self, other):
+        if isinstance(other, RowSparseNDArray):
+            other._data = self._data
+            other._indices = self._indices
+            other._dense_cache = None
+            return other
+        return super().copyto(other)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """(data, indices, indptr) 2-D CSR — reference CSRNDArray (sparse.py:260)."""
+
+    __slots__ = ("_indices", "_indptr")
+
+    def __init__(self, data, indices, indptr, shape):
+        super().__init__(shape, data)
+        self._indices = indices
+        self._indptr = indptr
+        self._stype = "csr"
+
+    @property
+    def indices(self):
+        return NDArray(self._indices)
+
+    @property
+    def indptr(self):
+        return NDArray(self._indptr)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._data.dtype)
+
+    def _to_dense_handle(self):
+        m, n = self._shape
+        indptr = np.asarray(self._indptr)
+        rows = np.repeat(np.arange(m), np.diff(indptr))
+        out = jnp.zeros(self._shape, self._data.dtype)
+        return out.at[rows, self._indices.astype(jnp.int32)].set(self._data)
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            # row slicing keeps CSR (reference csr slice)
+            dense = self._to_dense_handle()[key]
+            return _dense_to_csr(dense)
+        return super().__getitem__(key)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None) -> RowSparseNDArray:
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        data = data.asnumpy() if isinstance(data, NDArray) else np.asarray(data)
+        indices = indices.asnumpy() if isinstance(indices, NDArray) \
+            else np.asarray(indices)
+        dt = dtype_np(dtype or data.dtype)
+        order = np.argsort(indices)
+        return RowSparseNDArray(jnp.asarray(data[order], dt),
+                                jnp.asarray(indices[order], jnp.int64), shape)
+    if isinstance(arg1, RowSparseNDArray):
+        return arg1
+    dense = arg1.asnumpy() if isinstance(arg1, NDArray) else np.asarray(arg1)
+    if dtype is not None:
+        dense = dense.astype(dtype_np(dtype))
+    nz = np.where(np.any(dense.reshape(dense.shape[0], -1) != 0, axis=1))[0]
+    return RowSparseNDArray(jnp.asarray(dense[nz]), jnp.asarray(nz, jnp.int64),
+                            shape or dense.shape)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None) -> CSRNDArray:
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        conv = lambda x: x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
+        data, indices, indptr = conv(data), conv(indices), conv(indptr)
+        dt = dtype_np(dtype or data.dtype)
+        return CSRNDArray(jnp.asarray(data, dt),
+                          jnp.asarray(indices, jnp.int64),
+                          jnp.asarray(indptr, jnp.int64), shape)
+    dense = arg1.asnumpy() if isinstance(arg1, NDArray) else np.asarray(arg1)
+    if dtype is not None:
+        dense = dense.astype(dtype_np(dtype))
+    return _dense_to_csr(jnp.asarray(dense))
+
+
+def _dense_to_csr(dense) -> CSRNDArray:
+    d = np.asarray(dense)
+    m, n = d.shape
+    rows, cols = np.nonzero(d)
+    indptr = np.zeros(m + 1, np.int64)
+    np.add.at(indptr, rows + 1, 1)
+    indptr = np.cumsum(indptr)
+    return CSRNDArray(jnp.asarray(d[rows, cols]), jnp.asarray(cols, jnp.int64),
+                      jnp.asarray(indptr), (m, n))
+
+
+def cast_storage(arr, stype: str):
+    """reference: src/operator/tensor/cast_storage-inl.h"""
+    if stype == "default":
+        return NDArray(arr._handle) if isinstance(arr, BaseSparseNDArray) else arr
+    if stype == "row_sparse":
+        return row_sparse_array(arr, shape=arr.shape)
+    if stype == "csr":
+        if isinstance(arr, BaseSparseNDArray):
+            arr = arr.todense()
+        return _dense_to_csr(arr._handle)
+    raise MXNetError("unknown storage type " + stype)
+
+
+def sparse_dot(lhs, rhs, transpose_a=False):
+    """dot(csr, dense) / dot(csr.T, dense) (reference dot-inl.h sparse paths)."""
+    if isinstance(lhs, CSRNDArray):
+        dense = lhs._to_dense_handle()
+        out = (dense.T if transpose_a else dense) @ rhs._handle
+        return NDArray(out)
+    return invoke_with_arrays("dot", [lhs, rhs], dict(transpose_a=transpose_a))
+
+
+def zeros_sparse(stype, shape, ctx=None, dtype="float32"):
+    if stype == "row_sparse":
+        return RowSparseNDArray(jnp.zeros((0,) + tuple(shape[1:]), dtype_np(dtype)),
+                                jnp.zeros((0,), jnp.int64), shape)
+    if stype == "csr":
+        return CSRNDArray(jnp.zeros((0,), dtype_np(dtype)),
+                          jnp.zeros((0,), jnp.int64),
+                          jnp.zeros((shape[0] + 1,), jnp.int64), shape)
+    return zeros(shape, ctx, dtype)

@@ -1,0 +1,12 @@
+"""Operator library — importing this package registers every op."""
+from .registry import (AttrDict, Operator, apply_op, get_op, jitted_apply,
+                       list_ops, register)
+from . import elemwise          # noqa: F401
+from . import broadcast_reduce  # noqa: F401
+from . import matrix            # noqa: F401
+from . import nn                # noqa: F401
+from . import init_ops          # noqa: F401
+from . import random_ops        # noqa: F401
+from . import linalg            # noqa: F401
+from . import optimizer_ops     # noqa: F401
+from . import rnn               # noqa: F401

@@ -1,0 +1,191 @@
+"""Broadcasting binary ops and reductions.
+
+Reference: src/operator/tensor/elemwise_binary_broadcast_op*.cc and
+broadcast_reduce_op*.{cc,h}.  The reference computes broadcast shapes in
+BinaryBroadcastShape and launches specialised kernels; here jnp broadcasting
+is the semantics and XLA the codegen.
+
+Reduction attr semantics (broadcast_reduce_op.h ReduceAxesParam):
+* axis: None → all axes; int or tuple otherwise
+* keepdims: keep reduced axes as size-1
+* exclude: reduce over all axes NOT listed in axis
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import attr_bool, attr_shape, attr_str, attr_float, Param
+from .registry import register
+
+_BROADCAST = {
+    "broadcast_add": (jnp.add, ("_broadcast_plus",)),
+    "broadcast_sub": (jnp.subtract, ("_broadcast_minus",)),
+    "broadcast_mul": (jnp.multiply, ()),
+    "broadcast_div": (jnp.divide, ()),
+    "broadcast_mod": (jnp.mod, ()),
+    "broadcast_power": (jnp.power, ()),
+    "broadcast_maximum": (jnp.maximum, ()),
+    "broadcast_minimum": (jnp.minimum, ()),
+    "broadcast_hypot": (jnp.hypot, ()),
+    "broadcast_equal": (lambda a, b: (a == b), ()),
+    "broadcast_not_equal": (lambda a, b: (a != b), ()),
+    "broadcast_greater": (lambda a, b: (a > b), ()),
+    "broadcast_greater_equal": (lambda a, b: (a >= b), ()),
+    "broadcast_lesser": (lambda a, b: (a < b), ()),
+    "broadcast_lesser_equal": (lambda a, b: (a <= b), ()),
+    "broadcast_logical_and": (lambda a, b: (a != 0) & (b != 0), ()),
+    "broadcast_logical_or": (lambda a, b: (a != 0) | (b != 0), ()),
+    "broadcast_logical_xor": (lambda a, b: (a != 0) ^ (b != 0), ()),
+}
+
+
+def _make_bcast(name, f):
+    cmp = any(t in name for t in ("equal", "greater", "lesser", "logical"))
+
+    def fn(attrs, a, b):
+        out = f(a, b)
+        return out.astype(a.dtype) if cmp else out
+
+    return fn
+
+
+for _name, (_f, _aliases) in _BROADCAST.items():
+    register(_name, inputs=("lhs", "rhs"), aliases=_aliases)(
+        _make_bcast(_name, _f))
+
+
+@register("broadcast_to", inputs=("data",),
+          params=dict(shape=attr_shape(required=True)))
+def _broadcast_to(attrs, x):
+    # reference allows 0 meaning "keep this dim"
+    tgt = tuple(s if t == 0 else t for s, t in zip(x.shape, attrs.shape))
+    return jnp.broadcast_to(x, tgt)
+
+
+@register("broadcast_axis", inputs=("data",),
+          params=dict(axis=attr_shape(()), size=attr_shape(())),
+          aliases=("broadcast_axes",))
+def _broadcast_axis(attrs, x):
+    tgt = list(x.shape)
+    for ax, sz in zip(attrs.axis, attrs.size):
+        tgt[ax] = sz
+    return jnp.broadcast_to(x, tuple(tgt))
+
+
+@register("broadcast_like", inputs=("lhs", "rhs"))
+def _broadcast_like(attrs, a, b):
+    return jnp.broadcast_to(a, b.shape)
+
+
+# ---------------------------------------------------------------------------
+# Reductions
+# ---------------------------------------------------------------------------
+
+def _norm_axes(attrs, ndim):
+    axis = attrs.get("axis", None)
+    if axis is None or axis == ():
+        axes = tuple(range(ndim))
+    elif isinstance(axis, int):
+        axes = (axis % ndim,)
+    else:
+        axes = tuple(a % ndim for a in axis)
+    if attrs.get("exclude", False):
+        axes = tuple(i for i in range(ndim) if i not in axes)
+    return axes
+
+
+_RED_PARAMS = dict(axis=attr_shape(None), keepdims=attr_bool(False),
+                   exclude=attr_bool(False))
+
+_REDUCE = {
+    "sum": jnp.sum,
+    "mean": jnp.mean,
+    "prod": jnp.prod,
+    "nansum": jnp.nansum,
+    "nanprod": jnp.nanprod,
+    "max": jnp.max,
+    "min": jnp.min,
+}
+
+_RED_ALIASES = {"sum": ("sum_axis",), "max": ("max_axis",), "min": ("min_axis",)}
+
+
+def _make_reduce(f):
+    def fn(attrs, x):
+        axes = _norm_axes(attrs, x.ndim)
+        return f(x, axis=axes, keepdims=attrs.get("keepdims", False))
+
+    return fn
+
+
+for _name, _f in _REDUCE.items():
+    register(_name, inputs=("data",), params=dict(_RED_PARAMS),
+             aliases=_RED_ALIASES.get(_name, ()))(_make_reduce(_f))
+
+
+@register("norm", inputs=("data",),
+          params=dict(ord=Param(int, 2), axis=attr_shape(None),
+                      keepdims=attr_bool(False)))
+def _norm(attrs, x):
+    axis = attrs.axis
+    if axis is None:
+        sq = jnp.sum(x.astype(jnp.float32) ** 2)
+        return jnp.sqrt(sq).astype(x.dtype).reshape(
+            (1,) if not attrs.keepdims else (1,) * x.ndim)
+    axes = tuple(a % x.ndim for a in axis) if not isinstance(axis, int) else (axis % x.ndim,)
+    if attrs.ord == 1:
+        return jnp.sum(jnp.abs(x), axis=axes, keepdims=attrs.keepdims)
+    return jnp.sqrt(jnp.sum(x * x, axis=axes, keepdims=attrs.keepdims))
+
+
+@register("argmax", inputs=("data",),
+          params=dict(axis=Param(int, None), keepdims=attr_bool(False)))
+def _argmax(attrs, x):
+    if attrs.axis is None:
+        out = jnp.argmax(x.reshape(-1))
+        out = out.reshape((1,) * x.ndim) if attrs.keepdims else out
+    else:
+        out = jnp.argmax(x, axis=attrs.axis)
+        if attrs.keepdims:
+            out = jnp.expand_dims(out, attrs.axis)
+    return out.astype(x.dtype)  # reference returns same dtype as input
+
+
+@register("argmin", inputs=("data",),
+          params=dict(axis=Param(int, None), keepdims=attr_bool(False)))
+def _argmin(attrs, x):
+    if attrs.axis is None:
+        out = jnp.argmin(x.reshape(-1))
+        out = out.reshape((1,) * x.ndim) if attrs.keepdims else out
+    else:
+        out = jnp.argmin(x, axis=attrs.axis)
+        if attrs.keepdims:
+            out = jnp.expand_dims(out, attrs.axis)
+    return out.astype(x.dtype)
+
+
+@register("argmax_channel", inputs=("data",))
+def _argmax_channel(attrs, x):
+    return jnp.argmax(x, axis=1).astype(x.dtype)
+
+
+@register("square_sum", inputs=("data",), params=dict(_RED_PARAMS))
+def _square_sum(attrs, x):
+    """reference: src/operator/tensor/square_sum-inl.h (fused for rowsparse)"""
+    axes = _norm_axes(attrs, x.ndim)
+    return jnp.sum(x * x, axis=axes, keepdims=attrs.get("keepdims", False))
+
+
+@register("L2Normalization", inputs=("data",),
+          params=dict(eps=attr_float(1e-10), mode=attr_str("instance")))
+def _l2_normalization(attrs, x):
+    """reference: src/operator/l2_normalization-inl.h"""
+    if attrs.mode == "instance":
+        axes = tuple(range(1, x.ndim))
+    elif attrs.mode == "channel":
+        axes = (1,)
+    else:  # spatial
+        axes = tuple(range(2, x.ndim))
+    norm = jnp.sqrt(jnp.sum(x * x, axis=axes, keepdims=True) + attrs.eps)
+    return x / norm

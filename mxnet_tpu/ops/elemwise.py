@@ -1,0 +1,241 @@
+"""Elementwise operator families.
+
+Reference: src/operator/tensor/elemwise_unary_op.{cc,cu},
+elemwise_binary_op*.cc, elemwise_binary_scalar_op*.cc and the scalar functor
+zoo in src/operator/mshadow_op.h.  The reference stamps these out with
+MXNET_OPERATOR_REGISTER_UNARY/BINARY macros over mshadow expression templates;
+here each is a one-line jnp lambda registered from a table — XLA fuses chains
+of them into single HBM-bandwidth-bound kernels automatically (the fusion the
+reference only gets within a single mshadow expression).
+
+Semantics parity notes:
+* ``elemwise_*`` binary ops require identical shapes (reference
+  ElemwiseShape); broadcasting lives in broadcast_* (broadcast_reduce.py).
+* ``*_scalar`` ops take the scalar as attr, matching the reference.
+* comparison/logical ops return the input dtype (reference returns same-dtype
+  0/1 values, not bool) — we cast to the lhs dtype.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import attr_float, attr_int, attr_bool, attr_str
+from .registry import register
+
+
+def _same_shape_check(name, a, b):
+    if a.shape != b.shape:
+        raise ValueError(
+            "%s requires identical shapes, got %s vs %s (use broadcast_%s)"
+            % (name, a.shape, b.shape, name.split("_")[-1]))
+
+
+# ---------------------------------------------------------------------------
+# Unary math — mshadow_op.h functor zoo
+# ---------------------------------------------------------------------------
+_UNARY = {
+    "abs": jnp.abs,
+    "sign": jnp.sign,
+    "rint": jnp.rint,
+    "ceil": jnp.ceil,
+    "floor": jnp.floor,
+    "trunc": jnp.trunc,
+    "fix": jnp.trunc,  # reference `fix` rounds toward zero
+    "square": jnp.square,
+    "sqrt": jnp.sqrt,
+    "rsqrt": lambda x: jax.lax.rsqrt(x),
+    "cbrt": jnp.cbrt,
+    "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "exp": jnp.exp,
+    "log": jnp.log,
+    "log10": jnp.log10,
+    "log2": jnp.log2,
+    "log1p": jnp.log1p,
+    "expm1": jnp.expm1,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "arcsin": jnp.arcsin,
+    "arccos": jnp.arccos,
+    "arctan": jnp.arctan,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh,
+    "arccosh": jnp.arccosh,
+    "arctanh": jnp.arctanh,
+    "degrees": jnp.degrees,
+    "radians": jnp.radians,
+    "gamma": lambda x: jnp.exp(jax.lax.lgamma(x)),
+    "gammaln": lambda x: jax.lax.lgamma(x),
+    "reciprocal": lambda x: 1.0 / x,
+    "negative": jnp.negative,
+    "relu": lambda x: jnp.maximum(x, 0),
+    "sigmoid": jax.nn.sigmoid,
+    "softsign": jax.nn.soft_sign,
+    "erf": jax.lax.erf,
+    "erfinv": jax.lax.erf_inv,
+    "logical_not": lambda x: (x == 0).astype(x.dtype),
+}
+
+for _name, _f in _UNARY.items():
+    register(_name, inputs=("data",))(
+        (lambda f: lambda attrs, x: f(x))(_f))
+
+
+@register("identity", inputs=("data",), aliases=("_copy",))
+def _identity(attrs, x):
+    return x
+
+
+@register("BlockGrad", inputs=("data",), aliases=("stop_gradient",))
+def _block_grad(attrs, x):
+    """reference: src/operator/tensor/elemwise_unary_op.cc BlockGrad"""
+    return jax.lax.stop_gradient(x)
+
+
+@register("make_loss", inputs=("data",))
+def _make_loss_op(attrs, x):
+    return x
+
+
+@register("zeros_like", inputs=("data",))
+def _zeros_like(attrs, x):
+    return jnp.zeros_like(x)
+
+
+@register("ones_like", inputs=("data",))
+def _ones_like(attrs, x):
+    return jnp.ones_like(x)
+
+
+# ---------------------------------------------------------------------------
+# Binary elementwise (same-shape) — elemwise_binary_op_basic.cc
+# ---------------------------------------------------------------------------
+_BINARY = {
+    "elemwise_add": jnp.add,
+    "elemwise_sub": jnp.subtract,
+    "elemwise_mul": jnp.multiply,
+    "elemwise_div": jnp.divide,
+    "_maximum": jnp.maximum,
+    "_minimum": jnp.minimum,
+    "_hypot": jnp.hypot,
+    "_power": jnp.power,
+    "_mod": jnp.mod,
+    "_equal": lambda a, b: (a == b),
+    "_not_equal": lambda a, b: (a != b),
+    "_greater": lambda a, b: (a > b),
+    "_greater_equal": lambda a, b: (a >= b),
+    "_lesser": lambda a, b: (a < b),
+    "_lesser_equal": lambda a, b: (a <= b),
+    "_logical_and": lambda a, b: (a != 0) & (b != 0),
+    "_logical_or": lambda a, b: (a != 0) | (b != 0),
+    "_logical_xor": lambda a, b: (a != 0) ^ (b != 0),
+}
+
+_BINARY_ALIASES = {
+    "elemwise_add": ("_plus", "_add"),
+    "elemwise_sub": ("_minus", "_sub"),
+    "elemwise_mul": ("_mul",),
+    "elemwise_div": ("_div",),
+}
+
+
+def _make_binary(name, f):
+    cast = name.startswith("_equal") or name.startswith("_not") or \
+        name.startswith("_greater") or name.startswith("_lesser") or \
+        name.startswith("_logical")
+
+    def fn(attrs, a, b):
+        out = f(a, b)
+        return out.astype(a.dtype) if cast else out
+
+    return fn
+
+
+for _name, _f in _BINARY.items():
+    register(_name, inputs=("lhs", "rhs"),
+             aliases=_BINARY_ALIASES.get(_name, ()))(_make_binary(_name, _f))
+
+
+@register("smooth_l1", inputs=("data",), params=dict(scalar=attr_float(1.0)))
+def _smooth_l1(attrs, x):
+    """reference: mshadow_op.h smooth_l1_loss; sigma = attrs.scalar"""
+    s2 = attrs.scalar * attrs.scalar
+    absx = jnp.abs(x)
+    return jnp.where(absx < 1.0 / s2, 0.5 * s2 * x * x, absx - 0.5 / s2)
+
+
+# ---------------------------------------------------------------------------
+# Scalar ops — elemwise_binary_scalar_op_basic.cc; scalar is an attr
+# ---------------------------------------------------------------------------
+_SCALAR = {
+    "_plus_scalar": lambda x, s: x + s,
+    "_minus_scalar": lambda x, s: x - s,
+    "_rminus_scalar": lambda x, s: s - x,
+    "_mul_scalar": lambda x, s: x * s,
+    "_div_scalar": lambda x, s: x / s,
+    "_rdiv_scalar": lambda x, s: s / x,
+    "_mod_scalar": lambda x, s: jnp.mod(x, s),
+    "_rmod_scalar": lambda x, s: jnp.mod(s, x),
+    "_power_scalar": lambda x, s: jnp.power(x, s),
+    "_rpower_scalar": lambda x, s: jnp.power(s, x),
+    "_maximum_scalar": lambda x, s: jnp.maximum(x, s),
+    "_minimum_scalar": lambda x, s: jnp.minimum(x, s),
+    "_hypot_scalar": lambda x, s: jnp.hypot(x, s),
+    "_equal_scalar": lambda x, s: (x == s),
+    "_not_equal_scalar": lambda x, s: (x != s),
+    "_greater_scalar": lambda x, s: (x > s),
+    "_greater_equal_scalar": lambda x, s: (x >= s),
+    "_lesser_scalar": lambda x, s: (x < s),
+    "_lesser_equal_scalar": lambda x, s: (x <= s),
+    "_logical_and_scalar": lambda x, s: (x != 0) & (s != 0),
+    "_logical_or_scalar": lambda x, s: (x != 0) | (s != 0),
+    "_logical_xor_scalar": lambda x, s: (x != 0) ^ (s != 0),
+}
+
+
+def _make_scalar(name, f):
+    cmp = any(t in name for t in ("equal", "greater", "lesser", "logical"))
+
+    def fn(attrs, x):
+        out = f(x, attrs.scalar)
+        return out.astype(x.dtype) if cmp else out
+
+    return fn
+
+
+for _name, _f in _SCALAR.items():
+    register(_name, inputs=("data",),
+             params=dict(scalar=attr_float(required=True)))(
+        _make_scalar(_name, _f))
+
+
+@register("_scatter_elemwise_div", inputs=("lhs", "rhs"))
+def _scatter_div(attrs, a, b):
+    return a / b
+
+
+# clip: tensor/matrix_op.cc Clip
+@register("clip", inputs=("data",),
+          params=dict(a_min=attr_float(required=True),
+                      a_max=attr_float(required=True)))
+def _clip(attrs, x):
+    return jnp.clip(x, attrs.a_min, attrs.a_max)
+
+
+@register("Cast", inputs=("data",),
+          params=dict(dtype=attr_str(required=True)), aliases=("cast",))
+def _cast(attrs, x):
+    from ..base import dtype_np
+    return x.astype(dtype_np(attrs.dtype))
+
+
+@register("where", inputs=("condition", "x", "y"))
+def _where(attrs, cond, x, y):
+    """reference: src/operator/tensor/control_flow_op.cc (where)"""
+    if cond.shape != x.shape:
+        # 1-D condition selects rows (reference control_flow_op.h)
+        cond = cond.reshape((-1,) + (1,) * (x.ndim - 1))
+    return jnp.where(cond != 0, x, y)

@@ -1,0 +1,50 @@
+"""Creation ops (no tensor inputs).
+
+Reference: src/operator/tensor/init_op.{cc,h} (_zeros/_ones/_full/_arange/
+_eye) — these are the ops whose outputs materialise fresh buffers in HBM.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import attr_dtype, attr_float, attr_int, attr_shape, attr_str, dtype_np, Param
+from .registry import register
+
+_CREATE_PARAMS = dict(shape=attr_shape(()), ctx=attr_str(None),
+                      dtype=attr_dtype("float32"))
+
+
+@register("_zeros", inputs=(), params=dict(_CREATE_PARAMS))
+def _zeros(attrs):
+    return jnp.zeros(attrs.shape, dtype_np(attrs.dtype))
+
+
+@register("_ones", inputs=(), params=dict(_CREATE_PARAMS))
+def _ones(attrs):
+    return jnp.ones(attrs.shape, dtype_np(attrs.dtype))
+
+
+@register("_full", inputs=(),
+          params=dict(_CREATE_PARAMS, value=attr_float(required=True)))
+def _full(attrs):
+    return jnp.full(attrs.shape, attrs.value, dtype_np(attrs.dtype))
+
+
+@register("_arange", inputs=(),
+          params=dict(start=attr_float(0.0), stop=attr_float(None),
+                      step=attr_float(1.0), repeat=attr_int(1),
+                      infer_range=Param(bool, False),
+                      ctx=attr_str(None), dtype=attr_dtype("float32")))
+def _arange(attrs):
+    out = jnp.arange(attrs.start, attrs.stop, attrs.step, dtype_np(attrs.dtype))
+    if attrs.repeat != 1:
+        out = jnp.repeat(out, attrs.repeat)
+    return out
+
+
+@register("_eye", inputs=(),
+          params=dict(N=attr_int(required=True), M=attr_int(0), k=attr_int(0),
+                      ctx=attr_str(None), dtype=attr_dtype("float32")))
+def _eye(attrs):
+    m = attrs.M if attrs.M > 0 else attrs.N
+    return jnp.eye(attrs.N, m, k=attrs.k, dtype=dtype_np(attrs.dtype))

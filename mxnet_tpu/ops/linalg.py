@@ -1,0 +1,147 @@
+"""Linear-algebra ops (reference: src/operator/tensor/la_op.{cc,h} — potrf,
+potri, trmm, trsm, gemm, gemm2, sumlogdiag, syrk, gelqf, maketrian/extracttrian).
+
+These lower to jax.lax.linalg / jnp.linalg which XLA maps to MXU matmuls +
+host-side decompositions where needed.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import attr_bool, attr_float, attr_int
+from .registry import register
+
+
+@register("_linalg_gemm", inputs=("A", "B", "C"),
+          params=dict(transpose_a=attr_bool(False), transpose_b=attr_bool(False),
+                      alpha=attr_float(1.0), beta=attr_float(1.0),
+                      axis=attr_int(-2)),
+          aliases=("linalg_gemm",))
+def _gemm(attrs, a, b, c):
+    if attrs.transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if attrs.transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return attrs.alpha * jnp.matmul(a, b) + attrs.beta * c
+
+
+@register("_linalg_gemm2", inputs=("A", "B"),
+          params=dict(transpose_a=attr_bool(False), transpose_b=attr_bool(False),
+                      alpha=attr_float(1.0), axis=attr_int(-2)),
+          aliases=("linalg_gemm2",))
+def _gemm2(attrs, a, b):
+    if attrs.transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if attrs.transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return attrs.alpha * jnp.matmul(a, b)
+
+
+@register("_linalg_potrf", inputs=("A",), aliases=("linalg_potrf",))
+def _potrf(attrs, a):
+    return jnp.linalg.cholesky(a)
+
+
+@register("_linalg_potri", inputs=("A",), aliases=("linalg_potri",))
+def _potri(attrs, a):
+    """Inverse of matrix from its Cholesky factor L: (L L^T)^-1."""
+    eye = jnp.broadcast_to(jnp.eye(a.shape[-1], dtype=a.dtype), a.shape)
+    linv = jax.scipy.linalg.solve_triangular(a, eye, lower=True)
+    return jnp.matmul(jnp.swapaxes(linv, -1, -2), linv)
+
+
+@register("_linalg_trmm", inputs=("A", "B"),
+          params=dict(transpose=attr_bool(False), rightside=attr_bool(False),
+                      lower=attr_bool(True), alpha=attr_float(1.0)),
+          aliases=("linalg_trmm",))
+def _trmm(attrs, a, b):
+    tri = jnp.tril(a) if attrs.lower else jnp.triu(a)
+    if attrs.transpose:
+        tri = jnp.swapaxes(tri, -1, -2)
+    out = jnp.matmul(b, tri) if attrs.rightside else jnp.matmul(tri, b)
+    return attrs.alpha * out
+
+
+@register("_linalg_trsm", inputs=("A", "B"),
+          params=dict(transpose=attr_bool(False), rightside=attr_bool(False),
+                      lower=attr_bool(True), alpha=attr_float(1.0)),
+          aliases=("linalg_trsm",))
+def _trsm(attrs, a, b):
+    lower = attrs.lower != attrs.transpose  # transposing flips triangularity
+    if attrs.rightside:
+        # solve X A = alpha B  ->  A^T X^T = alpha B^T
+        at = jnp.swapaxes(a, -1, -2) if not attrs.transpose else a
+        xt = jax.scipy.linalg.solve_triangular(
+            at, jnp.swapaxes(attrs.alpha * b, -1, -2), lower=not lower)
+        return jnp.swapaxes(xt, -1, -2)
+    aa = jnp.swapaxes(a, -1, -2) if attrs.transpose else a
+    return jax.scipy.linalg.solve_triangular(aa, attrs.alpha * b, lower=lower)
+
+
+@register("_linalg_sumlogdiag", inputs=("A",), aliases=("linalg_sumlogdiag",))
+def _sumlogdiag(attrs, a):
+    diag = jnp.diagonal(a, axis1=-2, axis2=-1)
+    return jnp.sum(jnp.log(diag), axis=-1)
+
+
+@register("_linalg_syrk", inputs=("A",),
+          params=dict(transpose=attr_bool(False), alpha=attr_float(1.0)),
+          aliases=("linalg_syrk",))
+def _syrk(attrs, a):
+    at = jnp.swapaxes(a, -1, -2)
+    if attrs.transpose:
+        return attrs.alpha * jnp.matmul(at, a)
+    return attrs.alpha * jnp.matmul(a, at)
+
+
+@register("_linalg_gelqf", inputs=("A",), num_outputs=2,
+          aliases=("linalg_gelqf",))
+def _gelqf(attrs, a):
+    """LQ factorization A = L Q with Q orthonormal rows (m <= n)."""
+    q, r = jnp.linalg.qr(jnp.swapaxes(a, -1, -2), mode="reduced")
+    # A^T = Q R  =>  A = R^T Q^T ; enforce positive diagonal like LAPACK
+    l = jnp.swapaxes(r, -1, -2)
+    sign = jnp.sign(jnp.diagonal(l, axis1=-2, axis2=-1))
+    sign = jnp.where(sign == 0, 1.0, sign)
+    l = l * sign[..., None, :]
+    qt = jnp.swapaxes(q, -1, -2) * sign[..., :, None]
+    return l, qt
+
+
+@register("_linalg_maketrian", inputs=("A",),
+          params=dict(offset=attr_int(0), lower=attr_bool(True)),
+          aliases=("linalg_maketrian",))
+def _maketrian(attrs, a):
+    """Pack vector of triangular entries into a matrix."""
+    k = a.shape[-1]
+    n = int((jnp.sqrt(8 * k + 1) - 1) / 2)
+    idx = jnp.tril_indices(n) if attrs.lower else jnp.triu_indices(n)
+    out = jnp.zeros(a.shape[:-1] + (n, n), dtype=a.dtype)
+    return out.at[..., idx[0], idx[1]].set(a)
+
+
+@register("_linalg_extracttrian", inputs=("A",),
+          params=dict(offset=attr_int(0), lower=attr_bool(True)),
+          aliases=("linalg_extracttrian",))
+def _extracttrian(attrs, a):
+    n = a.shape[-1]
+    idx = jnp.tril_indices(n) if attrs.lower else jnp.triu_indices(n)
+    return a[..., idx[0], idx[1]]
+
+
+@register("_linalg_extractdiag", inputs=("A",),
+          params=dict(offset=attr_int(0)), aliases=("linalg_extractdiag",))
+def _extractdiag(attrs, a):
+    return jnp.diagonal(a, offset=attrs.offset, axis1=-2, axis2=-1)
+
+
+@register("_linalg_makediag", inputs=("A",),
+          params=dict(offset=attr_int(0)), aliases=("linalg_makediag",))
+def _makediag(attrs, a):
+    base = jnp.zeros(a.shape[:-1] + (a.shape[-1] + abs(attrs.offset),) * 2,
+                     dtype=a.dtype)
+    idx = jnp.arange(a.shape[-1])
+    if attrs.offset >= 0:
+        return base.at[..., idx, idx + attrs.offset].set(a)
+    return base.at[..., idx - attrs.offset, idx].set(a)

@@ -1,0 +1,426 @@
+"""Shape-manipulation, indexing and matmul ops.
+
+Reference: src/operator/tensor/matrix_op.{cc,-inl.h} (Reshape/transpose/slice/
+dot/Concat/...), indexing_op.{cc,h} (Embedding/take/one_hot/gather_nd/
+scatter_nd), ordering_op.cc (topk/sort/argsort).
+
+MXU note: ``dot``/``batch_dot``/``FullyConnected`` are the ops XLA maps onto
+the 128x128 systolic array; everything here keeps them as single
+lax.dot_general calls with a float32 accumulator (preferred_element_type) so
+bfloat16 inputs still accumulate in fp32 like the hardware wants.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import (MXNetError, attr_bool, attr_float, attr_int, attr_shape,
+                    attr_str, attr_dtype, Param)
+from .registry import register
+
+
+# ---------------------------------------------------------------------------
+# Reshape with MXNet's special codes (matrix_op-inl.h ReshapeParam):
+#  0 → copy input dim; -1 → infer; -2 → copy all remaining dims;
+# -3 → merge next two input dims; -4 → split one input dim into next two
+# ---------------------------------------------------------------------------
+
+def infer_reshape(ishape, target, reverse=False):
+    """Pure-python resolution of the target shape; shared with Symbol layer."""
+    if reverse:
+        ishape = tuple(reversed(ishape))
+        target = tuple(reversed(target))
+    out = []
+    src = list(ishape)
+    i = 0  # position in src
+    t = 0
+    while t < len(target):
+        code = target[t]
+        if code == 0:
+            out.append(src[i]); i += 1
+        elif code == -1:
+            out.append(-1); i += 1
+        elif code == -2:
+            out.extend(src[i:]); i = len(src)
+        elif code == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif code == -4:
+            d1, d2 = target[t + 1], target[t + 2]
+            if d1 == -1:
+                d1 = src[i] // d2
+            if d2 == -1:
+                d2 = src[i] // d1
+            out.extend([d1, d2]); i += 1; t += 2
+        else:
+            out.append(code)
+            if i < len(src):
+                i += 1
+        t += 1
+    if -1 in out:
+        known = int(np.prod([d for d in out if d != -1])) or 1
+        total = int(np.prod(ishape)) if ishape else 1
+        out[out.index(-1)] = total // known
+    if reverse:
+        out = list(reversed(out))
+    return tuple(out)
+
+
+@register("Reshape", inputs=("data",),
+          params=dict(shape=attr_shape(()), reverse=attr_bool(False),
+                      target_shape=attr_shape(None), keep_highest=attr_bool(False)),
+          aliases=("reshape",))
+def _reshape(attrs, x):
+    if attrs.shape:
+        tgt = infer_reshape(x.shape, attrs.shape, attrs.reverse)
+    elif attrs.target_shape is not None:  # legacy
+        tgt = attrs.target_shape
+        if attrs.keep_highest:
+            tgt = (x.shape[0],) + tuple(tgt)[1:]
+    else:
+        tgt = (-1,)
+    return jnp.reshape(x, tgt)
+
+
+@register("Flatten", inputs=("data",), aliases=("flatten",))
+def _flatten(attrs, x):
+    return jnp.reshape(x, (x.shape[0], -1))
+
+
+@register("transpose", inputs=("data",), params=dict(axes=attr_shape(())))
+def _transpose(attrs, x):
+    axes = attrs.axes if attrs.axes else None
+    return jnp.transpose(x, axes)
+
+
+@register("expand_dims", inputs=("data",),
+          params=dict(axis=attr_int(required=True)))
+def _expand_dims(attrs, x):
+    return jnp.expand_dims(x, attrs.axis)
+
+
+@register("squeeze", inputs=("data",), params=dict(axis=attr_shape(None)))
+def _squeeze(attrs, x):
+    return jnp.squeeze(x, attrs.axis)
+
+
+@register("swapaxes", inputs=("data",),
+          params=dict(dim1=attr_int(0), dim2=attr_int(0)),
+          aliases=("SwapAxis",))
+def _swapaxes(attrs, x):
+    return jnp.swapaxes(x, attrs.dim1, attrs.dim2)
+
+
+@register("slice", inputs=("data",),
+          params=dict(begin=attr_shape(required=True),
+                      end=attr_shape(required=True),
+                      step=attr_shape(())),
+          aliases=("crop",))
+def _slice(attrs, x):
+    idx = []
+    step = attrs.step or (None,) * len(attrs.begin)
+    for b, e, s in zip(attrs.begin, attrs.end, step):
+        idx.append(slice(b, e, s))
+    return x[tuple(idx)]
+
+
+@register("slice_axis", inputs=("data",),
+          params=dict(axis=attr_int(required=True),
+                      begin=attr_int(required=True),
+                      end=attr_int(None)))
+def _slice_axis(attrs, x):
+    idx = [slice(None)] * x.ndim
+    idx[attrs.axis] = slice(attrs.begin, attrs.end)
+    return x[tuple(idx)]
+
+
+@register("slice_like", inputs=("data", "shape_like"),
+          params=dict(axes=attr_shape(())))
+def _slice_like(attrs, x, y):
+    axes = attrs.axes or tuple(range(min(x.ndim, y.ndim)))
+    idx = [slice(None)] * x.ndim
+    for ax in axes:
+        idx[ax] = slice(0, y.shape[ax])
+    return x[tuple(idx)]
+
+
+@register("reverse", inputs=("data",),
+          params=dict(axis=attr_shape(required=True)), aliases=("flip",))
+def _reverse(attrs, x):
+    return jnp.flip(x, attrs.axis)
+
+
+@register("tile", inputs=("data",), params=dict(reps=attr_shape(required=True)))
+def _tile(attrs, x):
+    return jnp.tile(x, attrs.reps)
+
+
+@register("repeat", inputs=("data",),
+          params=dict(repeats=attr_int(required=True), axis=Param(int, None)))
+def _repeat(attrs, x):
+    return jnp.repeat(x, attrs.repeats, axis=attrs.axis)
+
+
+@register("Pad", inputs=("data",),
+          params=dict(mode=attr_str("constant"),
+                      pad_width=attr_shape(required=True),
+                      constant_value=attr_float(0.0)),
+          aliases=("pad",))
+def _pad(attrs, x):
+    pw = attrs.pad_width
+    pairs = [(pw[2 * i], pw[2 * i + 1]) for i in range(x.ndim)]
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect"}[attrs.mode]
+    if mode == "constant":
+        return jnp.pad(x, pairs, mode="constant", constant_values=attrs.constant_value)
+    return jnp.pad(x, pairs, mode=mode)
+
+
+# ---------------------------------------------------------------------------
+# Concat / split / stack
+# ---------------------------------------------------------------------------
+
+@register("Concat", variadic=True, inputs=("data",),
+          params=dict(num_args=attr_int(required=True), dim=attr_int(1)),
+          aliases=("concat",))
+def _concat(attrs, *xs):
+    return jnp.concatenate(xs, axis=attrs.dim)
+
+
+@register("stack", variadic=True, inputs=("data",),
+          params=dict(num_args=attr_int(required=True), axis=attr_int(0)))
+def _stack(attrs, *xs):
+    return jnp.stack(xs, axis=attrs.axis)
+
+
+def _split_outputs(attrs):
+    return attrs.num_outputs if attrs else 1
+
+
+@register("SliceChannel", inputs=("data",),
+          params=dict(num_outputs=attr_int(required=True), axis=attr_int(1),
+                      squeeze_axis=attr_bool(False)),
+          num_outputs=_split_outputs, aliases=("split",))
+def _slice_channel(attrs, x):
+    parts = jnp.split(x, attrs.num_outputs, axis=attrs.axis)
+    if attrs.squeeze_axis:
+        parts = [jnp.squeeze(p, axis=attrs.axis) for p in parts]
+    return tuple(parts)
+
+
+# ---------------------------------------------------------------------------
+# Matmuls — MXU-bound ops
+# ---------------------------------------------------------------------------
+
+@register("dot", inputs=("lhs", "rhs"),
+          params=dict(transpose_a=attr_bool(False), transpose_b=attr_bool(False),
+                      forward_stype=attr_str(None)))
+def _dot(attrs, a, b):
+    """reference: src/operator/tensor/dot-inl.h — reduces last axis of lhs
+    with first axis of rhs (after optional transposes)."""
+    if attrs.transpose_a:
+        a = jnp.transpose(a, tuple(range(1, a.ndim)) + (0,)) if a.ndim > 1 else a
+    if attrs.transpose_b:
+        b = jnp.transpose(b, (b.ndim - 1,) + tuple(range(b.ndim - 1))) if b.ndim > 1 else b
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.vdot(a, b)
+    return jax.lax.dot_general(
+        a, b, (((a.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(
+            jnp.promote_types(a.dtype, b.dtype))
+
+
+@register("batch_dot", inputs=("lhs", "rhs"),
+          params=dict(transpose_a=attr_bool(False), transpose_b=attr_bool(False),
+                      forward_stype=attr_str(None)))
+def _batch_dot(attrs, a, b):
+    if attrs.transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if attrs.transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+@register("khatri_rao", variadic=True, inputs=("args",),
+          params=dict(num_args=attr_int(required=True)))
+def _khatri_rao(attrs, *xs):
+    """Column-wise Khatri-Rao product (reference: src/operator/contrib/krprod.h)."""
+    out = xs[0]
+    for x in xs[1:]:
+        out = jnp.einsum("ik,jk->ijk", out, x).reshape(-1, out.shape[1])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Indexing — indexing_op.h
+# ---------------------------------------------------------------------------
+
+@register("Embedding", inputs=("data", "weight"),
+          params=dict(input_dim=attr_int(required=True),
+                      output_dim=attr_int(required=True),
+                      dtype=attr_dtype("float32"),
+                      sparse_grad=attr_bool(False)))
+def _embedding(attrs, idx, weight):
+    return jnp.take(weight, idx.astype(jnp.int32), axis=0)
+
+
+@register("take", inputs=("a", "indices"),
+          params=dict(axis=attr_int(0), mode=attr_str("clip")))
+def _take(attrs, a, idx):
+    mode = {"clip": "clip", "wrap": "wrap", "raise": "clip"}[attrs.mode]
+    return jnp.take(a, idx.astype(jnp.int32), axis=attrs.axis, mode=mode)
+
+
+@register("batch_take", inputs=("a", "indices"))
+def _batch_take(attrs, a, idx):
+    return jnp.take_along_axis(
+        a, idx.astype(jnp.int32).reshape(-1, 1), axis=1).squeeze(1)
+
+
+@register("pick", inputs=("data", "index"),
+          params=dict(axis=Param(int, -1), keepdims=attr_bool(False),
+                      mode=attr_str("clip")))
+def _pick(attrs, x, idx):
+    axis = attrs.axis if attrs.axis is not None else -1
+    idxe = jnp.expand_dims(idx.astype(jnp.int32), axis=axis)
+    out = jnp.take_along_axis(x, idxe, axis=axis)
+    return out if attrs.keepdims else jnp.squeeze(out, axis=axis)
+
+
+@register("one_hot", inputs=("indices",),
+          params=dict(depth=attr_int(required=True), on_value=attr_float(1.0),
+                      off_value=attr_float(0.0), dtype=attr_dtype("float32")))
+def _one_hot(attrs, idx):
+    from ..base import dtype_np
+    oh = jax.nn.one_hot(idx.astype(jnp.int32), attrs.depth)
+    out = oh * (attrs.on_value - attrs.off_value) + attrs.off_value
+    return out.astype(dtype_np(attrs.dtype))
+
+
+@register("gather_nd", inputs=("data", "indices"))
+def _gather_nd(attrs, data, indices):
+    """indices: (M, ...) leading dim indexes into first M dims of data."""
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    return data[tuple(idx[i] for i in range(m))]
+
+
+@register("scatter_nd", inputs=("data", "indices"),
+          params=dict(shape=attr_shape(required=True)))
+def _scatter_nd(attrs, data, indices):
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    out = jnp.zeros(attrs.shape, dtype=data.dtype)
+    return out.at[tuple(idx[i] for i in range(m))].set(data)
+
+
+@register("_backward_gather_nd", inputs=("data", "indices"),
+          params=dict(shape=attr_shape(required=True)))
+def _scatter_add_nd(attrs, data, indices):
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    out = jnp.zeros(attrs.shape, dtype=data.dtype)
+    return out.at[tuple(idx[i] for i in range(m))].add(data)
+
+
+# ---------------------------------------------------------------------------
+# Ordering — ordering_op.cc
+# ---------------------------------------------------------------------------
+
+def _topk_nout(attrs):
+    return 2 if attrs and attrs.get("ret_typ") == "both" else 1
+
+
+@register("topk", inputs=("data",),
+          params=dict(axis=Param(int, -1), k=attr_int(1),
+                      ret_typ=attr_str("indices"), is_ascend=attr_bool(False),
+                      dtype=attr_dtype("float32")),
+          num_outputs=_topk_nout)
+def _topk(attrs, x):
+    axis = attrs.axis if attrs.axis is not None else -1
+    xm = jnp.moveaxis(x, axis, -1)
+    vals = xm if not attrs.is_ascend else -xm
+    top_v, top_i = jax.lax.top_k(vals, attrs.k)
+    if attrs.is_ascend:
+        top_v = -top_v
+    top_v = jnp.moveaxis(top_v, -1, axis)
+    top_i = jnp.moveaxis(top_i, -1, axis)
+    if attrs.ret_typ == "value":
+        return top_v
+    if attrs.ret_typ == "both":
+        return top_v, top_i.astype(x.dtype)
+    if attrs.ret_typ == "mask":
+        mask = jnp.zeros(xm.shape, xm.dtype)
+        mask = mask.at[..., 0].set(0)  # shape anchor
+        oh = jax.nn.one_hot(top_i, xm.shape[-1], dtype=x.dtype).sum(-2)
+        return jnp.moveaxis(oh, -1, axis)
+    return top_i.astype(x.dtype)
+
+
+@register("sort", inputs=("data",),
+          params=dict(axis=Param(int, -1), is_ascend=attr_bool(True)))
+def _sort(attrs, x):
+    out = jnp.sort(x, axis=attrs.axis)
+    return out if attrs.is_ascend else jnp.flip(out, axis=attrs.axis if attrs.axis is not None else -1)
+
+
+@register("argsort", inputs=("data",),
+          params=dict(axis=Param(int, -1), is_ascend=attr_bool(True),
+                      dtype=attr_dtype("float32")))
+def _argsort(attrs, x):
+    out = jnp.argsort(x, axis=attrs.axis)
+    if not attrs.is_ascend:
+        out = jnp.flip(out, axis=attrs.axis if attrs.axis is not None else -1)
+    return out.astype(x.dtype)
+
+
+@register("shuffle", inputs=("data",), needs_rng=True)
+def _shuffle(attrs, key, x):
+    return jax.random.permutation(key, x, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Sequence ops — src/operator/sequence_{last,mask,reverse}-inl.h
+# sequence axis is axis 0 (TNC), batch axis 1
+# ---------------------------------------------------------------------------
+
+@register("SequenceMask", inputs=("data", "sequence_length"),
+          params=dict(use_sequence_length=attr_bool(False),
+                      value=attr_float(0.0), axis=attr_int(0)))
+def _sequence_mask(attrs, data, seq_len=None):
+    if not attrs.use_sequence_length or seq_len is None:
+        return data
+    T = data.shape[attrs.axis]
+    steps = jnp.arange(T)
+    if attrs.axis == 0:
+        mask = steps[:, None] < seq_len[None, :].astype(jnp.int32)
+        mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    else:
+        mask = steps[None, :] < seq_len[:, None].astype(jnp.int32)
+        mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, attrs.value)
+
+
+@register("SequenceLast", inputs=("data", "sequence_length"),
+          params=dict(use_sequence_length=attr_bool(False), axis=attr_int(0)))
+def _sequence_last(attrs, data, seq_len=None):
+    if not attrs.use_sequence_length or seq_len is None:
+        return jnp.take(data, -1, axis=attrs.axis)
+    idx = (seq_len.astype(jnp.int32) - 1)
+    if attrs.axis == 0:
+        return jnp.take_along_axis(
+            data, idx.reshape((1, -1) + (1,) * (data.ndim - 2)), axis=0)[0]
+    return jnp.take_along_axis(
+        data, idx.reshape((-1, 1) + (1,) * (data.ndim - 2)), axis=1)[:, 0]
+
+
+@register("SequenceReverse", inputs=("data", "sequence_length"),
+          params=dict(use_sequence_length=attr_bool(False), axis=attr_int(0)))
+def _sequence_reverse(attrs, data, seq_len=None):
+    if not attrs.use_sequence_length or seq_len is None:
+        return jnp.flip(data, axis=0)
+    T = data.shape[0]
+    steps = jnp.arange(T)[:, None]
+    L = seq_len.astype(jnp.int32)[None, :]
+    src = jnp.where(steps < L, L - 1 - steps, steps)  # (T, B)
+    src = src.reshape(src.shape + (1,) * (data.ndim - 2))
+    return jnp.take_along_axis(data, jnp.broadcast_to(src, data.shape), axis=0)

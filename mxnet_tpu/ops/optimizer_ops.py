@@ -1,0 +1,145 @@
+"""Fused optimizer update ops.
+
+Reference: src/operator/optimizer_op.cc (sgd_update :208, sgd_mom_update,
+adam_update :354, rmsprop_update, rmspropalex_update, ftrl_update,
+signsgd_update, signum_update, mp_sgd_* mixed-precision variants).
+
+The reference mutates weight/state in place (FMutateInputs); here each op
+returns (new_weight, new_states...) and declares `writeback` so the runtime
+updates the NDArrays — under jit the XLA buffer donation makes this truly
+in-place in HBM.  The whole update fuses into one kernel per parameter.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import attr_bool, attr_float
+from .registry import register
+
+_COMMON = dict(lr=attr_float(required=True), wd=attr_float(0.0),
+               rescale_grad=attr_float(1.0), clip_gradient=attr_float(-1.0))
+
+
+def _prep_grad(attrs, grad):
+    g = grad * attrs.rescale_grad
+    if attrs.clip_gradient > 0:
+        g = jnp.clip(g, -attrs.clip_gradient, attrs.clip_gradient)
+    return g
+
+
+@register("sgd_update", inputs=("weight", "grad"),
+          params=dict(_COMMON, lazy_update=attr_bool(True)),
+          writeback={0: 0})
+def _sgd_update(attrs, weight, grad):
+    g = _prep_grad(attrs, grad)
+    return weight - attrs.lr * (g + attrs.wd * weight)
+
+
+@register("sgd_mom_update", inputs=("weight", "grad", "mom"),
+          params=dict(_COMMON, momentum=attr_float(0.0),
+                      lazy_update=attr_bool(True)),
+          num_outputs=2, num_visible_outputs=1, writeback={0: 0, 2: 1})
+def _sgd_mom_update(attrs, weight, grad, mom):
+    g = _prep_grad(attrs, grad)
+    new_mom = attrs.momentum * mom - attrs.lr * (g + attrs.wd * weight)
+    return weight + new_mom, new_mom
+
+
+@register("mp_sgd_update", inputs=("weight", "grad", "weight32"),
+          params=dict(_COMMON, lazy_update=attr_bool(True)),
+          num_outputs=2, num_visible_outputs=1, writeback={0: 0, 2: 1})
+def _mp_sgd_update(attrs, weight, grad, weight32):
+    g = _prep_grad(attrs, grad.astype(jnp.float32))
+    new_w32 = weight32 - attrs.lr * (g + attrs.wd * weight32)
+    return new_w32.astype(weight.dtype), new_w32
+
+
+@register("mp_sgd_mom_update", inputs=("weight", "grad", "mom", "weight32"),
+          params=dict(_COMMON, momentum=attr_float(0.0),
+                      lazy_update=attr_bool(True)),
+          num_outputs=3, num_visible_outputs=1,
+          writeback={0: 0, 2: 1, 3: 2})
+def _mp_sgd_mom_update(attrs, weight, grad, mom, weight32):
+    g = _prep_grad(attrs, grad.astype(jnp.float32))
+    new_mom = attrs.momentum * mom - attrs.lr * (g + attrs.wd * weight32)
+    new_w32 = weight32 + new_mom
+    return new_w32.astype(weight.dtype), new_mom, new_w32
+
+
+@register("adam_update", inputs=("weight", "grad", "mean", "var"),
+          params=dict(_COMMON, beta1=attr_float(0.9), beta2=attr_float(0.999),
+                      epsilon=attr_float(1e-8), lazy_update=attr_bool(True)),
+          num_outputs=3, num_visible_outputs=1,
+          writeback={0: 0, 2: 1, 3: 2})
+def _adam_update(attrs, weight, grad, mean, var):
+    g = _prep_grad(attrs, grad) + attrs.wd * weight
+    new_mean = attrs.beta1 * mean + (1 - attrs.beta1) * g
+    new_var = attrs.beta2 * var + (1 - attrs.beta2) * g * g
+    new_w = weight - attrs.lr * new_mean / (jnp.sqrt(new_var) + attrs.epsilon)
+    return new_w, new_mean, new_var
+
+
+@register("rmsprop_update", inputs=("weight", "grad", "n"),
+          params=dict(_COMMON, gamma1=attr_float(0.95), epsilon=attr_float(1e-8),
+                      clip_weights=attr_float(-1.0)),
+          num_outputs=2, num_visible_outputs=1, writeback={0: 0, 2: 1})
+def _rmsprop_update(attrs, weight, grad, n):
+    g = _prep_grad(attrs, grad) + attrs.wd * weight
+    new_n = (1 - attrs.gamma1) * g * g + attrs.gamma1 * n
+    new_w = weight - attrs.lr * g / jnp.sqrt(new_n + attrs.epsilon)
+    if attrs.clip_weights > 0:
+        new_w = jnp.clip(new_w, -attrs.clip_weights, attrs.clip_weights)
+    return new_w, new_n
+
+
+@register("rmspropalex_update", inputs=("weight", "grad", "n", "g", "delta"),
+          params=dict(_COMMON, gamma1=attr_float(0.95), gamma2=attr_float(0.9),
+                      epsilon=attr_float(1e-8), clip_weights=attr_float(-1.0)),
+          num_outputs=4, num_visible_outputs=1,
+          writeback={0: 0, 2: 1, 3: 2, 4: 3})
+def _rmspropalex_update(attrs, weight, grad, n, g_state, delta):
+    g = _prep_grad(attrs, grad) + attrs.wd * weight
+    new_n = (1 - attrs.gamma1) * g * g + attrs.gamma1 * n
+    new_g = (1 - attrs.gamma1) * g + attrs.gamma1 * g_state
+    new_delta = attrs.gamma2 * delta - attrs.lr * g / jnp.sqrt(
+        new_n - new_g * new_g + attrs.epsilon)
+    new_w = weight + new_delta
+    if attrs.clip_weights > 0:
+        new_w = jnp.clip(new_w, -attrs.clip_weights, attrs.clip_weights)
+    return new_w, new_n, new_g, new_delta
+
+
+@register("ftrl_update", inputs=("weight", "grad", "z", "n"),
+          params=dict(_COMMON, lamda1=attr_float(0.01), beta=attr_float(1.0)),
+          num_outputs=3, num_visible_outputs=1,
+          writeback={0: 0, 2: 1, 3: 2})
+def _ftrl_update(attrs, weight, grad, z, n):
+    g = _prep_grad(attrs, grad)
+    new_n = n + g * g
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / attrs.lr
+    new_z = z + g - sigma * weight
+    new_w = jnp.where(
+        jnp.abs(new_z) <= attrs.lamda1,
+        0.0,
+        -(new_z - jnp.sign(new_z) * attrs.lamda1) /
+        ((attrs.beta + jnp.sqrt(new_n)) / attrs.lr + attrs.wd))
+    return new_w.astype(weight.dtype), new_z, new_n
+
+
+@register("signsgd_update", inputs=("weight", "grad"),
+          params=dict(_COMMON), writeback={0: 0})
+def _signsgd_update(attrs, weight, grad):
+    g = _prep_grad(attrs, grad)
+    return weight - attrs.lr * (jnp.sign(g) + attrs.wd * weight)
+
+
+@register("signum_update", inputs=("weight", "grad", "mom"),
+          params=dict(_COMMON, momentum=attr_float(0.0),
+                      wd_lh=attr_float(0.0)),
+          num_outputs=2, num_visible_outputs=1, writeback={0: 0, 2: 1})
+def _signum_update(attrs, weight, grad, mom):
+    g = _prep_grad(attrs, grad)
+    new_mom = attrs.momentum * mom - (1 - attrs.momentum) * (
+        g + attrs.wd * weight)
+    new_w = (1 - attrs.lr * attrs.wd_lh) * weight + attrs.lr * jnp.sign(new_mom)
+    return new_w, new_mom

@@ -1,0 +1,150 @@
+"""Random sampling ops.
+
+Reference: src/operator/random/{sample_op,multisample_op,sample_multinomial_op}
+backed by the parallel counter-based RNG resource (src/common/random_generator).
+On TPU the counter-based generator IS the native model: every op consumes an
+explicit threefry key supplied by the runtime (needs_rng), making runs
+reproducible under jit and across meshes (fold_in per device).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import attr_dtype, attr_float, attr_int, attr_shape, attr_str, dtype_np, Param
+from .registry import register
+
+_SAMPLE_PARAMS = dict(shape=attr_shape(()), ctx=attr_str(None),
+                      dtype=attr_dtype("float32"))
+
+
+@register("_random_uniform", inputs=(), needs_rng=True,
+          params=dict(_SAMPLE_PARAMS, low=attr_float(0.0), high=attr_float(1.0)),
+          aliases=("uniform", "random_uniform"))
+def _uniform(attrs, key):
+    return jax.random.uniform(key, attrs.shape, dtype_np(attrs.dtype) or jnp.float32,
+                              attrs.low, attrs.high)
+
+
+@register("_random_normal", inputs=(), needs_rng=True,
+          params=dict(_SAMPLE_PARAMS, loc=attr_float(0.0), scale=attr_float(1.0)),
+          aliases=("normal", "random_normal"))
+def _normal(attrs, key):
+    dt = dtype_np(attrs.dtype) or jnp.float32
+    return attrs.loc + attrs.scale * jax.random.normal(key, attrs.shape, dt)
+
+
+@register("_random_gamma", inputs=(), needs_rng=True,
+          params=dict(_SAMPLE_PARAMS, alpha=attr_float(1.0), beta=attr_float(1.0)),
+          aliases=("random_gamma",))
+def _gamma(attrs, key):
+    dt = dtype_np(attrs.dtype) or jnp.float32
+    return attrs.beta * jax.random.gamma(key, attrs.alpha, attrs.shape, dt)
+
+
+@register("_random_exponential", inputs=(), needs_rng=True,
+          params=dict(_SAMPLE_PARAMS, lam=attr_float(1.0)),
+          aliases=("random_exponential",))
+def _exponential(attrs, key):
+    dt = dtype_np(attrs.dtype) or jnp.float32
+    return jax.random.exponential(key, attrs.shape, dt) / attrs.lam
+
+
+@register("_random_poisson", inputs=(), needs_rng=True,
+          params=dict(_SAMPLE_PARAMS, lam=attr_float(1.0)),
+          aliases=("random_poisson",))
+def _poisson(attrs, key):
+    out = jax.random.poisson(key, attrs.lam, attrs.shape)
+    return out.astype(dtype_np(attrs.dtype) or jnp.float32)
+
+
+@register("_random_negative_binomial", inputs=(), needs_rng=True,
+          params=dict(_SAMPLE_PARAMS, k=attr_int(1), p=attr_float(1.0)),
+          aliases=("random_negative_binomial",))
+def _neg_binomial(attrs, key):
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, attrs.k, attrs.shape) * (1 - attrs.p) / attrs.p
+    out = jax.random.poisson(k2, lam, attrs.shape)
+    return out.astype(dtype_np(attrs.dtype) or jnp.float32)
+
+
+@register("_random_generalized_negative_binomial", inputs=(), needs_rng=True,
+          params=dict(_SAMPLE_PARAMS, mu=attr_float(1.0), alpha=attr_float(1.0)),
+          aliases=("random_generalized_negative_binomial",))
+def _gen_neg_binomial(attrs, key):
+    k1, k2 = jax.random.split(key)
+    if attrs.alpha == 0:
+        out = jax.random.poisson(k1, attrs.mu, attrs.shape)
+    else:
+        r = 1.0 / attrs.alpha
+        lam = jax.random.gamma(k1, r, attrs.shape) * attrs.mu * attrs.alpha
+        out = jax.random.poisson(k2, lam, attrs.shape)
+    return out.astype(dtype_np(attrs.dtype) or jnp.float32)
+
+
+@register("_random_randint", inputs=(), needs_rng=True,
+          params=dict(shape=attr_shape(()), low=attr_int(0), high=attr_int(1),
+                      ctx=attr_str(None), dtype=attr_dtype("int32")),
+          aliases=("random_randint",))
+def _randint(attrs, key):
+    return jax.random.randint(key, attrs.shape, attrs.low, attrs.high,
+                              dtype_np(attrs.dtype) or jnp.int32)
+
+
+# tensor-parameterised samplers (reference multisample_op.cc): params are arrays
+@register("_sample_uniform", inputs=("low", "high"), needs_rng=True,
+          params=dict(shape=attr_shape(()), dtype=attr_dtype("float32")),
+          aliases=("sample_uniform",))
+def _sample_uniform(attrs, key, low, high):
+    shape = tuple(low.shape) + tuple(attrs.shape or ())
+    u = jax.random.uniform(key, shape, dtype_np(attrs.dtype) or jnp.float32)
+    bshape = low.shape + (1,) * (len(shape) - low.ndim)
+    return low.reshape(bshape) + u * (high - low).reshape(bshape)
+
+
+@register("_sample_normal", inputs=("mu", "sigma"), needs_rng=True,
+          params=dict(shape=attr_shape(()), dtype=attr_dtype("float32")),
+          aliases=("sample_normal",))
+def _sample_normal(attrs, key, mu, sigma):
+    shape = tuple(mu.shape) + tuple(attrs.shape or ())
+    n = jax.random.normal(key, shape, dtype_np(attrs.dtype) or jnp.float32)
+    bshape = mu.shape + (1,) * (len(shape) - mu.ndim)
+    return mu.reshape(bshape) + n * sigma.reshape(bshape)
+
+
+@register("_sample_gamma", inputs=("alpha", "beta"), needs_rng=True,
+          params=dict(shape=attr_shape(()), dtype=attr_dtype("float32")),
+          aliases=("sample_gamma",))
+def _sample_gamma(attrs, key, alpha, beta):
+    shape = tuple(alpha.shape) + tuple(attrs.shape or ())
+    bshape = alpha.shape + (1,) * (len(shape) - alpha.ndim)
+    g = jax.random.gamma(key, jnp.broadcast_to(alpha.reshape(bshape), shape))
+    return (g * beta.reshape(bshape)).astype(dtype_np(attrs.dtype) or jnp.float32)
+
+
+def _multinomial_nout(attrs):
+    return 2 if attrs and attrs.get("get_prob") else 1
+
+
+@register("_sample_multinomial", inputs=("data",), needs_rng=True,
+          params=dict(shape=attr_shape(()), get_prob=Param(bool, False),
+                      dtype=attr_dtype("int32")),
+          num_outputs=_multinomial_nout,
+          aliases=("sample_multinomial",))
+def _sample_multinomial(attrs, key, data):
+    """data: (..., K) probabilities; samples `shape` draws per distribution."""
+    n = int(jnp.prod(jnp.array(attrs.shape))) if attrs.shape else 1
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    batch = data.shape[:-1]
+    draw_shape = batch + (tuple(attrs.shape) if attrs.shape else ())
+    samples = jax.random.categorical(
+        key, logits.reshape(-1, data.shape[-1])[:, None, :],
+        axis=-1, shape=(int(jnp.prod(jnp.array(batch or (1,)))), max(n, 1)))
+    out = samples.reshape(draw_shape if draw_shape else ()).astype(
+        dtype_np(attrs.dtype) or jnp.int32)
+    if attrs.get_prob:
+        lp = jnp.take_along_axis(
+            logits.reshape(-1, data.shape[-1]),
+            samples.reshape(len(samples), -1), axis=1).reshape(draw_shape)
+        return out, lp
+    return out

@@ -1,0 +1,200 @@
+"""Operator registry — TPU-native analog of the reference's NNVM op registry
+(include/mxnet/op_attr_types.h:183-262, NNVM_REGISTER_OP sites under
+src/operator/).
+
+Design departure from the reference, deliberately:
+
+* An op is a **pure JAX function** ``fn(attrs, *arrays) -> array | tuple``.
+  There is no FCompute<cpu>/FCompute<gpu> pair and no kernel dispatch — XLA
+  compiles one program per (attrs, shapes, dtypes) and caches it.
+* ``FInferShape``/``FInferType`` do not exist per-op: shape/type inference is
+  ``jax.eval_shape`` over the same pure function (single source of truth).
+* ``FGradient`` does not exist per-op: autograd is ``jax.vjp`` over the same
+  function.  Ops that are non-differentiable in some inputs simply produce
+  zero/None cotangents, matching the reference's zero-grad behaviour.
+* ``dmlc::Parameter`` op schemas become the typed ``params`` dict
+  (base.Param), parsed identically from python values or Symbol attr strings.
+
+Stateful concerns are declared, not hidden:
+* ``needs_rng``  — op receives a fresh PRNG key as an implicit first input
+  (reference: FResourceRequest kRandom / kParallelRandom, resource.h:30-60).
+* ``mode_dependent`` — op behaviour differs train vs. predict; the runtime
+  injects attrs['_train'] (reference: OpContext::is_train).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import jax
+
+from ..base import MXNetError, Param, _Null
+
+__all__ = ["Operator", "register", "get_op", "list_ops", "alias",
+           "AttrDict", "apply_op", "jitted_apply"]
+
+
+class AttrDict(dict):
+    """Parsed op attributes with attribute access; hashable for jit keys."""
+
+    def __getattr__(self, name):
+        try:
+            return self[name]
+        except KeyError:
+            raise AttributeError(name)
+
+    def key(self):
+        return tuple(sorted((k, _hashable(v)) for k, v in self.items()))
+
+
+def _hashable(v):
+    if isinstance(v, list):
+        return tuple(_hashable(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
+    return v
+
+
+_REGISTRY: Dict[str, "Operator"] = {}
+
+
+class Operator:
+    """A registered operator."""
+
+    def __init__(self, name: str, fn: Callable,
+                 params: Optional[Dict[str, Param]] = None,
+                 inputs: Union[Sequence[str], Callable] = ("data",),
+                 num_outputs: Union[int, Callable] = 1,
+                 num_visible_outputs: Union[int, Callable, None] = None,
+                 needs_rng: bool = False,
+                 mode_dependent: bool = False,
+                 mutate_inputs: Sequence[int] = (),
+                 variadic: bool = False,
+                 writeback: Optional[Dict[int, int]] = None,
+                 doc: str = ""):
+        self.name = name
+        self.fn = fn
+        self.params = dict(params or {})
+        self._inputs = inputs
+        self._num_outputs = num_outputs
+        self._num_visible_outputs = num_visible_outputs
+        self.needs_rng = needs_rng
+        self.mode_dependent = mode_dependent
+        self.mutate_inputs = tuple(mutate_inputs)
+        self.variadic = variadic
+        # Functional encoding of the reference's in-place mutation semantics
+        # (FMutateInputs, op_attr_types.h): {input_index: output_index} — the
+        # runtime writes output j back into the NDArray passed as input i.
+        # Used by BatchNorm moving stats and the fused optimizer update ops.
+        self.writeback: Dict[int, int] = dict(writeback or {})
+        self.doc = doc
+
+    # -- schema ----------------------------------------------------------
+    def parse_attrs(self, kwargs: Dict[str, Any]) -> AttrDict:
+        """Normalise raw kwargs (python values or strings) to typed attrs."""
+        out = AttrDict()
+        for pname, spec in self.params.items():
+            if pname in kwargs:
+                out[pname] = spec(kwargs[pname])
+            elif spec.required:
+                raise MXNetError(
+                    "Required parameter %s of op %s is missing" % (pname, self.name))
+            elif spec.default is not _Null:
+                out[pname] = spec.default
+        for k in kwargs:
+            if k in self.params:
+                continue
+            if k in ("name", "dtype_out", "ctx") or k.startswith("__"):
+                continue
+            raise MXNetError("Unknown argument %r for operator %s" % (k, self.name))
+        return out
+
+    def list_inputs(self, attrs: Optional[AttrDict] = None,
+                    num_args: Optional[int] = None) -> List[str]:
+        if callable(self._inputs):
+            return list(self._inputs(attrs, num_args))
+        if self.variadic and num_args is not None:
+            return ["arg%d" % i for i in range(num_args)]
+        return list(self._inputs)
+
+    def num_outputs(self, attrs: Optional[AttrDict] = None) -> int:
+        if callable(self._num_outputs):
+            return self._num_outputs(attrs)
+        return self._num_outputs
+
+    def num_visible_outputs(self, attrs: Optional[AttrDict] = None) -> int:
+        if self._num_visible_outputs is None:
+            return self.num_outputs(attrs)
+        if callable(self._num_visible_outputs):
+            return self._num_visible_outputs(attrs)
+        return self._num_visible_outputs
+
+    def __repr__(self):
+        return "<Operator %s>" % self.name
+
+
+def register(name: str, *, params=None, inputs=("data",), num_outputs=1,
+             num_visible_outputs=None, needs_rng=False, mode_dependent=False,
+             mutate_inputs=(), variadic=False, writeback=None, aliases=()):
+    """Decorator registering ``fn(attrs, *arrays)`` as operator `name`."""
+
+    def deco(fn):
+        op = Operator(name, fn, params=params, inputs=inputs,
+                      num_outputs=num_outputs,
+                      num_visible_outputs=num_visible_outputs,
+                      needs_rng=needs_rng, mode_dependent=mode_dependent,
+                      mutate_inputs=mutate_inputs, variadic=variadic,
+                      writeback=writeback, doc=fn.__doc__ or "")
+        if name in _REGISTRY:
+            raise MXNetError("Operator %s already registered" % name)
+        _REGISTRY[name] = op
+        for a in aliases:
+            _REGISTRY[a] = op
+        return fn
+
+    return deco
+
+
+def alias(existing: str, *new_names: str):
+    op = get_op(existing)
+    for n in new_names:
+        _REGISTRY[n] = op
+
+
+def get_op(name: str) -> Operator:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise MXNetError("Operator %s is not registered" % name) from None
+
+
+def list_ops() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# Execution: one jitted closure per (op, attrs).  jax.jit then re-specialises
+# per input shapes/dtypes — the analog of the reference engine pushing a
+# pre-tuned kernel per op, except XLA fuses across the whole call.
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _jitted(op_name: str, attr_key) -> Callable:
+    op = get_op(op_name)
+    attrs = AttrDict(attr_key)
+
+    def call(*arrays):
+        return op.fn(attrs, *arrays)
+
+    return jax.jit(call)
+
+
+def jitted_apply(op: Operator, attrs: AttrDict) -> Callable:
+    """Cached jitted callable for (op, attrs)."""
+    return _jitted(op.name, attrs.key())
+
+
+def apply_op(op: Operator, attrs: AttrDict, *arrays):
+    """Un-jitted application (used inside larger traced programs where an
+    extra jit boundary would block XLA fusion)."""
+    return op.fn(attrs, *arrays)

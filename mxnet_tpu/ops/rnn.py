@@ -1,0 +1,171 @@
+"""Fused multi-layer RNN op — the TPU equivalent of the reference's cuDNN RNN
+(src/operator/cudnn_rnn-inl.h:41, native fallback rnn-inl.h:89).
+
+The reference hands the whole stacked/bidirectional RNN to cuDNN as one op
+with a single packed parameter blob.  Here the same packed-blob API lowers to
+`lax.scan` over time per layer: the scan body is one (batch, 4H)x(H,4H)
+matmul pair per step — MXU work — and XLA pipelines the scan.  Weight blob
+layout matches cuDNN canonical order so checkpoints round-trip:
+
+  for layer in layers: for direction: [Wx (G*H x in), Wh (G*H x H)]
+  then for layer: for direction: [bx (G*H), bh (G*H)]
+
+Gate order: LSTM i,f,g,o ; GRU r,z,n (cuDNN order, like the reference).
+
+data: (T, N, C) (layout TNC, reference default); state: (L*D, N, H).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import attr_bool, attr_float, attr_int, attr_str
+from .registry import register
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def rnn_param_size(num_layers, input_size, state_size, bidirectional, mode):
+    """Total packed parameter count (matches cuDNN GetRNNParamsSize)."""
+    g = _GATES[mode]
+    d = 2 if bidirectional else 1
+    size = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else state_size * d
+        size += d * g * state_size * (in_sz + state_size)  # Wx + Wh
+    size += num_layers * d * 2 * g * state_size  # biases
+    return size
+
+
+def _unpack(params, num_layers, input_size, state_size, bidirectional, mode):
+    g = _GATES[mode]
+    d = 2 if bidirectional else 1
+    h = state_size
+    ws, off = [], 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else h * d
+        layer_ws = []
+        for _ in range(d):
+            wx = params[off:off + g * h * in_sz].reshape(g * h, in_sz)
+            off += g * h * in_sz
+            wh = params[off:off + g * h * h].reshape(g * h, h)
+            off += g * h * h
+            layer_ws.append((wx, wh))
+        ws.append(layer_ws)
+    bs = []
+    for layer in range(num_layers):
+        layer_bs = []
+        for _ in range(d):
+            bx = params[off:off + g * h]; off += g * h
+            bh = params[off:off + g * h]; off += g * h
+            layer_bs.append((bx, bh))
+        bs.append(layer_bs)
+    return ws, bs
+
+
+def _cell_step(mode, h):
+    if mode == "lstm":
+        def step(carry, xw, wh, bh):
+            hx, cx = carry
+            gates = xw + hx @ wh.T + bh
+            i, f, gg, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            c = f * cx + i * jnp.tanh(gg)
+            hy = o * jnp.tanh(c)
+            return (hy, c), hy
+    elif mode == "gru":
+        def step(carry, xw, wh, bh):
+            hx, = carry
+            xr, xz, xn = jnp.split(xw, 3, axis=-1)
+            hr, hz, hn = jnp.split(hx @ wh.T + bh, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            hy = (1 - z) * n + z * hx
+            return (hy,), hy
+    else:
+        act = jnp.maximum if mode == "rnn_relu" else None
+
+        def step(carry, xw, wh, bh):
+            hx, = carry
+            pre = xw + hx @ wh.T + bh
+            hy = jnp.maximum(pre, 0) if mode == "rnn_relu" else jnp.tanh(pre)
+            return (hy,), hy
+    return step
+
+
+def _run_layer(mode, x, wx, wh, bx, bh, h0, c0, reverse):
+    """x: (T, N, in); returns (out (T,N,H), hT, cT)."""
+    # hoist the input projection out of the scan: one big (T*N, in)x(in, GH)
+    xw = jnp.einsum("tni,gi->tng", x, wx) + bx
+    step_fn = _cell_step(mode, h0.shape[-1])
+
+    def body(carry, xw_t):
+        carry, out = step_fn(carry, xw_t, wh, bh)
+        return carry, out
+
+    carry0 = (h0, c0) if mode == "lstm" else (h0,)
+    carry, outs = jax.lax.scan(body, carry0, xw, reverse=reverse)
+    hT = carry[0]
+    cT = carry[1] if mode == "lstm" else None
+    return outs, hT, cT
+
+
+def _rnn_inputs(attrs, num_args=None):
+    if attrs is not None and attrs.get("mode") == "lstm":
+        return ["data", "parameters", "state", "state_cell"]
+    return ["data", "parameters", "state"]
+
+
+def _rnn_nout(attrs):
+    if attrs is None:
+        return 1
+    if not attrs.get("state_outputs", False):
+        return 1
+    return 3 if attrs.get("mode") == "lstm" else 2
+
+
+@register("RNN", inputs=_rnn_inputs,
+          params=dict(state_size=attr_int(required=True),
+                      num_layers=attr_int(required=True),
+                      bidirectional=attr_bool(False),
+                      mode=attr_str(required=True),
+                      p=attr_float(0.0), state_outputs=attr_bool(False),
+                      lstm_state_clip_min=attr_float(None),
+                      lstm_state_clip_max=attr_float(None)),
+          num_outputs=_rnn_nout, needs_rng=True, mode_dependent=True)
+def _rnn(attrs, key, data, parameters, state, state_cell=None):
+    mode = attrs.mode
+    L, d = attrs.num_layers, (2 if attrs.bidirectional else 1)
+    h = attrs.state_size
+    T, N, C = data.shape
+    ws, bs = _unpack(parameters, L, C, h, attrs.bidirectional, mode)
+    x = data
+    hTs, cTs = [], []
+    train = attrs.get("_train", False)
+    for layer in range(L):
+        outs_dir = []
+        for di in range(d):
+            wx, wh = ws[layer][di]
+            bx, bh = bs[layer][di]
+            sidx = layer * d + di
+            h0 = state[sidx]
+            c0 = state_cell[sidx] if mode == "lstm" else None
+            out, hT, cT = _run_layer(mode, x, wx, wh, bx, bh, h0, c0,
+                                     reverse=(di == 1))
+            outs_dir.append(out)
+            hTs.append(hT)
+            if mode == "lstm":
+                cTs.append(cT)
+        x = outs_dir[0] if d == 1 else jnp.concatenate(outs_dir, axis=-1)
+        if train and attrs.p > 0 and layer < L - 1:
+            key, sub = jax.random.split(key)
+            keep = 1.0 - attrs.p
+            mask = jax.random.bernoulli(sub, keep, x.shape).astype(x.dtype) / keep
+            x = x * mask
+    if not attrs.state_outputs:
+        return x
+    hN = jnp.stack(hTs)
+    if mode == "lstm":
+        return x, hN, jnp.stack(cTs)
+    return x, hN

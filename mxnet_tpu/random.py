@@ -1,0 +1,6 @@
+"""mx.random namespace (reference python/mxnet/random.py): global seed plus
+sampling helpers forwarding to ndarray.random."""
+from .rng import seed  # noqa: F401
+from .ndarray.random import (uniform, normal, gamma, exponential, poisson,  # noqa: F401
+                             negative_binomial, generalized_negative_binomial,
+                             randint, multinomial, shuffle)

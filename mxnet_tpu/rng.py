@@ -1,0 +1,36 @@
+"""Global RNG state.
+
+The reference seeds per-device mshadow RNG resources (mx.random.seed →
+ResourceManager kRandom).  TPU-natively randomness is functional: a root
+threefry key advanced by a counter; every random op consumes one split.
+Deterministic given seed + op order, and safe under jit because the key is an
+explicit op input, never hidden state.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+_state = threading.local()
+
+
+def _get():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.PRNGKey(0)
+        _state.counter = 0
+    return _state
+
+
+def seed(seed_state: int):
+    """mx.random.seed equivalent."""
+    s = _get()
+    s.key = jax.random.PRNGKey(int(seed_state))
+    s.counter = 0
+
+
+def next_key():
+    """A fresh PRNG key; advances global state."""
+    s = _get()
+    s.counter += 1
+    return jax.random.fold_in(s.key, s.counter)
