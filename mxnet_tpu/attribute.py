@@ -1,0 +1,2 @@
+"""Attribute scoping (reference python/mxnet/attribute.py) — re-export."""
+from .base import AttrScope  # noqa: F401

@@ -1,1 +1,445 @@
-"""placeholder — implemented later this round"""
+"""Executor — binds a Symbol to devices and runs it.
+
+Reference: src/executor/graph_executor.cc (GraphExecutor::Init :512/:951,
+Forward :81, Backward :94, RunOps :1469) + python/mxnet/executor.py.
+
+TPU-native design: where the reference turns each graph node into one engine
+op (InitCachedOps, graph_executor.cc:1221) and bulk-fuses segments
+(InitOpSegs :1340), here the ENTIRE graph lowers to one pure JAX function —
+forward is one jitted XLA computation, forward+backward another.  The nnvm
+passes map as: Gradient → jax.vjp; InferShape → jax.eval_shape + param
+hints; PlanMemory/DetectInplaceAddTo → XLA buffer assignment + donation;
+PlaceDevice/ctx_group → sharding annotations (see parallel/).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import MXNetError, dtype_np, dtype_name
+from .context import Context, cpu
+from .ndarray.ndarray import NDArray, zeros as nd_zeros, array as nd_array
+from .ops import shape_hints  # installs infer_params hooks  # noqa: F401
+from .symbol.symbol import Node, NodeEntry, Symbol, _topo_order
+from . import rng as _rng
+
+__all__ = ["Executor", "GraphProgram", "infer_shapes", "infer_types"]
+
+
+class GraphProgram:
+    """A Symbol compiled into a pure function.
+
+    fn(arg_arrays, aux_arrays, keys, train) evaluates the whole DAG.
+    Shared by Executor, CachedOp (gluon) and Module's fused train step.
+    """
+
+    def __init__(self, symbol: Symbol):
+        self.symbol = symbol
+        self.nodes = _topo_order(symbol._entries)
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        aux_ids = symbol._aux_var_ids()
+        self.var_kind: Dict[int, str] = {}
+        for n in self.nodes:
+            if n.is_var:
+                self.var_kind[id(n)] = "aux" if id(n) in aux_ids else "arg"
+        # rng nodes, in topo order
+        self.rng_nodes = [n for n in self.nodes
+                          if not n.is_var and n.op.needs_rng]
+        self.num_rng = len(self.rng_nodes)
+        # aux writeback plan: list of (aux_name, node, out_idx)
+        self.aux_updates = []
+        for n in self.nodes:
+            if n.is_var or not n.op.writeback:
+                continue
+            for i_in, i_out in n.op.writeback.items():
+                if i_in < len(n.inputs):
+                    src = n.inputs[i_in].node
+                    if src.is_var and id(src) in aux_ids:
+                        self.aux_updates.append((src.name, n, i_out))
+
+    def evaluate(self, arg_arrays: Sequence, aux_arrays: Sequence,
+                 keys, train: bool):
+        """Pure evaluation. Returns (outputs, new_aux)."""
+        arg_map = dict(zip(self.arg_names, arg_arrays))
+        aux_map = dict(zip(self.aux_names, aux_arrays))
+        key_idx = 0
+        raw: Dict[int, tuple] = {}
+        for node in self.nodes:
+            if node.is_var:
+                kind = self.var_kind[id(node)]
+                val = arg_map[node.name] if kind == "arg" else aux_map[node.name]
+                raw[id(node)] = (val,)
+                continue
+            attrs = node.parsed_attrs()
+            if node.op.mode_dependent:
+                attrs = type(attrs)(attrs)
+                attrs["_train"] = train
+            ins = [raw[id(e.node)][e.index] for e in node.inputs]
+            if node.op.needs_rng:
+                ins = [keys[key_idx]] + ins
+                key_idx += 1
+            out = node.op.fn(attrs, *ins)
+            raw[id(node)] = out if isinstance(out, tuple) else (out,)
+        outputs = [raw[id(e.node)][e.index] for e in self.symbol._entries]
+        new_aux = list(aux_arrays)
+        aux_pos = {n: i for i, n in enumerate(self.aux_names)}
+        for aux_name, node, i_out in self.aux_updates:
+            new_aux[aux_pos[aux_name]] = raw[id(node)][i_out]
+        return tuple(outputs), tuple(new_aux)
+
+    # jitted entry points -------------------------------------------------
+    @functools.lru_cache(maxsize=None)
+    def _jit_forward(self, train: bool):
+        def f(args, aux, keys):
+            return self.evaluate(args, aux, keys, train)
+        return jax.jit(f)
+
+    @functools.lru_cache(maxsize=None)
+    def _jit_fwd_bwd(self, train: bool, grad_mask: tuple):
+        """One XLA computation: outputs + grads of selected args + new aux."""
+        def f(args, aux, keys, out_cots):
+            diff_args = [a for a, m in zip(args, grad_mask) if m]
+
+            def split_fn(diff):
+                it = iter(diff)
+                full = [next(it) if m else a for a, m in zip(args, grad_mask)]
+                outs, new_aux = self.evaluate(full, aux, keys, train)
+                return outs, new_aux
+
+            (outs, new_aux), vjp = jax.vjp(split_fn, diff_args)
+            zero_aux = tuple(jnp.zeros_like(a) for a in new_aux)
+            (grads,) = vjp((tuple(out_cots), zero_aux))
+            return outs, new_aux, grads
+        return jax.jit(f)
+
+
+def _struct(shape, dtype="float32"):
+    return jax.ShapeDtypeStruct(tuple(shape), dtype_np(dtype))
+
+
+def _resolve_structs(symbol: Symbol, kwargs: Dict[str, Any],
+                     type_dict=None, partial=False):
+    """Bidirectional-ish shape inference: walk the graph forward, filling
+    unknown parameter shapes via infer_params hooks (shape_hints.py), then
+    output shapes via jax.eval_shape per node."""
+    prog = GraphProgram(symbol)
+    type_dict = type_dict or {}
+    known: Dict[str, jax.ShapeDtypeStruct] = {}
+    for k, v in (kwargs or {}).items():
+        if v is None:
+            continue
+        if isinstance(v, jax.ShapeDtypeStruct):
+            known[k] = v
+        elif isinstance(v, (tuple, list)):
+            known[k] = _struct(v, type_dict.get(k, "float32"))
+        elif isinstance(v, NDArray):
+            known[k] = _struct(v.shape, v.dtype)
+    shapes: Dict[int, tuple] = {}  # node id -> tuple of output structs
+    for node in prog.nodes:
+        if node.is_var:
+            if node.name in known:
+                shapes[id(node)] = (known[node.name],)
+            elif "__shape__" in node.attrs:
+                import ast
+                shp = ast.literal_eval(str(node.attrs["__shape__"]))
+                dt = type_dict.get(node.name,
+                                   node.attrs.get("__dtype__", "float32"))
+                s = _struct(shp, dt)
+                known[node.name] = s
+                shapes[id(node)] = (s,)
+            else:
+                shapes[id(node)] = (None,)
+            continue
+        attrs = node.parsed_attrs()
+        in_structs = [shapes[id(e.node)][e.index] for e in node.inputs]
+        hook = getattr(node.op, "infer_params", None)
+        if hook is not None and any(s is None for s in in_structs):
+            in_shapes = [tuple(s.shape) if s is not None else None
+                         for s in in_structs]
+            try:
+                hints = hook(attrs, in_shapes)
+            except Exception:
+                hints = {}
+            for idx, shp in hints.items():
+                if idx < len(in_structs) and in_structs[idx] is None:
+                    var_node = node.inputs[idx].node
+                    dt = type_dict.get(var_node.name, None)
+                    if dt is None:
+                        dt = in_structs[0].dtype if in_structs[0] is not None \
+                            else "float32"
+                    s = _struct(shp, dt)
+                    in_structs[idx] = s
+                    if var_node.is_var:
+                        known[var_node.name] = s
+                        shapes[id(var_node)] = (s,)
+        if any(s is None for s in in_structs):
+            if partial:
+                shapes[id(node)] = (None,) * node.num_outputs()
+                continue
+            missing = [node.inputs[i].node.name
+                       for i, s in enumerate(in_structs) if s is None]
+            raise MXNetError(
+                "infer_shape: cannot determine shape of %s (inputs of node "
+                "%s); provide it explicitly" % (missing, node.name))
+        a2 = attrs
+        if node.op.mode_dependent:
+            a2 = type(attrs)(attrs)
+            a2["_train"] = False
+        ins = list(in_structs)
+        if node.op.needs_rng:
+            ins = [jax.ShapeDtypeStruct((2,), np.uint32)] + ins
+        out = jax.eval_shape(functools.partial(node.op.fn, a2), *ins)
+        shapes[id(node)] = tuple(out) if isinstance(out, (tuple, list)) \
+            else (out,)
+    return prog, known, shapes
+
+
+def infer_shapes(symbol: Symbol, kwargs, partial=False):
+    prog, known, shapes = _resolve_structs(symbol, kwargs, partial=partial)
+    arg_shapes = [tuple(known[n].shape) if n in known else None
+                  for n in prog.arg_names]
+    out_shapes = []
+    for e in symbol._entries:
+        s = shapes[id(e.node)][e.index]
+        out_shapes.append(tuple(s.shape) if s is not None else None)
+    aux_shapes = [tuple(known[n].shape) if n in known else None
+                  for n in prog.aux_names]
+    return arg_shapes, out_shapes, aux_shapes
+
+
+def infer_types(symbol: Symbol, kwargs):
+    """Type inference given arg dtypes (reference Symbol.infer_type).
+    Shapes unknown → use dummy 1-sized dims where needed is impossible, so
+    we return declared/default types (types flow trivially in this stack:
+    params adopt the data dtype)."""
+    prog = GraphProgram(symbol)
+    type_dict = {k: dtype_name(v) for k, v in (kwargs or {}).items()}
+    data_dt = next(iter(type_dict.values()), "float32")
+    arg_types = [np.dtype(type_dict.get(n, data_dt)) for n in prog.arg_names]
+    out_types = [np.dtype(data_dt)] * len(symbol._entries)
+    aux_types = [np.dtype("float32")] * len(prog.aux_names)
+    return arg_types, out_types, aux_types
+
+
+class Executor:
+    """Bound computation (reference python/mxnet/executor.py).
+
+    forward() → one jitted XLA call; backward()/run_fwd_bwd() → one jitted
+    XLA call computing outputs + gradients together.
+    """
+
+    def __init__(self, symbol: Symbol, ctx: Context,
+                 args, args_grad=None, grad_req="write", aux_states=None,
+                 shared_exec: Optional["Executor"] = None, program=None):
+        self._symbol = symbol
+        self._ctx = ctx if isinstance(ctx, Context) else cpu()
+        if program is not None:
+            self._prog = program
+        elif shared_exec is not None and shared_exec._symbol is symbol:
+            self._prog = shared_exec._prog
+        else:
+            self._prog = GraphProgram(symbol)
+        arg_names = self._prog.arg_names
+
+        if isinstance(args, dict):
+            self.arg_arrays = [args[n] for n in arg_names]
+        else:
+            self.arg_arrays = list(args)
+        self.arg_dict = dict(zip(arg_names, self.arg_arrays))
+
+        aux_names = self._prog.aux_names
+        if aux_states is None:
+            aux_states = []
+        if isinstance(aux_states, dict):
+            self.aux_arrays = [aux_states[n] for n in aux_names]
+        else:
+            self.aux_arrays = list(aux_states)
+        self.aux_dict = dict(zip(aux_names, self.aux_arrays))
+
+        if isinstance(grad_req, str):
+            self.grad_req = {n: grad_req for n in arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            self.grad_req = dict(zip(arg_names, grad_req))
+        else:
+            self.grad_req = {n: grad_req.get(n, "null") for n in arg_names}
+
+        if args_grad is None:
+            self.grad_arrays = [None] * len(arg_names)
+        elif isinstance(args_grad, dict):
+            self.grad_arrays = [args_grad.get(n) for n in arg_names]
+        else:
+            self.grad_arrays = list(args_grad)
+            if len(self.grad_arrays) < len(arg_names):
+                self.grad_arrays += [None] * (len(arg_names) -
+                                              len(self.grad_arrays))
+        self.grad_dict = {n: g for n, g in zip(arg_names, self.grad_arrays)}
+
+        self.outputs: List[NDArray] = []
+        self._monitor_callback = None
+
+    # -- binding helpers -------------------------------------------------
+    @staticmethod
+    def simple_bind(symbol: Symbol, ctx, grad_req="write", type_dict=None,
+                    shared_exec=None, **kwargs):
+        prog, known, shapes = _resolve_structs(symbol, kwargs, type_dict)
+        missing = [n for n in prog.arg_names if n not in known]
+        if missing:
+            raise MXNetError("simple_bind: could not infer shapes for %s"
+                             % missing)
+        args = {n: nd_zeros(tuple(known[n].shape),
+                            dtype=np.dtype(known[n].dtype), ctx=ctx)
+                for n in prog.arg_names}
+        aux = {n: nd_zeros(tuple(known[n].shape),
+                           dtype=np.dtype(known[n].dtype), ctx=ctx)
+               for n in prog.aux_names}
+        greq = grad_req if isinstance(grad_req, dict) else \
+            {n: grad_req for n in prog.arg_names}
+        grads = {n: nd_zeros(tuple(known[n].shape),
+                             dtype=np.dtype(known[n].dtype), ctx=ctx)
+                 for n in prog.arg_names if greq.get(n, "null") != "null"}
+        return Executor(symbol, ctx, args, args_grad=grads, grad_req=greq,
+                        aux_states=aux, program=prog)
+
+    # -- execution -------------------------------------------------------
+    def _keys(self):
+        if self._prog.num_rng == 0:
+            return jnp.zeros((0, 2), dtype=jnp.uint32)
+        return jnp.stack([_rng.next_key() for _ in range(self._prog.num_rng)])
+
+    def forward(self, is_train=False, **kwargs):
+        for k, v in kwargs.items():
+            if k in self.arg_dict:
+                tgt = self.arg_dict[k]
+                tgt._handle = v._handle if isinstance(v, NDArray) \
+                    else jnp.asarray(v)
+        fn = self._prog._jit_forward(bool(is_train))
+        args = tuple(a._handle for a in self.arg_arrays)
+        aux = tuple(a._handle for a in self.aux_arrays)
+        outs, new_aux = fn(args, aux, self._keys())
+        if is_train:
+            for nd_, na in zip(self.aux_arrays, new_aux):
+                nd_._handle = na
+        self.outputs = [NDArray(o) for o in outs]
+        if self._monitor_callback is not None:
+            names = self._symbol.list_outputs()
+            for n, o in zip(names, self.outputs):
+                self._monitor_callback(n, o)
+        return self.outputs
+
+    def _write_grads(self, grads, mask):
+        gi = iter(grads)
+        for name, m in zip(self._prog.arg_names, mask):
+            if not m:
+                continue
+            g = next(gi)
+            tgt = self.grad_dict.get(name)
+            if tgt is None:
+                continue
+            if self.grad_req[name] == "add":
+                tgt._handle = tgt._handle + g.astype(tgt._handle.dtype)
+            else:
+                tgt._handle = g.astype(tgt._handle.dtype)
+
+    def backward(self, out_grads=None, is_train=True):
+        mask = tuple(self.grad_req.get(n, "null") != "null"
+                     for n in self._prog.arg_names)
+        if not any(mask):
+            return
+        fn = self._prog._jit_fwd_bwd(bool(is_train), mask)
+        args = tuple(a._handle for a in self.arg_arrays)
+        aux = tuple(a._handle for a in self.aux_arrays)
+        if out_grads is None:
+            if self.outputs:
+                cots = tuple(jnp.ones_like(o._handle) for o in self.outputs)
+            else:
+                structs = jax.eval_shape(self._prog._jit_forward(bool(is_train)),
+                                         args, aux, self._keys())[0]
+                cots = tuple(jnp.ones(s.shape, s.dtype) for s in structs)
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            cots = tuple(g._handle if isinstance(g, NDArray) else jnp.asarray(g)
+                         for g in out_grads)
+        _, _, grads = fn(args, aux, self._keys(), cots)
+        self._write_grads(grads, mask)
+
+    def run_fwd_bwd(self, out_cots=None, is_train=True):
+        """Fused forward+backward: ONE XLA computation (the perf path used
+        by Module).  Returns outputs; grads written per grad_req; aux
+        updated."""
+        mask = tuple(self.grad_req.get(n, "null") != "null"
+                     for n in self._prog.arg_names)
+        args = tuple(a._handle for a in self.arg_arrays)
+        aux = tuple(a._handle for a in self.aux_arrays)
+        keys = self._keys()
+        if not any(mask):
+            outs, new_aux = self._prog._jit_forward(bool(is_train))(
+                args, aux, keys)
+            grads = ()
+        else:
+            fn = self._prog._jit_fwd_bwd(bool(is_train), mask)
+            if out_cots is None:
+                structs = jax.eval_shape(self._prog._jit_forward(bool(is_train)),
+                                         args, aux, keys)[0]
+                cots = tuple(jnp.ones(s.shape, s.dtype) for s in structs)
+            else:
+                cots = tuple(c._handle if isinstance(c, NDArray) else c
+                             for c in out_cots)
+            outs, new_aux, grads = fn(args, aux, keys, cots)
+        if is_train:
+            for nd_, na in zip(self.aux_arrays, new_aux):
+                nd_._handle = na
+        self.outputs = [NDArray(o) for o in outs]
+        if grads:
+            self._write_grads(grads, mask)
+        return self.outputs
+
+    # -- misc API parity -------------------------------------------------
+    @property
+    def output_dict(self):
+        return dict(zip(self._symbol.list_outputs(), self.outputs))
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for name, arr in arg_params.items():
+            if name in self.arg_dict:
+                self.arg_dict[name]._handle = arr._handle.astype(
+                    self.arg_dict[name]._handle.dtype)
+            elif not allow_extra_params:
+                raise MXNetError("Found name \"%s\" not in arguments" % name)
+        if aux_params:
+            for name, arr in aux_params.items():
+                if name in self.aux_dict:
+                    self.aux_dict[name]._handle = arr._handle.astype(
+                        self.aux_dict[name]._handle.dtype)
+                elif not allow_extra_params:
+                    raise MXNetError("Found name \"%s\" not in aux" % name)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Re-bind for new input shapes; XLA re-specialises automatically
+        (the reference's careful memory-sharing rebind is unnecessary —
+        buffers are XLA-managed)."""
+        new_args = {}
+        for n, arr in self.arg_dict.items():
+            if n in kwargs:
+                new_args[n] = nd_zeros(kwargs[n], dtype=arr.dtype,
+                                       ctx=self._ctx)
+            else:
+                new_args[n] = arr
+        grads = {n: nd_zeros(new_args[n].shape, dtype=new_args[n].dtype,
+                             ctx=self._ctx)
+                 for n, g in self.grad_dict.items() if g is not None}
+        return Executor(self._symbol, self._ctx, new_args, args_grad=grads,
+                        grad_req=self.grad_req, aux_states=self.aux_dict,
+                        program=self._prog)
+
+    def set_monitor_callback(self, callback):
+        self._monitor_callback = callback
+
+    def debug_str(self):
+        return self._symbol.debug_str()

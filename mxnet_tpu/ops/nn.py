@@ -267,7 +267,7 @@ def _softmax_activation(attrs, x):
                       output_mean_var=attr_bool(False), axis=attr_int(1),
                       cudnn_off=attr_bool(False)),
           num_outputs=5, num_visible_outputs=1,
-          writeback={3: 3, 4: 4}, mode_dependent=True,
+          writeback={3: 3, 4: 4}, aux_inputs=(3, 4), mode_dependent=True,
           aliases=("BatchNorm_v1",))
 def _batch_norm(attrs, x, gamma, beta, mov_mean, mov_var):
     ax = attrs.axis % x.ndim

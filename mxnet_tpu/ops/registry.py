@@ -71,6 +71,7 @@ class Operator:
                  mutate_inputs: Sequence[int] = (),
                  variadic: bool = False,
                  writeback: Optional[Dict[int, int]] = None,
+                 aux_inputs: Sequence[int] = (),
                  doc: str = ""):
         self.name = name
         self.fn = fn
@@ -87,6 +88,10 @@ class Operator:
         # runtime writes output j back into the NDArray passed as input i.
         # Used by BatchNorm moving stats and the fused optimizer update ops.
         self.writeback: Dict[int, int] = dict(writeback or {})
+        # Input positions that are auxiliary states (reference
+        # ListAuxiliaryStates): not arguments, not differentiated, updated
+        # via writeback.  E.g. BatchNorm's moving_mean/moving_var.
+        self.aux_inputs = tuple(aux_inputs)
         self.doc = doc
 
     # -- schema ----------------------------------------------------------
@@ -135,7 +140,8 @@ class Operator:
 
 def register(name: str, *, params=None, inputs=("data",), num_outputs=1,
              num_visible_outputs=None, needs_rng=False, mode_dependent=False,
-             mutate_inputs=(), variadic=False, writeback=None, aliases=()):
+             mutate_inputs=(), variadic=False, writeback=None, aux_inputs=(),
+             aliases=()):
     """Decorator registering ``fn(attrs, *arrays)`` as operator `name`."""
 
     def deco(fn):
@@ -144,7 +150,8 @@ def register(name: str, *, params=None, inputs=("data",), num_outputs=1,
                       num_visible_outputs=num_visible_outputs,
                       needs_rng=needs_rng, mode_dependent=mode_dependent,
                       mutate_inputs=mutate_inputs, variadic=variadic,
-                      writeback=writeback, doc=fn.__doc__ or "")
+                      writeback=writeback, aux_inputs=aux_inputs,
+                      doc=fn.__doc__ or "")
         if name in _REGISTRY:
             raise MXNetError("Operator %s already registered" % name)
         _REGISTRY[name] = op

@@ -1,0 +1,102 @@
+"""Parameter-shape inference hints.
+
+The reference's InferShape pass is bidirectional: given only the data shape,
+it derives weight/bias/aux shapes (src/executor/infer_graph_attr_pass.cc over
+per-op FInferShape).  Output shapes here come for free from jax.eval_shape;
+this module supplies ONLY the missing direction — for ops with learnable
+inputs, a hook computing the parameter shapes from the known input shapes
+and attrs.  Everything else needs no hook at all.
+
+Hook signature: fn(attrs, in_shapes: list[tuple|None]) -> {input_idx: shape}.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import get_op
+from .rnn import rnn_param_size
+
+
+def _fc(attrs, shapes):
+    data = shapes[0]
+    if attrs.get("flatten", True):
+        in_dim = int(np.prod(data[1:]))
+    else:
+        in_dim = data[-1]
+    out = {1: (attrs["num_hidden"], in_dim)}
+    if not attrs.get("no_bias", False):
+        out[2] = (attrs["num_hidden"],)
+    return out
+
+
+def _conv(attrs, shapes):
+    data = shapes[0]
+    nd = len(attrs["kernel"])
+    g = attrs.get("num_group", 1)
+    out = {1: (attrs["num_filter"], data[1] // g) + tuple(attrs["kernel"])}
+    if not attrs.get("no_bias", False):
+        out[2] = (attrs["num_filter"],)
+    return out
+
+
+def _deconv(attrs, shapes):
+    data = shapes[0]
+    g = attrs.get("num_group", 1)
+    out = {1: (data[1], attrs["num_filter"] // g) + tuple(attrs["kernel"])}
+    if not attrs.get("no_bias", False):
+        out[2] = (attrs["num_filter"],)
+    return out
+
+
+def _bn(attrs, shapes):
+    c = shapes[0][attrs.get("axis", 1)]
+    return {1: (c,), 2: (c,), 3: (c,), 4: (c,)}
+
+
+def _in_norm(attrs, shapes):
+    c = shapes[0][1]
+    return {1: (c,), 2: (c,)}
+
+
+def _layer_norm(attrs, shapes):
+    c = shapes[0][attrs.get("axis", -1)]
+    return {1: (c,), 2: (c,)}
+
+
+def _embedding(attrs, shapes):
+    return {1: (attrs["input_dim"], attrs["output_dim"])}
+
+
+def _rnn(attrs, shapes):
+    data = shapes[0]
+    L = attrs["num_layers"]
+    d = 2 if attrs.get("bidirectional", False) else 1
+    h = attrs["state_size"]
+    n = rnn_param_size(L, data[2], h, attrs.get("bidirectional", False),
+                       attrs["mode"])
+    out = {1: (n,), 2: (L * d, data[1], h)}
+    if attrs["mode"] == "lstm":
+        out[3] = (L * d, data[1], h)
+    return out
+
+
+def _prelu(attrs, shapes):
+    if attrs.get("act_type") == "prelu":
+        data = shapes[0]
+        return {1: (data[1] if len(data) > 1 else 1,)}
+    return {}
+
+
+def install():
+    get_op("FullyConnected").infer_params = _fc
+    get_op("Convolution").infer_params = _conv
+    get_op("Deconvolution").infer_params = _deconv
+    get_op("BatchNorm").infer_params = _bn
+    get_op("InstanceNorm").infer_params = _in_norm
+    get_op("LayerNorm").infer_params = _layer_norm
+    get_op("Embedding").infer_params = _embedding
+    get_op("RNN").infer_params = _rnn
+    get_op("LeakyReLU").infer_params = _prelu
+
+
+install()

@@ -1,0 +1,31 @@
+"""mx.sym namespace: Symbol + every registered op as a graph constructor."""
+import sys as _sys
+
+from .symbol import (Group, Symbol, Variable, create, load, load_json, var,
+                     zeros, ones, arange)
+from ..ops.registry import get_op as _get_op, list_ops as _list_ops
+from ..base import MXNetError as _MXNetError
+
+
+def _make_sym_wrapper(op_name):
+    op = _get_op(op_name)
+
+    def wrapper(*args, **kwargs):
+        input_syms = [a for a in args if isinstance(a, Symbol)]
+        extra = [a for a in args if not isinstance(a, Symbol)]
+        if extra:
+            raise _MXNetError(
+                "sym.%s: positional args must be Symbols, got %r"
+                % (op_name, extra))
+        return create(op_name, input_syms, kwargs)
+
+    wrapper.__name__ = op_name
+    wrapper.__doc__ = op.doc
+    return wrapper
+
+
+for _name in _list_ops():
+    setattr(_sys.modules[__name__], _name, _make_sym_wrapper(_name))
+
+from . import random  # noqa: E402,F401
+from . import linalg  # noqa: E402,F401
