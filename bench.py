@@ -1,0 +1,84 @@
+"""Benchmark of record: ResNet-50 training throughput, images/sec/chip.
+
+Baseline (BASELINE.md): reference MXNet ResNet-50 train bs32 on K80 =
+45.52 img/s (docs/faq/perf.md:146-180).  This benchmark runs the same
+workload TPU-natively: one fused XLA train step (fwd+bwd+SGD update,
+donated buffers) via parallel.ShardedTrainer, data resident in HBM,
+bfloat16 activations/params with fp32 BN statistics (the TPU-native
+precision recipe; set BENCH_DTYPE=float32 for strict fp32).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BASELINE_IMG_S = 45.52  # reference K80 bs32 (docs/faq/perf.md)
+
+
+def main():
+    batch = int(os.environ.get("BENCH_BATCH", "32"))
+    dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
+    warmup = int(os.environ.get("BENCH_WARMUP", "3"))
+    iters = int(os.environ.get("BENCH_ITERS", "20"))
+
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu  # noqa: F401  (enables x64 config, registers ops)
+    from mxnet_tpu.models.resnet import get_symbol
+    from mxnet_tpu.parallel.mesh import MeshSpec, make_mesh
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+    devices = jax.devices()
+    n_dev = len([d for d in devices if d.platform != "cpu"]) or 1
+    sym = get_symbol(num_classes=1000, num_layers=50,
+                     image_shape="3,224,224", dtype=dtype)
+    spec = MeshSpec(make_mesh((n_dev,), ("dp",)))
+    trainer = ShardedTrainer(sym, spec, lr=0.1, momentum=0.9, wd=1e-4,
+                             param_dtype=dtype if dtype != "float32" else None)
+
+    global_batch = batch * n_dev
+    shapes = {"data": (global_batch, 3, 224, 224),
+              "softmax_label": (global_batch,)}
+    params, mom, aux = trainer.init_state(shapes)
+
+    # data generated on device — the tunnel must not be in the loop
+    key = jax.random.PRNGKey(0)
+    data = jax.device_put(
+        jax.random.uniform(key, (global_batch, 3, 224, 224), jnp.float32),
+        spec.batch_sharding())
+    label = jax.device_put(
+        jax.random.randint(key, (global_batch,), 0, 1000).astype(jnp.float32),
+        spec.batch_sharding())
+    batch_dict = {"data": data, "softmax_label": label}
+
+    from mxnet_tpu.parallel.trainer import sgd_step_fn
+    step = sgd_step_fn(trainer)
+    keys = trainer._keys()
+
+    for _ in range(warmup):
+        params, mom, aux, loss = step(params, mom, aux, batch_dict, keys)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, mom, aux, loss = step(params, mom, aux, batch_dict, keys)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    img_s = global_batch * iters / dt
+    img_s_chip = img_s / n_dev
+    print(json.dumps({
+        "metric": "resnet50_train_img_per_sec_per_chip",
+        "value": round(img_s_chip, 2),
+        "unit": "images/sec/chip (bs%d, %s, %d chip%s)" % (
+            batch, dtype, n_dev, "s" if n_dev > 1 else ""),
+        "vs_baseline": round(img_s_chip / BASELINE_IMG_S, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

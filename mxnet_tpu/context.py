@@ -50,7 +50,8 @@ class Context:
         """Resolve to a concrete jax device."""
         if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
             try:
-                return jax.devices("cpu")[0]
+                cpus = jax.devices("cpu")
+                return cpus[self.device_id % len(cpus)]
             except RuntimeError:
                 # cpu platform absent under some runtimes: fall back to default
                 return jax.devices()[0]

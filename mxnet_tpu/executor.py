@@ -147,11 +147,14 @@ def _resolve_structs(symbol: Symbol, kwargs: Dict[str, Any],
             elif "__shape__" in node.attrs:
                 import ast
                 shp = ast.literal_eval(str(node.attrs["__shape__"]))
-                dt = type_dict.get(node.name,
-                                   node.attrs.get("__dtype__", "float32"))
-                s = _struct(shp, dt)
-                known[node.name] = s
-                shapes[id(node)] = (s,)
+                if shp is None or any((d is None or d <= 0) for d in shp):
+                    shapes[id(node)] = (None,)  # partially-known: infer
+                else:
+                    dt = type_dict.get(node.name,
+                                       node.attrs.get("__dtype__", "float32"))
+                    s = _struct(shp, dt)
+                    known[node.name] = s
+                    shapes[id(node)] = (s,)
             else:
                 shapes[id(node)] = (None,)
             continue
@@ -311,12 +314,16 @@ class Executor:
             return jnp.zeros((0, 2), dtype=jnp.uint32)
         return jnp.stack([_rng.next_key() for _ in range(self._prog.num_rng)])
 
+    def _commit(self, h):
+        """Place an incoming array on this executor's device."""
+        return jax.device_put(h, self._ctx.jax_device)
+
     def forward(self, is_train=False, **kwargs):
         for k, v in kwargs.items():
             if k in self.arg_dict:
                 tgt = self.arg_dict[k]
-                tgt._handle = v._handle if isinstance(v, NDArray) \
-                    else jnp.asarray(v)
+                tgt._handle = self._commit(
+                    v._handle if isinstance(v, NDArray) else jnp.asarray(v))
         fn = self._prog._jit_forward(bool(is_train))
         args = tuple(a._handle for a in self.arg_arrays)
         aux = tuple(a._handle for a in self.aux_arrays)
@@ -408,15 +415,15 @@ class Executor:
                          allow_extra_params=False):
         for name, arr in arg_params.items():
             if name in self.arg_dict:
-                self.arg_dict[name]._handle = arr._handle.astype(
-                    self.arg_dict[name]._handle.dtype)
+                self.arg_dict[name]._handle = self._commit(
+                    arr._handle.astype(self.arg_dict[name]._handle.dtype))
             elif not allow_extra_params:
                 raise MXNetError("Found name \"%s\" not in arguments" % name)
         if aux_params:
             for name, arr in aux_params.items():
                 if name in self.aux_dict:
-                    self.aux_dict[name]._handle = arr._handle.astype(
-                        self.aux_dict[name]._handle.dtype)
+                    self.aux_dict[name]._handle = self._commit(
+                        arr._handle.astype(self.aux_dict[name]._handle.dtype))
                 elif not allow_extra_params:
                     raise MXNetError("Found name \"%s\" not in aux" % name)
 

@@ -1,0 +1,465 @@
+"""Gluon Block / HybridBlock / SymbolBlock.
+
+Reference: python/mxnet/gluon/block.py (Block :122, HybridBlock :375,
+SymbolBlock :598; _build_cache → CachedOp :435-438).
+
+TPU-native hybridize: calling ``hybridize()`` traces ``hybrid_forward``
+ONCE with Symbols, lowers the whole block through GraphProgram and runs it
+as a single jitted XLA computation per input signature — the CachedOp role
+(src/imperative/cached_op.cc) with XLA as the executor.  The eager path
+dispatches per-op like the reference's imperative mode.
+"""
+from __future__ import annotations
+
+import copy
+import re
+import threading
+import warnings
+from collections import OrderedDict
+
+import numpy as np
+
+from .. import autograd as _ag
+from ..base import MXNetError
+from ..context import cpu
+from ..ndarray.ndarray import NDArray, array as nd_array
+from ..symbol.symbol import Group, Symbol, Variable
+from .parameter import DeferredInitializationError, Parameter, ParameterDict
+
+
+class _BlockScope:
+    """Name/param scoping (reference block.py _BlockScope)."""
+
+    _current = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+        self._name_scope = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        current = getattr(_BlockScope._current, "value", None)
+        if current is None:
+            if prefix is None:
+                from ..name import NameManager
+                prefix = NameManager.current().get(None, hint) + "_"
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            prefix = "%s%d_" % (hint, count)
+            current._counter[hint] = count + 1
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix, parent._shared)
+        else:
+            params = ParameterDict(params.prefix, params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        if self._block._empty_prefix:
+            return self
+        self._old_scope = getattr(_BlockScope._current, "value", None)
+        _BlockScope._current.value = self
+        from ..name import Prefix
+        self._name_scope = Prefix(self._block.prefix)
+        self._name_scope.__enter__()
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        if self._block._empty_prefix:
+            return
+        self._name_scope.__exit__(ptype, value, trace)
+        self._name_scope = None
+        _BlockScope._current.value = self._old_scope
+
+
+def _flatten(args, inout_str):
+    if isinstance(args, NDArray):
+        return [args], int(0)
+    if isinstance(args, Symbol):
+        length = len(args.list_outputs())
+        length = length if length > 1 else 0
+        return [args], int(length)
+    assert isinstance(args, (list, tuple)), \
+        "HybridBlock %s must be (nested) list of Symbol or NDArray, " \
+        "but got %s of type %s" % (inout_str, str(args), str(type(args)))
+    flat = []
+    fmts = []
+    for i in args:
+        arg, fmt = _flatten(i, inout_str)
+        flat.extend(arg)
+        fmts.append(fmt)
+    return flat, fmts
+
+
+def _regroup(args, fmt):
+    if isinstance(fmt, int):
+        if fmt == 0:
+            return args[0], args[1:]
+        return args[:fmt], args[fmt:]
+    assert isinstance(args, (list, tuple)), \
+        "output must be (nested) list of Symbol or NDArray"
+    ret = []
+    for i in fmt:
+        res, args = _regroup(args, i)
+        ret.append(res)
+    return ret, args
+
+
+class Block:
+    """reference block.py:122"""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(prefix, params,
+                                                        self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") \
+            else self._prefix
+        self._scope = _BlockScope(self)
+        self._children = OrderedDict()
+        self._reg_params = {}
+
+    def __repr__(self):
+        s = "{name}(\n{modstr}\n)"
+        modstr = "\n".join("  ({key}): {block}".format(
+            key=key, block=_indent(str(block), 2))
+            for key, block in self._children.items())
+        return s.format(name=self.__class__.__name__, modstr=modstr)
+
+    def __setattr__(self, name, value):
+        if hasattr(self, name):
+            existing = getattr(self, name)
+            if isinstance(existing, (Parameter, Block)) and \
+                    not isinstance(value, type(existing)):
+                raise TypeError("Changing attribute type for {name} from "
+                                "{type1} to {type2} is not allowed.".format(
+                                    name=name, type1=type(existing),
+                                    type2=type(value)))
+        if isinstance(value, Block):
+            self.register_child(value, name)
+        elif isinstance(value, Parameter):
+            assert name not in self._reg_params or \
+                self._reg_params[name] is value, \
+                "Overriding Parameter attribute %s is not allowed." % name
+            self._reg_params[name] = value
+        super().__setattr__(name, value)
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    def name_scope(self):
+        return self._scope
+
+    @property
+    def params(self):
+        return self._params
+
+    def collect_params(self, select=None) -> ParameterDict:
+        ret = ParameterDict(self._params.prefix)
+        if not select:
+            ret.update(self.params)
+        else:
+            pattern = re.compile(select)
+            ret.update({name: value for name, value in self.params.items()
+                        if pattern.match(name)})
+        for cld in self._children.values():
+            ret.update(cld.collect_params(select=select))
+        return ret
+
+    def save_params(self, filename):
+        self.collect_params().save(filename, strip_prefix=self.prefix)
+
+    def load_params(self, filename, ctx=None, allow_missing=False,
+                    ignore_extra=False):
+        self.collect_params().load(filename, ctx, allow_missing,
+                                   ignore_extra, self.prefix)
+
+    # newer-name aliases kept for convenience
+    save_parameters = save_params
+    load_parameters = load_params
+
+    def register_child(self, block, name=None):
+        if name is None:
+            name = str(len(self._children))
+        self._children[name] = block
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        from ..initializer import Uniform
+        self.collect_params().initialize(init or Uniform(), ctx, verbose,
+                                         force_reinit)
+
+    def hybridize(self, active=True, **kwargs):
+        for cld in self._children.values():
+            cld.hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for _, param in self.params.items():
+            param.cast(dtype)
+
+    def __call__(self, *args):
+        return self.forward(*args)
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+    def apply(self, fn):
+        for cld in self._children.values():
+            cld.apply(fn)
+        fn(self)
+        return self
+
+    def summary(self, *inputs):
+        out = self(*inputs)
+        return out
+
+
+def _indent(s_, num_spaces):
+    lines = s_.split("\n")
+    first = lines.pop(0)
+    lines = [(num_spaces * " ") + line for line in lines]
+    return "\n".join([first] + lines)
+
+
+class HybridBlock(Block):
+    """reference block.py:375 — hybridize() builds one XLA program."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_graph = ()
+        self._cached_program = None
+        self._flags = {}
+
+    def __setattr__(self, name, value):
+        super().__setattr__(name, value)
+        if isinstance(value, HybridBlock):
+            self._clear_cached_op()
+
+    def _clear_cached_op(self):
+        self._cached_graph = ()
+        self._cached_program = None
+
+    def register_child(self, block, name=None):
+        if not isinstance(block, HybridBlock):
+            raise ValueError(
+                "Children of HybridBlock must also be HybridBlock, but %s "
+                "has type %s." % (str(block), str(type(block))))
+        super().register_child(block, name)
+        self._clear_cached_op()
+
+    def hybridize(self, active=True, **kwargs):
+        self._active = active
+        self._flags = kwargs
+        self._clear_cached_op()
+        super().hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        self._clear_cached_op()
+        super().cast(dtype)
+
+    def _get_graph(self, *args):
+        if not self._cached_graph:
+            flat_args, self._in_format = _flatten(args, "input")
+            inputs = [Variable("data%d" % i) if len(flat_args) > 1
+                      else Variable("data") for i in range(len(flat_args))]
+            grouped, _ = _regroup(inputs, self._in_format)
+            params = {i: j.var() for i, j in self._reg_params.items()}
+            with self.name_scope():
+                if isinstance(grouped, (list, tuple)):
+                    out = self.hybrid_forward(_SymModule, *grouped, **params)
+                else:
+                    out = self.hybrid_forward(_SymModule, grouped, **params)
+            flat_out, self._out_format = _flatten(out, "output")
+            self._cached_graph = inputs, Group([o for o in flat_out])
+        return self._cached_graph
+
+    def infer_shape(self, *args):
+        inputs, out = self._get_graph(*args)
+        flat_args, _ = _flatten(args, "input")
+        shapes = {i.name: a.shape for i, a in zip(inputs, flat_args)}
+        from ..executor import infer_shapes
+        arg_shapes, _, aux_shapes = infer_shapes(out, shapes)
+        sdict = dict(zip(out.list_arguments(), arg_shapes))
+        sdict.update(zip(out.list_auxiliary_states(), aux_shapes))
+        for _, param in self.collect_params().items():
+            if param.name in sdict:
+                param.shape = sdict[param.name]
+
+    def _build_cache(self, *args):
+        inputs, out = self._get_graph(*args)
+        from ..executor import GraphProgram
+        self._cached_program = GraphProgram(out)
+        self._cached_input_names = [i.name for i in inputs]
+
+    def _call_cached_op(self, *args):
+        if self._cached_program is None:
+            self._build_cache(*args)
+        prog = self._cached_program
+        flat_args, _ = _flatten(args, "input")
+        arg_map = dict(zip(self._cached_input_names,
+                           [a for a in flat_args]))
+        params = {p.name: p for _, p in self.collect_params().items()}
+        arg_nds = []
+        for name in prog.arg_names:
+            if name in arg_map:
+                arg_nds.append(arg_map[name])
+            else:
+                arg_nds.append(params[name].data())
+        aux_nds = [params[name].data() for name in prog.aux_names]
+        train = _ag.is_training()
+        fn = prog._jit_forward(train)
+        import jax.numpy as jnp
+        from .. import rng as _rng
+        if prog.num_rng:
+            keys = jnp.stack([_rng.next_key() for _ in range(prog.num_rng)])
+        else:
+            keys = jnp.zeros((0, 2), jnp.uint32)
+        arg_handles = tuple(a._handle for a in arg_nds)
+        aux_handles = tuple(a._handle for a in aux_nds)
+        outs, new_aux = fn(arg_handles, aux_handles, keys)
+        if train:
+            for nd_, na in zip(aux_nds, new_aux):
+                nd_._handle = na
+        out_nds = [NDArray(o) for o in outs]
+        if _ag.is_recording():
+            # record one tape node for the whole fused program
+            def pure(*arrays):
+                o, _ = fn(tuple(arrays), aux_handles, keys)
+                return tuple(o)
+            _ag._record_op(pure, list(arg_handles), arg_nds, out_nds)
+        ret, _ = _regroup(out_nds, self._out_format)
+        return ret
+
+    def forward(self, x, *args):
+        if isinstance(x, NDArray):
+            if self._active:
+                try:
+                    return self._call_cached_op(x, *args)
+                except DeferredInitializationError:
+                    self._deferred_infer_shape(x, *args)
+                    for _, p in self.collect_params().items():
+                        p._finish_deferred_init()
+                    return self._call_cached_op(x, *args)
+            try:
+                params = {i: j.data() for i, j in self._reg_params.items()}
+            except DeferredInitializationError:
+                self._deferred_infer_shape(x, *args)
+                for _, i in self._reg_params.items():
+                    i._finish_deferred_init()
+                params = {i: j.data() for i, j in self._reg_params.items()}
+            from .. import ndarray as ndm
+            return self.hybrid_forward(ndm, x, *args, **params)
+        assert isinstance(x, Symbol), \
+            "HybridBlock requires the first argument to forward be either " \
+            "Symbol or NDArray, but got %s" % type(x)
+        params = {i: j.var() for i, j in self._reg_params.items()}
+        with self.name_scope():
+            from .. import symbol as symm
+            return self.hybrid_forward(symm, x, *args, **params)
+
+    def _deferred_infer_shape(self, *args):
+        try:
+            self.infer_shape(*args)
+        except Exception as e:
+            raise ValueError(
+                "Deferred initialization failed because shape cannot be "
+                "inferred. %s" % e)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    def export(self, path, epoch=0):
+        """Export symbol + params (reference block.py export)."""
+        if not self._cached_graph:
+            raise RuntimeError(
+                "Please first call block.hybridize() and then run forward "
+                "with this block at least once before calling export.")
+        sym = self._cached_graph[1]
+        sym.save("%s-symbol.json" % path)
+        arg_dict = {}
+        for name, param in self.collect_params().items():
+            if name in sym.list_auxiliary_states():
+                arg_dict["aux:" + name] = param.data()
+            else:
+                arg_dict["arg:" + name] = param.data()
+        from ..ndarray.ndarray import save as nd_save
+        nd_save("%s-%04d.params" % (path, epoch), arg_dict)
+
+
+class _SymModule:
+    """F for symbolic hybrid_forward tracing."""
+
+    def __getattr__(self, name):
+        from .. import symbol as symm
+        return getattr(symm, name)
+
+
+_SymModule = _SymModule()
+
+
+class SymbolBlock(HybridBlock):
+    """Wrap a Symbol into a Block (reference block.py:598)."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix=None, params=params)
+        self._prefix = ""
+        self._params = ParameterDict("", params)
+        if isinstance(inputs, (Symbol,)) and len(inputs.list_outputs()) == 1:
+            inputs = [inputs]
+        if isinstance(outputs, (list, tuple)) and len(outputs) == 1 and \
+                isinstance(outputs[0], (list, tuple)):
+            outputs = outputs[0]
+        if isinstance(outputs, (list, tuple)):
+            outputs = Group(outputs)
+        syms, self._in_format = _flatten(inputs, "input")
+        out, self._out_format = _flatten(outputs, "output")
+        out = Group(out) if isinstance(out, list) else out
+
+        input_names = set(i.name for i in syms)
+        for name in out.list_arguments():
+            if name not in input_names:
+                self.params.get(name, allow_deferred_init=True)
+        for name in out.list_auxiliary_states():
+            self.params.get(name, allow_deferred_init=True, grad_req="null")
+        self._cached_graph = syms, out
+        prefix = _common_prefix(list(self._params.keys()))
+        params = {k[len(prefix):]: v for k, v in self._params.items()}
+        self._reg_params = params
+
+    def forward(self, x, *args):
+        if isinstance(x, NDArray):
+            return self._call_cached_op(x, *args)
+        assert isinstance(x, Symbol)
+        return copy.copy(self._cached_graph[1])
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+
+def _common_prefix(names):
+    if not names:
+        return ""
+    prefix = names[0]
+    for name in names:
+        i = 0
+        while i < len(prefix) and i < len(name) and prefix[i] == name[i]:
+            i += 1
+        prefix = prefix[:i]
+    return prefix

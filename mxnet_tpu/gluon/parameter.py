@@ -1,0 +1,358 @@
+"""Gluon Parameter / ParameterDict.
+
+Reference: python/mxnet/gluon/parameter.py (Parameter with deferred init,
+grad_req, lr_mult/wd_mult; ParameterDict with prefix scoping).
+
+Single-array model: on TPU one jax.Array (possibly mesh-sharded) replaces
+the reference's per-GPU copies — list_ctx/list_data keep API parity.
+"""
+from __future__ import annotations
+
+import warnings
+from collections import OrderedDict
+from typing import List, Optional
+
+import numpy as np
+
+from ..base import MXNetError, dtype_np
+from ..context import Context, cpu, current_context
+from ..initializer import Initializer, InitDesc, Uniform, create as init_create
+from ..ndarray.ndarray import NDArray, zeros as nd_zeros
+from ..symbol.symbol import Variable
+
+
+class DeferredInitializationError(MXNetError):
+    pass
+
+
+class Parameter:
+    """reference gluon/parameter.py Parameter."""
+
+    def __init__(self, name, grad_req="write", shape=None, dtype=np.float32,
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self._var = None
+        self._data: Optional[NDArray] = None
+        self._grad: Optional[NDArray] = None
+        self._deferred_init = ()
+        self._differentiable = differentiable
+        self._allow_deferred_init = allow_deferred_init
+        self._grad_req = None
+        self.name = name
+        if isinstance(shape, int):
+            shape = (shape,)
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.grad_req = grad_req
+        self.init = init
+        self._stype = stype
+        self._grad_stype = grad_stype
+
+    def __repr__(self):
+        return "Parameter %s (shape=%s, dtype=%s)" % (
+            self.name, self.shape, self.dtype)
+
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        assert req in ("write", "add", "null")
+        if not self._differentiable:
+            req = "null"
+        if self._grad_req == req:
+            return
+        self._grad_req = req
+        if req == "null":
+            self._grad = None
+        elif self._data is not None and self._grad is None:
+            self._init_grad()
+
+    def _check_and_get(self, arr, ctx):
+        if arr is not None:
+            return arr
+        if self._deferred_init:
+            raise DeferredInitializationError(
+                "Parameter '%s' has not been initialized yet because "
+                "initialization was deferred. Actual initialization happens "
+                "during the first forward pass." % self.name)
+        raise RuntimeError(
+            "Parameter '%s' has not been initialized. You should initialize "
+            "parameters with Block.collect_params().initialize()" % self.name)
+
+    def _finish_deferred_init(self):
+        if not self._deferred_init:
+            return
+        init, ctx, default_init, data = self._deferred_init
+        self._deferred_init = ()
+        assert self.shape is not None and all(s > 0 for s in self.shape), \
+            "Cannot initialize Parameter '%s' because it has invalid shape: " \
+            "%s." % (self.name, str(self.shape))
+        if data is None:
+            data = nd_zeros(self.shape, dtype=self.dtype, ctx=ctx or cpu())
+            (init or default_init or Uniform())(InitDesc(self.name), data)
+        self._init_impl(data, ctx)
+
+    def _init_impl(self, data, ctx):
+        self._data = data
+        if self._grad_req != "null":
+            self._init_grad()
+
+    def _init_grad(self):
+        self._grad = nd_zeros(self._data.shape, dtype=self._data.dtype,
+                              ctx=self._data.context)
+        from .. import autograd as _ag
+        _ag.mark_variables([self._data], [self._grad],
+                           grad_reqs=self._grad_req)
+
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        if default_init is None:
+            default_init = Uniform()
+        if self._data is not None and not force_reinit:
+            warnings.warn("Parameter '%s' is already initialized, ignoring. "
+                          "Set force_reinit=True to re-initialize." % self.name,
+                          stacklevel=2)
+            return
+        if isinstance(ctx, Context):
+            ctx = ctx
+        elif isinstance(ctx, (list, tuple)):
+            ctx = ctx[0]
+        if self.shape is None or any(s <= 0 for s in (self.shape or (0,))):
+            if self._allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init, None)
+                return
+            raise ValueError("Cannot initialize Parameter '%s' because it has "
+                             "invalid shape: %s." % (self.name, self.shape))
+        self._deferred_init = (init, ctx, default_init, None)
+        self._finish_deferred_init()
+
+    def reset_ctx(self, ctx):
+        if self._data is not None:
+            self._data = self._data.as_in_context(
+                ctx[0] if isinstance(ctx, (list, tuple)) else ctx)
+
+    def set_data(self, data):
+        if self._data is None:
+            assert self._deferred_init, \
+                "Parameter '%s' has not been initialized" % self.name
+            self.shape = data.shape
+            init, ctx, default_init, _ = self._deferred_init
+            self._deferred_init = (init, ctx, default_init, data)
+            self._finish_deferred_init()
+            return
+        if self.shape is not None and tuple(self.shape) != tuple(data.shape):
+            raise AssertionError(
+                "Shape mismatch for Parameter %s: %s vs %s"
+                % (self.name, self.shape, data.shape))
+        self._data._handle = data._handle if isinstance(data, NDArray) \
+            else nd_zeros(data.shape)._handle
+        if isinstance(data, np.ndarray):
+            from ..ndarray.ndarray import array as nd_array
+            self._data._handle = nd_array(data, dtype=self._data.dtype)._handle
+
+    def data(self, ctx=None) -> NDArray:
+        return self._check_and_get(self._data, ctx)
+
+    def list_data(self):
+        return [self._check_and_get(self._data, None)]
+
+    def grad(self, ctx=None) -> NDArray:
+        if self._data is not None and self._grad is None:
+            raise RuntimeError(
+                "Cannot get gradient array for Parameter '%s' because "
+                "grad_req='null'" % self.name)
+        return self._check_and_get(self._grad, ctx)
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def list_ctx(self):
+        if self._data is None:
+            if self._deferred_init:
+                return [self._deferred_init[1] or cpu()]
+            raise RuntimeError("Parameter '%s' has not been initialized"
+                               % self.name)
+        return [self._data.context]
+
+    def zero_grad(self):
+        if self._grad is not None:
+            self._grad[:] = 0
+
+    def var(self):
+        if self._var is None:
+            self._var = Variable(self.name, shape=self.shape,
+                                 dtype=self.dtype, lr_mult=self.lr_mult,
+                                 wd_mult=self.wd_mult)
+        return self._var
+
+    def cast(self, dtype):
+        self.dtype = dtype
+        if self._data is not None:
+            self._data = self._data.astype(dtype)
+            if self._grad is not None:
+                self._grad = self._grad.astype(dtype)
+
+
+class Constant(Parameter):
+    """reference gluon/parameter.py Constant — non-differentiable param."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, NDArray):
+            from ..ndarray.ndarray import array as nd_array
+            value = nd_array(value)
+        self.value = value
+
+        class Init(Initializer):
+            def _init_weight(self, _, arr):
+                value.copyto(arr)
+            _init_default = _init_weight
+
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         dtype=value.dtype, init=Init(),
+                         differentiable=False)
+
+
+class ParameterDict:
+    """reference gluon/parameter.py ParameterDict."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = OrderedDict()
+        self._shared = shared
+
+    def __repr__(self):
+        return "ParameterDict '%s' (\n%s\n)" % (
+            self._prefix, "\n".join(str(v) for v in self.values()))
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._params[name]
+        return None
+
+    def get(self, name, **kwargs) -> Parameter:
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            for k, v in kwargs.items():
+                if hasattr(param, k) and getattr(param, k) is not None:
+                    existing = getattr(param, k)
+                    if k == "shape" and v is not None:
+                        v = tuple(v)
+                        if existing != v and None not in (existing, v):
+                            # allow unknown (0) dims to be filled
+                            matched = tuple(
+                                a if a else b for a, b in zip(existing, v)) \
+                                if len(existing) == len(v) else None
+                            if matched is None or 0 in matched:
+                                raise AssertionError(
+                                    "Cannot retrieve Parameter %s because "
+                                    "shapes mismatch: %s vs %s"
+                                    % (name, existing, v))
+                            param.shape = matched
+                            continue
+                        param.shape = v
+                        continue
+                    setattr(param, k, v)
+                else:
+                    setattr(param, k, v)
+        return param
+
+    def get_constant(self, name, value=None):
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            if value is None:
+                raise KeyError("No constant named '%s'." % name)
+            param = Constant(name, value)
+            self._params[name] = param
+        return param
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise ValueError("Cannot update self with other because they "
+                                 "have different Parameters with the same "
+                                 "name '%s'" % k)
+            self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        from ..initializer import Uniform
+        if init is None:
+            init = Uniform()
+        for _, v in self.items():
+            v.initialize(None, ctx, init, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for v in self.values():
+            v.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for v in self.values():
+            v.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        for v in self.values():
+            setattr(v, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        from ..ndarray.ndarray import save as nd_save
+        arg_dict = {}
+        for param in self.values():
+            block = param.list_data()
+            weight = block[0]
+            if not param.name.startswith(strip_prefix):
+                raise ValueError(
+                    "Prefix '%s' is to be stripped before saving, but "
+                    "Parameter's name '%s' does not start with it"
+                    % (strip_prefix, param.name))
+            arg_dict[param.name[len(strip_prefix):]] = weight
+        nd_save(filename, arg_dict)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        from ..ndarray.ndarray import load as nd_load
+        arg_dict = nd_load(filename)
+        arg_dict = {restore_prefix + k.split(":", 1)[-1]: v
+                    for k, v in arg_dict.items()}
+        if not allow_missing:
+            for name in self.keys():
+                assert name in arg_dict, \
+                    "Parameter '%s' is missing in file '%s'" % (
+                        name[len(restore_prefix):], filename)
+        for name in arg_dict:
+            if name not in self._params:
+                assert ignore_extra, \
+                    "Parameter '%s' loaded from file '%s' is not present in " \
+                    "ParameterDict" % (name[len(restore_prefix):], filename)
+                continue
+            self[name].set_data(arg_dict[name])

@@ -1,0 +1,15 @@
+"""mx.io namespace."""
+from .io import (CSVIter, DataBatch, DataDesc, DataIter, LibSVMIter,
+                 MNISTIter, NDArrayIter, PrefetchingIter, ResizeIter)
+
+# ImageRecordIter / ImageRecordUInt8Iter are provided by the image package
+# (RecordIO + decode + augment pipeline, reference iter_image_recordio_2.cc)
+
+
+def _lazy_image_record_iter(*args, **kwargs):
+    from ..image.record_iter import ImageRecordIter as _IRI
+    return _IRI(*args, **kwargs)
+
+
+def ImageRecordIter(*args, **kwargs):  # noqa: N802 (reference name)
+    return _lazy_image_record_iter(*args, **kwargs)

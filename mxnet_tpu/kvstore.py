@@ -1,0 +1,274 @@
+"""KVStore — parameter synchronisation.
+
+Reference: include/mxnet/kvstore.h + src/kvstore/ (KVStoreLocal
+kvstore_local.h:51, Comm reduce comm.h:43, KVStoreNCCL kvstore_nccl.h:62,
+dist worker/server kvstore_dist.h:49 / kvstore_dist_server.h:113) and
+python/mxnet/kvstore.py.
+
+TPU-native mapping (SURVEY.md §5.8):
+* 'local' / 'device' / 'nccl' / 'tpu' — single-process multi-device reduce.
+  The NCCL ring / CUDA P2P machinery is replaced by one jitted sum: device
+  copies are summed on the lead device (XLA issues the transfers; on a mesh
+  this is an ICI all-reduce via parallel.allreduce when arrays are sharded).
+* 'dist_sync' / 'dist_device_sync' / 'dist_async' — multi-host: instead of a
+  ZMQ parameter server, every host enters the same psum over the global mesh
+  (jax.distributed runtime is the tracker/Postoffice analog).  The PS-style
+  API (push/pull/updater, rank, barrier) is preserved exactly, so
+  Module/Gluon drive it unchanged.
+* Gradient compression keeps its API; over ICI it's a no-op win, so set_
+  gradient_compression records config and (2bit) applies error-feedback
+  quantisation before the reduce to preserve semantics for tests.
+"""
+from __future__ import annotations
+
+import logging
+import pickle
+from typing import Callable, Dict, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray, array as nd_array, zeros as nd_zeros
+from .ndarray.sparse import RowSparseNDArray
+
+__all__ = ["KVStore", "create"]
+
+
+def _key_str(key):
+    return str(key)
+
+
+@jax.jit
+def _sum_arrays(arrs):
+    out = arrs[0]
+    for a in arrs[1:]:
+        out = out + a
+    return out
+
+
+class _TwoBitCompressor:
+    """2-bit gradient compression with error feedback (reference
+    src/kvstore/gradient_compression.{h,cc}): values quantised to
+    {-threshold, 0, +threshold}, residual carried forward."""
+
+    def __init__(self, threshold=0.5):
+        self.threshold = float(threshold)
+        self.residual: Dict[str, jnp.ndarray] = {}
+
+    def compress(self, key, grad):
+        r = self.residual.get(key)
+        g = grad if r is None else grad + r
+        t = self.threshold
+        q = jnp.where(g >= t, t, jnp.where(g <= -t, -t, 0.0)).astype(g.dtype)
+        self.residual[key] = g - q
+        return q
+
+
+class KVStore:
+    """In-process store; subclassed for dist (reference kvstore.py:62)."""
+
+    def __init__(self, kv_type="local"):
+        self.type = kv_type
+        self._store: Dict[str, NDArray] = {}
+        self._updater: Optional[Callable] = None
+        self._optimizer = None
+        self._compressor: Optional[_TwoBitCompressor] = None
+        self._str_keys = False
+
+    # -- init/push/pull ---------------------------------------------------
+    def init(self, key, value):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            if k in self._store:
+                continue
+            if isinstance(v, RowSparseNDArray):
+                self._store[k] = v
+            else:
+                self._store[k] = NDArray(v._handle)
+
+    def push(self, key, value, priority=0):
+        """Reduce value(s) into the store; run updater if set (reference
+        KVStoreLocal::PushImpl kvstore_local.h:159)."""
+        keys, values = self._normalize_push(key, value)
+        for k, vlist in zip(keys, values):
+            merged = self._reduce(k, vlist)
+            if self._updater is not None:
+                self._updater(self._updater_key(k), merged, self._store[k])
+            else:
+                stored = self._store[k]
+                stored._handle = stored._handle + merged._handle
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        """Broadcast the stored value to each out array, keeping each on its
+        own device (the Comm::Broadcast analog, comm.h)."""
+        keys, outs = self._normalize_push(key, out)
+        for k, olist in zip(keys, outs):
+            src = self._store[k]
+            for o in olist:
+                dev = list(o._handle.devices())[0] if o._handle is not None \
+                    else None
+                if dev is not None and dev not in src._handle.devices():
+                    o._handle = jax.device_put(src._handle, dev)
+                else:
+                    o._handle = src._handle
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only the requested rows (reference PullRowSparseImpl
+        kvstore_dist.h:267)."""
+        assert out is not None and row_ids is not None
+        keys, outs = self._normalize_push(key, out)
+        rids = row_ids if isinstance(row_ids, list) else [row_ids]
+        for k, olist in zip(keys, outs):
+            src = self._store[k]
+            for o, rid in zip(olist, rids * len(olist)):
+                idx = rid._handle.astype(jnp.int32)
+                if isinstance(src, RowSparseNDArray):
+                    dense = src._to_dense_handle()
+                else:
+                    dense = src._handle
+                data = jnp.take(dense, idx, axis=0)
+                if isinstance(o, RowSparseNDArray):
+                    o._data = data
+                    o._indices = idx.astype(jnp.int64)
+                    o._dense_cache = None
+                else:
+                    o._handle = dense
+        return
+
+    # -- updater/optimizer -----------------------------------------------
+    def set_updater(self, updater):
+        self._updater = updater
+
+    def set_optimizer(self, optimizer):
+        """On dist stores the reference pickles the optimizer to servers
+        (kvstore.py:435-476); here the 'server' is this process."""
+        from .optimizer import Updater
+        self._optimizer = optimizer
+        self._updater = Updater(optimizer)
+
+    def set_gradient_compression(self, compression_params):
+        ctype = compression_params.get("type", "2bit")
+        if ctype != "2bit":
+            raise MXNetError("unsupported compression type " + ctype)
+        self._compressor = _TwoBitCompressor(
+            compression_params.get("threshold", 0.5))
+
+    # -- distributed topology (single-process defaults) -------------------
+    @property
+    def rank(self) -> int:
+        return 0
+
+    @property
+    def num_workers(self) -> int:
+        return 1
+
+    def barrier(self):
+        pass
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        assert self._updater is not None, "Cannot save states for distributed training"
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        assert self._updater is not None
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    # -- helpers -----------------------------------------------------------
+    def _updater_key(self, k):
+        try:
+            return int(k)
+        except ValueError:
+            return k
+
+    def _reduce(self, k, vlist) -> NDArray:
+        if len(vlist) == 1:
+            merged = vlist[0]
+            if isinstance(merged, RowSparseNDArray):
+                return merged
+            merged = NDArray(merged._handle)
+        elif isinstance(vlist[0], RowSparseNDArray):
+            dense = _sum_arrays([v._handle for v in vlist])
+            merged = NDArray(dense)
+        else:
+            lead = vlist[0]._handle
+            handles = [lead] + [jax.device_put(v._handle, lead.devices().pop())
+                                for v in vlist[1:]]
+            merged = NDArray(_sum_arrays(handles))
+        if self._compressor is not None and not isinstance(merged, RowSparseNDArray):
+            merged._handle = self._compressor.compress(k, merged._handle)
+        return merged
+
+    def _normalize(self, key, value):
+        if isinstance(key, (str, int)):
+            key, value = [key], [value]
+        keys = [_key_str(k) for k in key]
+        values = value if isinstance(value, list) else [value]
+        return keys, values
+
+    def _normalize_push(self, key, value):
+        """Returns keys + list-of-lists of values."""
+        if isinstance(key, (str, int)):
+            keys = [_key_str(key)]
+            if isinstance(value, (list, tuple)) and \
+                    all(isinstance(v, NDArray) for v in value):
+                return keys, [list(value)]
+            return keys, [[value]]
+        keys = [_key_str(k) for k in key]
+        out = []
+        for v in value:
+            if isinstance(v, (list, tuple)):
+                out.append(list(v))
+            else:
+                out.append([v])
+        return keys, out
+
+
+class KVStoreTPUDist(KVStore):
+    """Multi-host data parallelism over the global device mesh.
+
+    The reference's scheduler/server/worker ps-lite deployment
+    (kvstore_dist.h) becomes: every host calls jax.distributed.initialize
+    (done by parallel.init_distributed / the launcher), arrays are sharded
+    over a global mesh, and push's reduce is a psum riding ICI/DCN.  In a
+    single-process run it degrades to KVStore('local') semantics.
+    """
+
+    def __init__(self, kv_type="dist_sync"):
+        super().__init__(kv_type)
+        from .parallel import topology
+        self._topo = topology()
+
+    @property
+    def rank(self):
+        return self._topo.process_index
+
+    @property
+    def num_workers(self):
+        return self._topo.process_count
+
+    def barrier(self):
+        from .parallel import barrier as _barrier
+        _barrier()
+
+    def _reduce(self, k, vlist):
+        merged = super()._reduce(k, vlist)
+        if self.num_workers > 1 and not isinstance(merged, RowSparseNDArray):
+            from .parallel import allreduce_array
+            merged._handle = allreduce_array(merged._handle)
+        return merged
+
+
+def create(name="local") -> KVStore:
+    """reference: src/kvstore/kvstore.cc:40-75 factory."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    if name in ("local", "local_update_cpu", "local_allreduce_cpu",
+                "local_allreduce_device", "device", "nccl", "tpu"):
+        return KVStore(name)
+    if name.startswith("dist"):
+        return KVStoreTPUDist(name)
+    raise MXNetError("unknown KVStore type %s" % name)

@@ -1,0 +1,10 @@
+"""Model zoo (symbol builders) — reference example/image-classification/symbols/."""
+from . import resnet
+from . import lenet
+from . import mlp
+from . import alexnet
+from . import vgg
+
+get_resnet = resnet.get_symbol
+get_lenet = lenet.get_symbol
+get_mlp = mlp.get_symbol

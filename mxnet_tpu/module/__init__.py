@@ -1,0 +1,7 @@
+"""mx.mod namespace (reference python/mxnet/module/)."""
+from .base_module import BaseModule
+from .bucketing_module import BucketingModule
+from .executor_group import DataParallelExecutorGroup
+from .module import Module
+from .sequential_module import SequentialModule
+from .python_module import PythonModule, PythonLossModule

@@ -1,0 +1,236 @@
+"""DataParallelExecutorGroup — fan a batch across a context list.
+
+Reference: python/mxnet/module/executor_group.py:129 (decide_slices :267,
+bind_exec :330/:618, forward :422, backward :554, update_metric :583).
+
+TPU note: this class preserves the reference's multi-executor model for API
+parity (one Executor per Context, batch sliced on axis 0).  On a TPU pod the
+*preferred* path is a single sharded program over a jax Mesh — that lives in
+parallel/ and kvstore('tpu'); Module uses it automatically when all contexts
+are TPU and a mesh is active.  Per-device executors remain correct and are
+what CPU-device tests exercise.
+"""
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+import numpy as np
+
+from ..base import MXNetError
+from ..context import Context
+from ..executor import Executor
+from ..io.io import DataDesc
+from ..ndarray.ndarray import NDArray, array as nd_array, zeros as nd_zeros
+
+
+def _split_input_slice(batch_size: int, work_load_list) -> List[slice]:
+    """reference: python/mxnet/executor_manager.py _split_input_slice"""
+    total = sum(work_load_list)
+    if batch_size < len(work_load_list):
+        raise ValueError("Too many slices. Some splits are empty.")
+    slices = []
+    start = 0
+    for i, w in enumerate(work_load_list):
+        if i == len(work_load_list) - 1:
+            end = batch_size
+        else:
+            end = start + int(round(batch_size * w / total))
+        slices.append(slice(start, end))
+        start = end
+    return slices
+
+
+class DataParallelExecutorGroup:
+    def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
+                 param_names, for_training, inputs_need_grad,
+                 shared_group=None, logger=logging, fixed_param_names=None,
+                 grad_req="write", state_names=None):
+        self.param_names = param_names
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.symbol = symbol
+        self.contexts = contexts
+        self.workload = workload or [1] * len(contexts)
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.logger = logger
+        self.fixed_param_names = set(fixed_param_names or [])
+        self.state_names = set(state_names or [])
+        self.data_shapes = None
+        self.label_shapes = None
+        self.execs: List[Executor] = []
+        self.slices: List[slice] = []
+        self.batch_size = None
+
+        data_names = [x.name if isinstance(x, DataDesc) else x[0]
+                      for x in data_shapes]
+        label_names = [x.name if isinstance(x, DataDesc) else x[0]
+                       for x in (label_shapes or [])]
+        self._input_names = set(data_names + label_names)
+
+        self.grad_req = {}
+        for name in self.arg_names:
+            if name in self.param_names:
+                self.grad_req[name] = "null" if name in self.fixed_param_names \
+                    else grad_req
+            elif name in data_names:
+                self.grad_req[name] = grad_req if inputs_need_grad else "null"
+            else:
+                self.grad_req[name] = "null"
+        if not for_training:
+            self.grad_req = {k: "null" for k in self.grad_req}
+
+        self.bind_exec(data_shapes, label_shapes, shared_group)
+
+    def decide_slices(self, data_shapes):
+        """reference executor_group.py:267"""
+        batch_size = data_shapes[0][1][0] if not isinstance(data_shapes[0], DataDesc) \
+            else data_shapes[0].shape[0]
+        self.batch_size = batch_size
+        self.slices = _split_input_slice(batch_size, self.workload)
+        return self.slices
+
+    def bind_exec(self, data_shapes, label_shapes, shared_group=None,
+                  reshape=False):
+        self.data_shapes = [x if isinstance(x, DataDesc) else DataDesc(*x)
+                            for x in data_shapes]
+        self.label_shapes = [x if isinstance(x, DataDesc) else DataDesc(*x)
+                             for x in (label_shapes or [])]
+        self.decide_slices(self.data_shapes)
+        self.execs = []
+        shared_prog = None
+        if shared_group is not None and shared_group.execs:
+            shared_prog = shared_group.execs[0]._prog \
+                if shared_group.symbol is self.symbol else None
+        for i, ctx in enumerate(self.contexts):
+            sl = self.slices[i]
+            n_i = sl.stop - sl.start
+            kwargs = {}
+            for d in self.data_shapes:
+                kwargs[d.name] = (n_i,) + tuple(d.shape[1:])
+            for l in self.label_shapes:
+                kwargs[l.name] = (n_i,) + tuple(l.shape[1:])
+            ex = Executor.simple_bind(self.symbol, ctx,
+                                      grad_req=self.grad_req, **kwargs)
+            if shared_group is not None and i < len(shared_group.execs):
+                # share parameter arrays with the shared group (bucketing)
+                src = shared_group.execs[i]
+                for name in self.param_names:
+                    if name in src.arg_dict:
+                        ex.arg_dict[name] = src.arg_dict[name]
+                        ex.arg_arrays[ex._prog.arg_names.index(name)] = \
+                            src.arg_dict[name]
+                        if src.grad_dict.get(name) is not None:
+                            ex.grad_dict[name] = src.grad_dict[name]
+                for name in self.aux_names:
+                    if name in src.aux_dict:
+                        ex.aux_dict[name] = src.aux_dict[name]
+                        ex.aux_arrays[ex._prog.aux_names.index(name)] = \
+                            src.aux_dict[name]
+            self.execs.append(ex)
+
+    def reshape(self, data_shapes, label_shapes):
+        self.bind_exec(data_shapes, label_shapes, None, reshape=True)
+
+    def set_params(self, arg_params, aux_params, allow_extra=False):
+        for ex in self.execs:
+            ex.copy_params_from(arg_params, aux_params,
+                                allow_extra_params=allow_extra)
+
+    def get_params(self, arg_params, aux_params):
+        """Copy (averaged over devices) params out (reference :376)."""
+        for name in self.param_names:
+            arrs = [ex.arg_dict[name] for ex in self.execs
+                    if name in ex.arg_dict]
+            if not arrs:
+                continue
+            avg = arrs[0].asnumpy() if len(arrs) == 1 else \
+                np.mean([a.asnumpy() for a in arrs], axis=0)
+            arg_params[name] = nd_array(avg, dtype=arrs[0].dtype)
+        for name in self.aux_names:
+            arrs = [ex.aux_dict[name] for ex in self.execs
+                    if name in ex.aux_dict]
+            if not arrs:
+                continue
+            avg = arrs[0].asnumpy() if len(arrs) == 1 else \
+                np.mean([a.asnumpy() for a in arrs], axis=0)
+            aux_params[name] = nd_array(avg, dtype=arrs[0].dtype)
+
+    def _slice_batch(self, arrays, names):
+        """Scatter host batch slices to each executor's inputs."""
+        for name, arr in zip(names, arrays):
+            for ex, sl in zip(self.execs, self.slices):
+                if name not in ex.arg_dict:
+                    continue
+                part = arr[sl.start:sl.stop]
+                tgt = ex.arg_dict[name]
+                tgt._handle = ex._commit(
+                    part._handle if isinstance(part, NDArray) else part)
+
+    def forward(self, data_batch, is_train=None):
+        """reference executor_group.py:422"""
+        if is_train is None:
+            is_train = self.for_training
+        data_names = [d.name for d in self.data_shapes]
+        self._slice_batch(data_batch.data, data_names)
+        if self.label_shapes and data_batch.label:
+            label_names = [l.name for l in self.label_shapes]
+            self._slice_batch(data_batch.label, label_names)
+        for ex in self.execs:
+            ex.forward(is_train=is_train)
+
+    def forward_backward(self, data_batch):
+        """Fused fwd+bwd per device — ONE XLA computation per device."""
+        data_names = [d.name for d in self.data_shapes]
+        self._slice_batch(data_batch.data, data_names)
+        if self.label_shapes and data_batch.label:
+            label_names = [l.name for l in self.label_shapes]
+            self._slice_batch(data_batch.label, label_names)
+        for ex in self.execs:
+            ex.run_fwd_bwd(is_train=True)
+
+    def backward(self, out_grads=None):
+        """reference executor_group.py:554"""
+        assert self.for_training, "re-bind with for_training=True"
+        for i, ex in enumerate(self.execs):
+            og = None
+            if out_grads is not None:
+                og = [g[self.slices[i].start:self.slices[i].stop]
+                      for g in out_grads]
+            ex.backward(out_grads=og)
+
+    def get_outputs(self, merge_multi_context=True):
+        if merge_multi_context and len(self.execs) > 1:
+            outs = []
+            for i in range(len(self.execs[0].outputs)):
+                parts = [ex.outputs[i].asnumpy() for ex in self.execs]
+                outs.append(nd_array(np.concatenate(parts, axis=0)))
+            return outs
+        if len(self.execs) == 1:
+            return self.execs[0].outputs
+        return [[ex.outputs[i] for ex in self.execs]
+                for i in range(len(self.execs[0].outputs))]
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.inputs_need_grad
+        data_names = [d.name for d in self.data_shapes]
+        if merge_multi_context and len(self.execs) > 1:
+            out = []
+            for name in data_names:
+                parts = [ex.grad_dict[name].asnumpy() for ex in self.execs]
+                out.append(nd_array(np.concatenate(parts, axis=0)))
+            return out
+        if len(self.execs) == 1:
+            return [self.execs[0].grad_dict[n] for n in data_names]
+        return [[ex.grad_dict[n] for ex in self.execs] for n in data_names]
+
+    def update_metric(self, eval_metric, labels):
+        """reference executor_group.py:583"""
+        outputs = self.get_outputs(merge_multi_context=True)
+        n_vis = len(self.symbol.list_outputs())
+        eval_metric.update(labels, outputs[:n_vis])
+
+    def install_monitor(self, mon):
+        for ex in self.execs:
+            mon.install(ex)

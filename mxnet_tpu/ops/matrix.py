@@ -5,9 +5,9 @@ dot/Concat/...), indexing_op.{cc,h} (Embedding/take/one_hot/gather_nd/
 scatter_nd), ordering_op.cc (topk/sort/argsort).
 
 MXU note: ``dot``/``batch_dot``/``FullyConnected`` are the ops XLA maps onto
-the 128x128 systolic array; everything here keeps them as single
-lax.dot_general calls with a float32 accumulator (preferred_element_type) so
-bfloat16 inputs still accumulate in fp32 like the hardware wants.
+the 128x128 systolic array; each stays a single lax.dot_general call (the MXU
+accumulates bfloat16 operands in fp32 natively; matmul precision defaults to
+'highest' package-wide so float32 stays true fp32).
 """
 from __future__ import annotations
 
@@ -224,9 +224,7 @@ def _dot(attrs, a, b):
     if a.ndim == 1 and b.ndim == 1:
         return jnp.vdot(a, b)
     return jax.lax.dot_general(
-        a, b, (((a.ndim - 1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32).astype(
-            jnp.promote_types(a.dtype, b.dtype))
+        a, b, (((a.ndim - 1,), (0,)), ((), ())))
 
 
 @register("batch_dot", inputs=("lhs", "rhs"),

@@ -46,8 +46,7 @@ def _fully_connected(attrs, data, weight, bias=None):
     else:
         x = data
     out = jax.lax.dot_general(
-        x, weight, (((x.ndim - 1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32).astype(x.dtype)
+        x, weight, (((x.ndim - 1,), (1,)), ((), ())))
     if bias is not None:
         out = out + bias
     return out
@@ -90,8 +89,7 @@ def _convolution(attrs, x, w, bias=None):
         ("NC" + spatial, "OI" + spatial, "NC" + spatial))
     out = jax.lax.conv_general_dilated(
         x, w, window_strides=stride, padding=pad, rhs_dilation=dilate,
-        dimension_numbers=dn, feature_group_count=attrs.num_group,
-        preferred_element_type=jnp.float32).astype(x.dtype)
+        dimension_numbers=dn, feature_group_count=attrs.num_group)
     if bias is not None:
         out = out + bias.reshape((1, -1) + (1,) * nd)
     return out
@@ -115,8 +113,7 @@ def _deconvolution(attrs, x, w, bias=None):
     out = jax.lax.conv_general_dilated(
         x, jnp.flip(w, axis=tuple(range(2, 2 + nd))), window_strides=(1,) * nd,
         padding=pad_t, lhs_dilation=stride, rhs_dilation=dilate,
-        dimension_numbers=dn, feature_group_count=attrs.num_group,
-        preferred_element_type=jnp.float32).astype(x.dtype)
+        dimension_numbers=dn, feature_group_count=attrs.num_group)
     if bias is not None:
         out = out + bias.reshape((1, -1) + (1,) * nd)
     return out

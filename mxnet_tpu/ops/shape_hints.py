@@ -87,7 +87,29 @@ def _prelu(attrs, shapes):
     return {}
 
 
+def _softmax_output_label(attrs, shapes):
+    data = shapes[0]
+    if attrs.get("multi_output", False):
+        return {1: (data[0],) + tuple(data[2:])}
+    if attrs.get("preserve_shape", False):
+        return {1: tuple(data[:-1])}
+    return {1: (data[0],)}
+
+
+def _label_like_data(attrs, shapes):
+    return {1: tuple(shapes[0])}
+
+
+def _svm_label(attrs, shapes):
+    return {1: (shapes[0][0],)}
+
+
 def install():
+    get_op("SoftmaxOutput").infer_params = _softmax_output_label
+    get_op("LinearRegressionOutput").infer_params = _label_like_data
+    get_op("MAERegressionOutput").infer_params = _label_like_data
+    get_op("LogisticRegressionOutput").infer_params = _label_like_data
+    get_op("SVMOutput").infer_params = _svm_label
     get_op("FullyConnected").infer_params = _fc
     get_op("Convolution").infer_params = _conv
     get_op("Deconvolution").infer_params = _deconv

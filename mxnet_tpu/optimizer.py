@@ -1,0 +1,575 @@
+"""Optimizers (reference python/mxnet/optimizer.py).
+
+Each optimizer's update is a registered fused op (ops/optimizer_ops.py) —
+one XLA kernel per parameter per step, with functional writeback.  The
+`Updater` closure preserves the reference's kvstore integration contract
+(kvstore calls updater(key, grad, weight)).
+"""
+from __future__ import annotations
+
+import math
+import pickle
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray, invoke_with_arrays, zeros
+from .ndarray import sparse as _sp
+
+__all__ = ["Optimizer", "SGD", "Signum", "FTML", "DCASGD", "NAG", "SGLD",
+           "Adam", "AdaGrad", "RMSProp", "AdaDelta", "Ftrl", "Adamax",
+           "Nadam", "Test", "Updater", "get_updater", "create", "register"]
+
+
+class Optimizer:
+    """Base optimizer with registry + lr/wd multiplier logic."""
+
+    opt_registry: Dict[str, type] = {}
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        if param_idx2name is None:
+            param_idx2name = {}
+        self.idx2name = param_idx2name.copy()
+        self.sym_info = (sym.attr_dict(), sym.list_arguments()) if sym is not None \
+            else ({}, [])
+        self.param_dict = param_dict or {}
+        self.set_lr_mult({})
+        self.set_wd_mult({})
+
+    @staticmethod
+    def register(klass):
+        name = klass.__name__.lower()
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        if name.lower() in Optimizer.opt_registry:
+            return Optimizer.opt_registry[name.lower()](**kwargs)
+        raise ValueError("Cannot find optimizer %s" % name)
+
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight.dtype == np.float16:
+            w32 = weight.astype("float32")
+            return (w32, self.create_state(index, w32))
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError()
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype == np.float16:
+            w32, base_state = state
+            g32 = grad.astype("float32")
+            self.update(index, w32, g32, base_state)
+            weight._handle = w32._handle.astype(weight._handle.dtype)
+        else:
+            self.update(index, weight, grad, state)
+
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise UserWarning("LRScheduler of the optimizer has already been "
+                              "defined.")
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = {}
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__lr_mult__" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__wd_mult__" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        if index in self.param_dict:
+            lr *= self.param_dict[index].lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= self.param_dict[index].wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def _common_kwargs(self, index):
+        kw = dict(lr=self._get_lr(index), wd=self._get_wd(index),
+                  rescale_grad=self.rescale_grad)
+        if self.clip_gradient is not None:
+            kw["clip_gradient"] = self.clip_gradient
+        return kw
+
+
+register = Optimizer.register
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum; fused sgd(_mom)_update ops (reference :435)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, dtype=weight.dtype, ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        if state is not None:
+            invoke_with_arrays("sgd_mom_update", [weight, grad, state],
+                               dict(momentum=self.momentum, **kw))
+        else:
+            invoke_with_arrays("sgd_update", [weight, grad], kw)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype == np.float16:
+            kw = self._common_kwargs(index)
+            w32, mom = state if isinstance(state, tuple) else (state, None)
+            if mom is not None:
+                invoke_with_arrays("mp_sgd_mom_update",
+                                   [weight, grad, mom, w32],
+                                   dict(momentum=self.momentum, **kw))
+            else:
+                invoke_with_arrays("mp_sgd_update", [weight, grad, w32], kw)
+            self._update_count(index)
+        else:
+            self.update(index, weight, grad, state)
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight.dtype == np.float16:
+            w32 = weight.astype("float32")
+            mom = None
+            if self.momentum != 0.0:
+                mom = zeros(weight.shape, dtype="float32", ctx=weight.context)
+            return (w32, mom)
+        return self.create_state(index, weight)
+
+
+@register
+class Signum(Optimizer):
+    """reference optimizer.py:540 — sign-SGD with momentum."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, dtype=weight.dtype, ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        if state is not None:
+            invoke_with_arrays("signum_update", [weight, grad, state],
+                               dict(momentum=self.momentum, wd_lh=self.wd_lh,
+                                    **kw))
+        else:
+            invoke_with_arrays("signsgd_update", [weight, grad], kw)
+
+
+@register
+class FTML(Optimizer):
+    """reference optimizer.py:602."""
+
+    def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        mk = lambda: zeros(weight.shape, dtype=weight.dtype, ctx=weight.context)
+        return (mk(), mk(), mk())  # d, v, z
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        d, v, z = state
+        g = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        v_t = self.beta2 * v + (1 - self.beta2) * g * g
+        b2c = 1 - self.beta2 ** t
+        b1c = 1 - self.beta1 ** t
+        d_t = (b1c / lr) * ((v_t / b2c).sqrt() + self.epsilon)
+        sigma = d_t - self.beta1 * d
+        z_t = self.beta1 * z + (1 - self.beta1) * g - sigma * weight
+        w_t = -1.0 * z_t / d_t
+        d._handle, v._handle, z._handle = d_t._handle, v_t._handle, z_t._handle
+        weight._handle = w_t._handle
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference optimizer.py:840)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (zeros(weight.shape, dtype=weight.dtype, ctx=weight.context),
+                weight.copy())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        mom, prev = state
+        comp = g + self.lamda * g * g * (weight - prev)
+        if mom is not None:
+            m = self.momentum * mom - lr * (comp + wd * weight)
+            mom._handle = m._handle
+            step = m
+        else:
+            step = -lr * (comp + wd * weight)
+        prev._handle = weight._handle
+        weight._handle = (weight + step)._handle
+
+
+@register
+class NAG(Optimizer):
+    """Nesterov accelerated SGD (reference optimizer.py:897)."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, dtype=weight.dtype, ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        if state is not None:
+            m = self.momentum * state + g
+            state._handle = m._handle
+            weight._handle = (weight - lr * (g + self.momentum * m))._handle
+        else:
+            weight._handle = (weight - lr * g)._handle
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (reference optimizer.py:949)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        from .ndarray import random as _rand
+        noise = _rand.normal(0, math.sqrt(lr), shape=weight.shape,
+                             dtype=weight.dtype)
+        weight._handle = (weight - lr / 2 * g + noise)._handle
+
+
+@register
+class Adam(Optimizer):
+    """reference optimizer.py:985; fused adam_update op with bias-corrected
+    lr folded in (matching optimizer_op.cc:354)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype=weight.dtype, ctx=weight.context),
+                zeros(weight.shape, dtype=weight.dtype, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        coef1 = 1. - self.beta1 ** t
+        coef2 = 1. - self.beta2 ** t
+        lr *= math.sqrt(coef2) / coef1
+        mean, var = state
+        kw = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                  beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon)
+        if self.clip_gradient is not None:
+            kw["clip_gradient"] = self.clip_gradient
+        invoke_with_arrays("adam_update", [weight, grad, mean, var], kw)
+
+
+@register
+class AdaGrad(Optimizer):
+    """reference optimizer.py:1067."""
+
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, dtype=weight.dtype, ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        hist = state
+        hist._handle = (hist + g * g)._handle
+        step = lr * (g / (hist + self.float_stable_eps).sqrt() + wd * weight)
+        weight._handle = (weight - step)._handle
+
+
+@register
+class RMSProp(Optimizer):
+    """reference optimizer.py:1135; fused ops."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1, self.gamma2 = gamma1, gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        mk = lambda: zeros(weight.shape, dtype=weight.dtype, ctx=weight.context)
+        if self.centered:
+            return (mk(), mk(), mk())  # n, g, delta
+        return (mk(),)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        kw.update(gamma1=self.gamma1, epsilon=self.epsilon)
+        if self.clip_weights:
+            kw["clip_weights"] = self.clip_weights
+        if self.centered:
+            n, g, delta = state
+            kw["gamma2"] = self.gamma2
+            invoke_with_arrays("rmspropalex_update",
+                               [weight, grad, n, g, delta], kw)
+        else:
+            (n,) = state
+            invoke_with_arrays("rmsprop_update", [weight, grad, n], kw)
+
+
+@register
+class AdaDelta(Optimizer):
+    """reference optimizer.py:1211."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype=weight.dtype, ctx=weight.context),
+                zeros(weight.shape, dtype=weight.dtype, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        acc_g, acc_delta = state
+        ag = self.rho * acc_g + (1. - self.rho) * g * g
+        delta = ((acc_delta + self.epsilon).sqrt() /
+                 (ag + self.epsilon).sqrt()) * g
+        ad = self.rho * acc_delta + (1. - self.rho) * delta * delta
+        acc_g._handle, acc_delta._handle = ag._handle, ad._handle
+        weight._handle = (weight - delta - wd * weight)._handle
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype=weight.dtype, ctx=weight.context),
+                zeros(weight.shape, dtype=weight.dtype, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        z, n = state
+        invoke_with_arrays("ftrl_update", [weight, grad, z, n],
+                           dict(lamda1=self.lamda1, beta=self.beta, **kw))
+
+
+@register
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype=weight.dtype, ctx=weight.context),
+                zeros(weight.shape, dtype=weight.dtype, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._get_lr(index) / (1. - self.beta1 ** t)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        m, u = state
+        from .ndarray import maximum as nd_max
+        m_t = self.beta1 * m + (1. - self.beta1) * g
+        u_t = nd_max(self.beta2 * u, g.abs())
+        m._handle, u._handle = m_t._handle, u_t._handle
+        weight._handle = (weight - lr * m_t / (u_t + 1e-8))._handle
+
+
+@register
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype=weight.dtype, ctx=weight.context),
+                zeros(weight.shape, dtype=weight.dtype, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        momentum_t = self.beta1 * (1. - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1. - 0.5 * 0.96 **
+                                     ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        m, v = state
+        g_prime = g / (1. - self.m_schedule)
+        m_t = self.beta1 * m + (1. - self.beta1) * g
+        m_t_prime = m_t / (1. - m_schedule_next)
+        v_t = self.beta2 * v + (1. - self.beta2) * g * g
+        v_t_prime = v_t / (1. - self.beta2 ** t)
+        m_t_bar = (1. - momentum_t) * g_prime + momentum_t_1 * m_t_prime
+        m._handle, v._handle = m_t._handle, v_t._handle
+        weight._handle = (weight - lr * m_t_bar /
+                          (v_t_prime.sqrt() + self.epsilon))._handle
+
+
+@register
+class Test(Optimizer):
+    def create_state(self, index, weight):
+        return zeros(weight.shape, dtype=weight.dtype, ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        weight._handle = (weight + grad * self.rescale_grad)._handle
+        state._handle = weight._handle
+
+
+create = Optimizer.create_optimizer
+
+
+class Updater:
+    """Closure applying an optimizer, used by kvstore (reference
+    optimizer.py get_updater)."""
+
+    def __init__(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state_multi_precision(
+                index, weight)
+            self.states_synced[index] = True
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def set_states(self, states):
+        self.states = pickle.loads(states) if isinstance(states, bytes) \
+            else states
+        self.states_synced = {k: False for k in self.states}
+
+    def get_states(self, dump_optimizer=False):
+        return pickle.dumps((self.states, self.optimizer)
+                            if dump_optimizer else self.states)
+
+
+def get_updater(optimizer: Optimizer) -> Updater:
+    return Updater(optimizer)

@@ -1,0 +1,64 @@
+"""Parallelism & distribution over TPU meshes.
+
+This package is the TPU-native replacement for the reference's entire
+distribution stack (SURVEY.md §2.3, §5.8): ps-lite/NCCL/CUDA-P2P become XLA
+collectives over a jax.sharding.Mesh (ICI intra-slice, DCN across slices).
+
+Modules:
+* mesh.py  — mesh construction + sharding helpers (dp/tp/pp/sp axes)
+* trainer.py — sharded data-parallel train step (the kvstore('tpu') engine)
+* ring.py  — ring-attention sequence parallelism (beyond-reference)
+* pipeline.py — pipeline parallelism via shard_map micro-batching
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .mesh import (MeshSpec, current_mesh, data_parallel_mesh, make_mesh,
+                   set_current_mesh, shard_batch, replicate)
+
+Topology = namedtuple("Topology", ["process_index", "process_count",
+                                   "local_device_count",
+                                   "global_device_count"])
+
+
+def topology() -> Topology:
+    return Topology(jax.process_index(), jax.process_count(),
+                    jax.local_device_count(), jax.device_count())
+
+
+def init_distributed(coordinator_address=None, num_processes=None,
+                     process_id=None):
+    """Multi-host bootstrap — the tracker/Postoffice analog (reference
+    tools/launch.py + ps::Postoffice).  On TPU pods the env provides the
+    coordination, so arguments are optional."""
+    if jax.process_count() > 1:
+        return  # already initialised by the runtime
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    except Exception:
+        pass  # single-process
+
+
+def barrier(name="kvstore_barrier"):
+    """Global barrier (reference KVStore::Barrier, kvstore.h:349)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(name)
+
+
+def allreduce_array(x):
+    """Sum an array across processes (DCN allreduce).  Within one process
+    the kvstore already reduced device copies; this extends the reduction
+    across hosts like the reference's server-side aggregation."""
+    if jax.process_count() == 1:
+        return x
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(x)
+    return jnp.sum(gathered, axis=0)

@@ -1,0 +1,84 @@
+"""Device-mesh construction and sharding helpers.
+
+The scaling-book recipe: pick a mesh (axes named for the parallelism kind),
+annotate shardings on program inputs/outputs, let XLA insert the
+collectives, profile, iterate.  Axis conventions used across mxnet_tpu:
+
+  'dp' — data parallel (batch dim)       → psum(grads) rides ICI
+  'tp' — tensor parallel (hidden dims)   → all_gather/reduce_scatter
+  'pp' — pipeline stages                 → ppermute
+  'sp' — sequence/context parallel       → ring collectives (ring.py)
+  'ep' — expert parallel (MoE)           → all_to_all
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["MeshSpec", "make_mesh", "data_parallel_mesh", "current_mesh",
+           "set_current_mesh", "shard_batch", "replicate", "P"]
+
+
+class MeshSpec:
+    """A mesh plus the axis layout used by the sharded trainer."""
+
+    def __init__(self, mesh: Mesh, dp_axis="dp", tp_axis=None, pp_axis=None,
+                 sp_axis=None, ep_axis=None):
+        self.mesh = mesh
+        self.dp_axis = dp_axis
+        self.tp_axis = tp_axis
+        self.pp_axis = pp_axis
+        self.sp_axis = sp_axis
+        self.ep_axis = ep_axis
+
+    @property
+    def dp_size(self):
+        return self.mesh.shape[self.dp_axis] if self.dp_axis else 1
+
+    def batch_sharding(self):
+        return NamedSharding(self.mesh, P(self.dp_axis))
+
+    def replicated(self):
+        return NamedSharding(self.mesh, P())
+
+
+_state = threading.local()
+
+
+def make_mesh(shape: Sequence[int], axis_names: Sequence[str],
+              devices=None) -> Mesh:
+    """Build a Mesh over the (global) device list, ICI-contiguous order."""
+    devices = devices if devices is not None else jax.devices()
+    n = int(np.prod(shape))
+    if n > len(devices):
+        raise ValueError("mesh of %d devices requested, %d available"
+                         % (n, len(devices)))
+    arr = np.array(devices[:n]).reshape(tuple(shape))
+    return Mesh(arr, tuple(axis_names))
+
+
+def data_parallel_mesh(num_devices: Optional[int] = None) -> MeshSpec:
+    devices = jax.devices()
+    n = num_devices or len(devices)
+    return MeshSpec(make_mesh((n,), ("dp",)))
+
+
+def current_mesh() -> Optional[MeshSpec]:
+    return getattr(_state, "mesh", None)
+
+
+def set_current_mesh(spec: Optional[MeshSpec]):
+    _state.mesh = spec
+
+
+def shard_batch(x, spec: MeshSpec):
+    """Place a host batch onto the mesh, sharded along dp."""
+    return jax.device_put(x, spec.batch_sharding())
+
+
+def replicate(x, spec: MeshSpec):
+    return jax.device_put(x, spec.replicated())

@@ -1,0 +1,175 @@
+"""ShardedTrainer — the SPMD training engine.
+
+This is the TPU-native replacement for the reference's entire data-parallel
+machinery: DataParallelExecutorGroup's per-device executors + KVStore
+reduce/broadcast (executor_group.py:129 + kvstore comm.h) collapse into ONE
+jitted step function over a jax Mesh:
+
+  params: replicated over 'dp' (or sharded over 'tp' when a tp axis exists)
+  batch:  sharded over 'dp'
+  step = forward → loss → grad (XLA inserts psum over dp) → optimizer update
+
+The gradient all-reduce rides ICI as a single fused psum — the kvstore
+'device'/'nccl' path taken to its limit.  Donated argnums make the update
+in-place in HBM.  Works identically on a CPU device mesh (tests) and a TPU
+pod slice (multi-host: same program, jax.distributed handles DCN).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..executor import GraphProgram
+from .mesh import MeshSpec
+
+__all__ = ["ShardedTrainer", "sgd_step_fn"]
+
+
+def _tree_sgd(params, grads, mom, lr, momentum, wd, rescale):
+    new_params = []
+    new_mom = []
+    for p, g, m in zip(params, grads, mom):
+        g = g.astype(jnp.float32) * rescale + wd * p
+        m2 = momentum * m - lr * g
+        new_params.append((p + m2).astype(p.dtype))
+        new_mom.append(m2)
+    return tuple(new_params), tuple(new_mom)
+
+
+class ShardedTrainer:
+    """One-program data-parallel trainer for a Symbol graph."""
+
+    def __init__(self, symbol, spec: MeshSpec, data_names=("data",),
+                 label_names=("softmax_label",), lr=0.01, momentum=0.9,
+                 wd=0.0001, loss_scale=1.0, param_dtype=None):
+        self.symbol = symbol
+        self.spec = spec
+        self.prog = GraphProgram(symbol)
+        self.data_names = list(data_names)
+        self.label_names = list(label_names)
+        self.input_names = self.data_names + self.label_names
+        self.param_names = [n for n in self.prog.arg_names
+                            if n not in self.input_names]
+        self.param_idx = [self.prog.arg_names.index(n)
+                          for n in self.param_names]
+        self.input_idx = {n: self.prog.arg_names.index(n)
+                          for n in self.input_names}
+        self.lr = lr
+        self.momentum = momentum
+        self.wd = wd
+        self.param_dtype = param_dtype
+        self._step = None
+
+    # -- state ------------------------------------------------------------
+    def init_state(self, shapes: Dict[str, tuple], initializer=None,
+                   seed=0):
+        """Initialise (params, mom, aux) replicated on the mesh."""
+        from ..executor import _resolve_structs
+        from ..initializer import Xavier, InitDesc
+        from ..ndarray.ndarray import NDArray
+        import numpy as _np
+        prog, known, _ = _resolve_structs(self.symbol, shapes)
+        initializer = initializer or Xavier(rnd_type="gaussian",
+                                            factor_type="in", magnitude=2)
+        rep = self.spec.replicated()
+        params = []
+        rs = _np.random.RandomState(seed)
+        for n in self.param_names:
+            s = known[n]
+            host = _np.zeros(s.shape, _np.float32)
+            arr = NDArray(jnp.asarray(host))
+            try:
+                initializer(InitDesc(n), arr)
+                host = arr.asnumpy()
+            except Exception:
+                pass
+            if self.param_dtype is not None and not n.endswith(
+                    ("gamma", "beta")):  # BN affine stays fp32
+                from ..base import dtype_np
+                dt = dtype_np(self.param_dtype)
+            else:
+                dt = s.dtype
+            params.append(jax.device_put(host.astype(dt), rep))
+        mom = tuple(jax.device_put(np.zeros(known[n].shape, np.float32), rep)
+                    for n in self.param_names)
+        aux = tuple(jax.device_put(
+            (np.zeros if "mean" in n else np.ones)(known[n].shape, np.float32),
+            rep) for n in self.prog.aux_names)
+        return tuple(params), mom, aux
+
+    # -- the step ---------------------------------------------------------
+    def _build_step(self, donate=True):
+        prog = self.prog
+        param_idx = list(self.param_idx)
+        input_idx = dict(self.input_idx)
+        lr, momentum, wd = self.lr, self.momentum, self.wd
+
+        def loss_fn(params, inputs, aux, keys):
+            args = [None] * len(prog.arg_names)
+            for i, p in zip(param_idx, params):
+                args[i] = p
+            for n, v in inputs.items():
+                args[input_idx[n]] = v
+            outs, new_aux = prog.evaluate(args, aux, keys, True)
+            # SoftmaxOutput-style heads carry their gradient via custom vjp;
+            # summing outputs triggers it exactly like executor backward
+            loss = sum(jnp.sum(o.astype(jnp.float32)) for o in outs)
+            return loss, (outs, new_aux)
+
+        def step_fn(params, mom, aux, inputs, keys):
+            (loss, (outs, new_aux)), grads = jax.value_and_grad(
+                loss_fn, argnums=0, has_aux=True)(params, inputs, aux, keys)
+            new_params, new_mom = _tree_sgd(
+                params, grads, mom, lr, momentum, wd, 1.0)
+            return new_params, new_mom, new_aux, loss
+
+        rep = self.spec.replicated()
+        bat = self.spec.batch_sharding()
+        in_shardings = (
+            tuple(rep for _ in self.param_names),   # params
+            tuple(rep for _ in self.param_names),   # mom
+            tuple(rep for _ in self.prog.aux_names),  # aux
+            {n: bat for n in self.input_names},     # batch
+            rep,                                    # keys
+        )
+        out_shardings = (
+            tuple(rep for _ in self.param_names),
+            tuple(rep for _ in self.param_names),
+            tuple(rep for _ in self.prog.aux_names),
+            rep,
+        )
+        with self.spec.mesh:
+            return jax.jit(step_fn, in_shardings=in_shardings,
+                           out_shardings=out_shardings,
+                           donate_argnums=(0, 1, 2) if donate else ())
+
+    def step(self, params, mom, aux, batch: Dict[str, np.ndarray]):
+        """One synchronous data-parallel SGD step.  batch arrays are global
+        (host) arrays; they get sharded over dp."""
+        if self._step is None:
+            self._step = self._build_step()
+        inputs = {n: jax.device_put(v, self.spec.batch_sharding())
+                  for n, v in batch.items()}
+        keys = self._keys()
+        return self._step(params, mom, aux, inputs, keys)
+
+    def _keys(self):
+        from .. import rng as _rng
+        rep = self.spec.replicated()
+        if self.prog.num_rng == 0:
+            return jax.device_put(jnp.zeros((0, 2), jnp.uint32), rep)
+        return jax.device_put(
+            jnp.stack([_rng.next_key() for _ in range(self.prog.num_rng)]),
+            rep)
+
+
+def sgd_step_fn(trainer: ShardedTrainer):
+    """Expose the raw jitted step (for dryrun/compile checks)."""
+    if trainer._step is None:
+        trainer._step = trainer._build_step(donate=False)
+    return trainer._step

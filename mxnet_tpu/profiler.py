@@ -1,0 +1,98 @@
+"""Profiler — per-op/step timing with Chrome-trace output.
+
+Reference: src/engine/profiler.{h,cc} (OprExecStat profiler.h:40, Chrome
+trace dump profiler.cc:147) + python/mxnet/profiler.py.
+
+TPU-natively the heavy lifting is jax.profiler (XPlane → TensorBoard /
+Perfetto).  This module keeps the reference's API (profiler_set_config /
+profiler_set_state / dump_profile) and ALSO emits a Chrome-trace JSON of
+python-level op dispatches so the "open chrome://tracing" UX survives.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+from typing import List, Optional
+
+import jax
+
+_state = {"mode": "symbolic", "filename": "profile.json", "running": False,
+          "events": [], "jax_dir": None, "lock": threading.Lock()}
+
+
+def profiler_set_config(mode="symbolic", filename="profile.json"):
+    """reference: MXSetProfilerConfig (c_api.h)."""
+    _state["mode"] = mode
+    _state["filename"] = filename
+
+
+def profiler_set_state(state="stop"):
+    """reference: MXSetProfilerState; 'run' | 'stop'."""
+    if state == "run" and not _state["running"]:
+        _state["running"] = True
+        _state["events"] = []
+        jax_dir = os.path.splitext(_state["filename"])[0] + "_xplane"
+        try:
+            jax.profiler.start_trace(jax_dir)
+            _state["jax_dir"] = jax_dir
+        except Exception:
+            _state["jax_dir"] = None
+    elif state == "stop" and _state["running"]:
+        _state["running"] = False
+        if _state["jax_dir"]:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+
+
+set_config = profiler_set_config
+set_state = profiler_set_state
+
+
+def record_event(name: str, start_us: float, dur_us: float, cat="operator"):
+    """Append one op event (called by instrumented dispatch paths)."""
+    if not _state["running"]:
+        return
+    with _state["lock"]:
+        _state["events"].append(
+            {"name": name, "cat": cat, "ph": "X", "ts": start_us,
+             "dur": dur_us, "pid": 0,
+             "tid": threading.get_ident() % 1000})
+
+
+def dump_profile():
+    """reference: MXDumpProfile — write Chrome trace JSON."""
+    with _state["lock"]:
+        trace = {"traceEvents": list(_state["events"]),
+                 "displayTimeUnit": "ms"}
+        with open(_state["filename"], "w") as f:
+            json.dump(trace, f)
+    return _state["filename"]
+
+
+dump = dump_profile
+
+
+class Scope:
+    """Context manager timing a region into the trace."""
+
+    def __init__(self, name, cat="python"):
+        self.name = name
+        self.cat = cat
+
+    def __enter__(self):
+        self._t0 = time.perf_counter() * 1e6
+        return self
+
+    def __exit__(self, *a):
+        t1 = time.perf_counter() * 1e6
+        record_event(self.name, self._t0, t1 - self._t0, self.cat)
+
+
+def trace_annotate(name):
+    """jax-level named region (shows in XPlane)."""
+    return jax.profiler.TraceAnnotation(name)

@@ -1,0 +1,1 @@
+"""Symbolic RNN cells (reference python/mxnet/rnn/)."""

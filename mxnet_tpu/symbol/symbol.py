@@ -412,7 +412,8 @@ def Variable(name: str, attr=None, shape=None, lr_mult=None, wd_mult=None,
     if shape is not None:
         attrs["__shape__"] = str(tuple(shape))
     if dtype is not None:
-        attrs["__dtype__"] = str(dtype)
+        from ..base import dtype_name
+        attrs["__dtype__"] = dtype_name(dtype)
     if lr_mult is not None:
         attrs["__lr_mult__"] = str(lr_mult)
     if wd_mult is not None:

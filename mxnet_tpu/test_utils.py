@@ -1,0 +1,407 @@
+"""Test helpers (reference python/mxnet/test_utils.py).
+
+Includes the backend-equivalence harness: the reference checks CPU-vs-GPU
+(`check_consistency`, test_utils.py:1208); here the same harness checks
+host-CPU (XLA:CPU) vs TPU and dtype crosses.
+"""
+from __future__ import annotations
+
+import numbers
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .base import dtype_np
+from .context import Context, cpu, current_context, tpu
+from .executor import Executor
+from .ndarray.ndarray import NDArray, array as nd_array, zeros as nd_zeros
+from .ndarray.sparse import CSRNDArray, RowSparseNDArray, csr_matrix, row_sparse_array
+from .symbol.symbol import Symbol
+
+_rng = np.random.RandomState(1234)
+
+
+def default_context() -> Context:
+    """reference test_utils.py:55"""
+    return current_context()
+
+
+def set_default_context(ctx: Context):
+    Context._default_ctx.value = ctx
+
+
+def default_dtype():
+    return np.float32
+
+
+def get_atol(atol=None):
+    return 1e-20 if atol is None else atol
+
+
+def get_rtol(rtol=None):
+    return 1e-5 if rtol is None else rtol
+
+
+def random_arrays(*shapes):
+    """Generate random float64 numpy arrays."""
+    arrays = [np.array(_rng.randn(), dtype=np.float64) if len(s) == 0
+              else _rng.randn(*s).astype(np.float64) for s in shapes]
+    if len(arrays) == 1:
+        return arrays[0]
+    return arrays
+
+
+def random_sample(population, k):
+    population_copy = population[:]
+    np.random.shuffle(population_copy)
+    return population_copy[0:k]
+
+
+def rand_sparse_ndarray(shape, stype, density=None, dtype=None,
+                        distribution="uniform"):
+    """reference test_utils.py:96"""
+    density = _rng.rand() if density is None else density
+    dtype = default_dtype() if dtype is None else dtype
+    if stype == "row_sparse":
+        idx_sample = _rng.rand(shape[0])
+        indices = np.argwhere(idx_sample < density).flatten()
+        if indices.shape[0] == 0:
+            return row_sparse_array(
+                (np.zeros((0,) + shape[1:], dtype=dtype),
+                 np.zeros((0,), np.int64)), shape=shape), (np.array([]),)
+        val = _rng.rand(indices.shape[0], *shape[1:]).astype(dtype)
+        arr = row_sparse_array((val, indices), shape=shape, dtype=dtype)
+        return arr, (val, indices)
+    if stype == "csr":
+        dense = _rng.rand(*shape)
+        dense[dense > density] = 0
+        arr = csr_matrix(dense.astype(dtype))
+        return arr, (arr.data.asnumpy(), arr.indices.asnumpy(),
+                     arr.indptr.asnumpy())
+    raise ValueError("unknown storage type " + stype)
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype=None,
+                 distribution="uniform"):
+    """reference test_utils.py:341"""
+    if stype == "default":
+        return nd_array(_rng.uniform(size=shape).astype(
+            dtype or default_dtype()))
+    arr, _ = rand_sparse_ndarray(shape, stype, density=density, dtype=dtype)
+    return arr
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return _rng.randint(1, dim0 + 1), _rng.randint(1, dim1 + 1)
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (_rng.randint(1, dim0 + 1), _rng.randint(1, dim1 + 1),
+            _rng.randint(1, dim2 + 1))
+
+
+def rand_shape_nd(num_dim, dim=10):
+    return tuple(_rng.randint(1, dim + 1, size=num_dim))
+
+
+def np_reduce(dat, axis, keepdims, numpy_reduce_func):
+    """reference test_utils.py np_reduce"""
+    if isinstance(axis, int):
+        axis = [axis]
+    else:
+        axis = list(axis) if axis is not None else range(len(dat.shape))
+    ret = dat
+    for i in reversed(sorted(axis)):
+        ret = numpy_reduce_func(ret, axis=i)
+    if keepdims:
+        keepdims_shape = list(dat.shape)
+        for i in axis:
+            keepdims_shape[i] = 1
+        ret = ret.reshape(tuple(keepdims_shape))
+    return ret
+
+
+def find_max_violation(a, b, rtol=None, atol=None):
+    rtol = get_rtol(rtol)
+    atol = get_atol(atol)
+    diff = np.abs(a - b)
+    tol = atol + rtol * np.abs(b)
+    violation = diff / (tol + 1e-20)
+    loc = np.argmax(violation)
+    idx = np.unravel_index(loc, violation.shape)
+    return idx, np.max(violation)
+
+
+def same(a, b):
+    return np.array_equal(a, b)
+
+
+def almost_equal(a, b, rtol=None, atol=None, equal_nan=False):
+    return np.allclose(a, b, rtol=get_rtol(rtol), atol=get_atol(atol),
+                       equal_nan=equal_nan)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b"),
+                        equal_nan=False):
+    """reference test_utils.py:472"""
+    rtol = get_rtol(rtol)
+    atol = get_atol(atol)
+    if isinstance(a, NDArray):
+        a = a.asnumpy()
+    if isinstance(b, NDArray):
+        b = b.asnumpy()
+    if almost_equal(a, b, rtol, atol, equal_nan=equal_nan):
+        return
+    index, rel = find_max_violation(np.asarray(a, np.float64),
+                                    np.asarray(b, np.float64), rtol, atol)
+    raise AssertionError(
+        "Error %f exceeds tolerance rtol=%f, atol=%f.  Location of maximum "
+        "error:%s, a=%f, b=%f" % (rel, rtol, atol, str(index),
+                                  np.asarray(a, np.float64)[index],
+                                  np.asarray(b, np.float64)[index]))
+
+
+def assert_exception(f, exception_type, *args, **kwargs):
+    try:
+        f(*args, **kwargs)
+        assert False
+    except exception_type:
+        return
+
+
+def simple_forward(sym, ctx=None, is_train=False, **inputs):
+    """reference test_utils.py simple_forward"""
+    npdict = {k: v for k, v in inputs.items()}
+    shapes = {k: v.shape for k, v in npdict.items()}
+    ex = Executor.simple_bind(sym, ctx or cpu(), **shapes)
+    for k, v in npdict.items():
+        ex.arg_dict[k][:] = v
+    ex.forward(is_train=is_train)
+    outputs = [x.asnumpy() for x in ex.outputs]
+    if len(outputs) == 1:
+        outputs = outputs[0]
+    return outputs
+
+
+def _parse_location(sym, location, ctx, dtype=None):
+    if isinstance(location, dict):
+        if set(location.keys()) != set(sym.list_arguments()):
+            raise ValueError(
+                "Symbol arguments and keys of location do not match. "
+                "symbol args:%s, location.keys():%s"
+                % (str(set(sym.list_arguments())), str(set(location.keys()))))
+        location = {k: location[k] for k in sym.list_arguments()}
+    else:
+        location = dict(zip(sym.list_arguments(), location))
+    return {k: nd_array(v, ctx=ctx, dtype=dtype if dtype else None)
+            if isinstance(v, np.ndarray) else v
+            for k, v in location.items()}
+
+
+def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
+                           rtol=1e-2, atol=None, grad_nodes=None,
+                           use_forward_train=True, ctx=None,
+                           grad_stype_dict=None, dtype=np.float64):
+    """Finite-difference gradient check (reference test_utils.py:794)."""
+    ctx = ctx or default_context()
+    location = _parse_location(sym, location, ctx)
+    loc_np = {k: v.asnumpy().astype(np.float64) for k, v in location.items()}
+    if grad_nodes is None:
+        grad_nodes = [k for k in location
+                      if not k.endswith("label")]
+
+    aux = None
+    if aux_states is not None:
+        aux = {k: nd_array(np.asarray(v)) for k, v in aux_states.items()}
+
+    def fwd(loc_arrays):
+        args = {k: nd_array(v.astype(np.float32)) for k, v in loc_arrays.items()}
+        ex = sym.bind(ctx, args,
+                      args_grad={k: nd_zeros(args[k].shape) for k in grad_nodes},
+                      grad_req={k: ("write" if k in grad_nodes else "null")
+                                for k in args},
+                      aux_states=aux)
+        outs = ex.forward(is_train=use_forward_train)
+        return ex, np.sum([o.asnumpy().astype(np.float64).sum() for o in outs])
+
+    # analytic grads
+    args = {k: nd_array(v.astype(np.float32)) for k, v in loc_np.items()}
+    grads = {k: nd_zeros(args[k].shape) for k in grad_nodes}
+    ex = sym.bind(ctx, args, args_grad=grads,
+                  grad_req={k: ("write" if k in grad_nodes else "null")
+                            for k in args},
+                  aux_states=aux)
+    ex.forward(is_train=use_forward_train)
+    ex.backward()
+    analytic = {k: grads[k].asnumpy().astype(np.float64) for k in grad_nodes}
+
+    for name in grad_nodes:
+        base = loc_np[name]
+        num_grad = np.zeros_like(base)
+        flat = base.reshape(-1)
+        ng_flat = num_grad.reshape(-1)
+        for i in range(flat.size):
+            old = flat[i]
+            flat[i] = old + numeric_eps / 2
+            _, fp = fwd(loc_np)
+            flat[i] = old - numeric_eps / 2
+            _, fm = fwd(loc_np)
+            flat[i] = old
+            ng_flat[i] = (fp - fm) / numeric_eps
+        assert_almost_equal(analytic[name], num_grad, rtol=rtol,
+                            atol=atol if atol is not None else 1e-3,
+                            names=("analytic_%s" % name, "numeric_%s" % name))
+
+
+def check_symbolic_forward(sym, location, expected, rtol=1e-5, atol=None,
+                           aux_states=None, ctx=None, equal_nan=False,
+                           dtype=np.float32):
+    """reference test_utils.py:926"""
+    ctx = ctx or default_context()
+    location = _parse_location(sym, location, ctx, dtype)
+    aux = None
+    if aux_states is not None:
+        if isinstance(aux_states, dict):
+            aux = {k: nd_array(np.asarray(v)) for k, v in aux_states.items()}
+        else:
+            aux = dict(zip(sym.list_auxiliary_states(),
+                           [nd_array(np.asarray(v)) for v in aux_states]))
+    ex = sym.bind(ctx, dict(location), aux_states=aux, grad_req="null")
+    outs = ex.forward(is_train=False)
+    if isinstance(expected, dict):
+        expected = [expected[k] for k in sym.list_outputs()]
+    for out, exp in zip(outs, expected):
+        assert_almost_equal(out.asnumpy(), exp, rtol=rtol, atol=atol,
+                            equal_nan=equal_nan)
+    return [o.asnumpy() for o in outs]
+
+
+def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-5,
+                            atol=None, aux_states=None, grad_req="write",
+                            ctx=None, grad_stypes=None, equal_nan=False,
+                            dtype=np.float32):
+    """reference test_utils.py:1030"""
+    ctx = ctx or default_context()
+    location = _parse_location(sym, location, ctx, dtype)
+    if isinstance(expected, (list, tuple)):
+        expected = dict(zip(sym.list_arguments(), expected))
+    greq = {k: (grad_req if isinstance(grad_req, str) else grad_req.get(k, "null"))
+            if k in expected else "null" for k in location}
+    grads = {k: nd_zeros(location[k].shape) for k in expected}
+    aux = None
+    if aux_states is not None:
+        if isinstance(aux_states, dict):
+            aux = {k: nd_array(np.asarray(v)) for k, v in aux_states.items()}
+        else:
+            aux = dict(zip(sym.list_auxiliary_states(),
+                           [nd_array(np.asarray(v)) for v in aux_states]))
+    ex = sym.bind(ctx, dict(location), args_grad=grads, grad_req=greq,
+                  aux_states=aux)
+    ex.forward(is_train=True)
+    og = [nd_array(np.asarray(g)) if not isinstance(g, NDArray) else g
+          for g in (out_grads if isinstance(out_grads, (list, tuple))
+                    else [out_grads])]
+    ex.backward(out_grads=og)
+    for name, exp in expected.items():
+        assert_almost_equal(grads[name].asnumpy(), exp, rtol=rtol, atol=atol,
+                            equal_nan=equal_nan)
+    return {k: v.asnumpy() for k, v in grads.items()}
+
+
+def check_consistency(sym, ctx_list, scale=1.0, grad_req="write",
+                      arg_params=None, aux_params=None, tol=None,
+                      raise_on_err=True, ground_truth=None, equal_nan=False,
+                      use_uniform=False):
+    """Backend-equivalence harness (reference test_utils.py:1208): run the
+    same symbol under each ctx/dtype spec and cross-check fwd + bwd."""
+    if tol is None:
+        tol = {np.dtype(np.float16): 1e-1, np.dtype(np.float32): 1e-3,
+               np.dtype(np.float64): 1e-5, np.dtype(np.uint8): 0,
+               np.dtype(np.int32): 0}
+    elif isinstance(tol, numbers.Number):
+        tol = {np.dtype(np.float16): tol, np.dtype(np.float32): tol,
+               np.dtype(np.float64): tol, np.dtype(np.uint8): tol,
+               np.dtype(np.int32): tol}
+
+    assert len(ctx_list) > 1
+    if isinstance(sym, Symbol):
+        sym = [sym] * len(ctx_list)
+    else:
+        assert len(sym) == len(ctx_list)
+
+    output_names = sym[0].list_outputs()
+    arg_names = sym[0].list_arguments()
+    exe_list = []
+    for s, ctx in zip(sym, ctx_list):
+        assert s.list_arguments() == arg_names
+        assert s.list_outputs() == output_names
+        exe_list.append(s.simple_bind(grad_req=grad_req, **ctx))
+
+    arg_params = {} if arg_params is None else arg_params
+    aux_params = {} if aux_params is None else aux_params
+    # init with the same values everywhere
+    exe0 = exe_list[0]
+    for name, arr in exe0.arg_dict.items():
+        if name in arg_params:
+            init_val = np.asarray(arg_params[name])
+        elif use_uniform:
+            init_val = np.random.uniform(-0.5, 0.5, size=arr.shape)
+        else:
+            init_val = np.random.normal(size=arr.shape) * scale
+        arg_params[name] = init_val
+    for name, arr in exe0.aux_dict.items():
+        if name not in aux_params:
+            aux_params[name] = 0
+    for exe in exe_list:
+        for name, arr in exe.arg_dict.items():
+            arr[:] = np.asarray(arg_params[name]).astype(arr.dtype)
+        for name, arr in exe.aux_dict.items():
+            arr[:] = aux_params[name]
+
+    dtypes = [np.dtype(exe.outputs[0].dtype) if exe.outputs else
+              np.dtype(exe.arg_arrays[0].dtype) for exe in exe_list]
+    # forward
+    for exe in exe_list:
+        exe.forward(is_train=False)
+    dtypes = [np.dtype(exe.outputs[0].dtype) for exe in exe_list]
+    max_idx = np.argmax([t.itemsize for t in dtypes])
+    gt = ground_truth
+    if gt is None:
+        gt = {n: v.asnumpy() for n, v in
+              zip(output_names, exe_list[max_idx].outputs)}
+    for i, exe in enumerate(exe_list):
+        if i == max_idx and ground_truth is None:
+            continue
+        rtol = atol = tol[dtypes[i]]
+        for name, out in zip(output_names, exe.outputs):
+            assert_almost_equal(out.asnumpy(), gt[name], rtol=rtol, atol=atol,
+                                equal_nan=equal_nan)
+    # backward
+    if grad_req != "null":
+        for exe in exe_list:
+            exe.forward(is_train=True)
+            exe.backward([nd_array(gt[n].astype(dtypes[i]))
+                          for i, n in enumerate(output_names[:len(exe.outputs)])]
+                         if False else None)
+        gt_grad = {n: v.asnumpy() for n, v in
+                   zip(arg_names, exe_list[max_idx].grad_arrays)
+                   if v is not None}
+        for i, exe in enumerate(exe_list):
+            if i == max_idx and ground_truth is None:
+                continue
+            rtol = atol = tol[dtypes[i]]
+            for name, garr in zip(arg_names, exe.grad_arrays):
+                if garr is None or name not in gt_grad:
+                    continue
+                assert_almost_equal(garr.asnumpy(), gt_grad[name],
+                                    rtol=rtol, atol=atol, equal_nan=equal_nan)
+    return gt
+
+
+def list_gpus():
+    from .context import num_gpus
+    return list(range(num_gpus()))
+
+
+def download(url, fname=None, dirname=None, overwrite=False):
+    raise RuntimeError("network access is not available in this environment")

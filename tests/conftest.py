@@ -1,0 +1,17 @@
+"""Test config: force XLA:CPU with 8 virtual devices so multi-device and
+mesh/sharding paths run without TPU hardware (SURVEY.md §4 — the analog of
+the reference's local-multiprocess dist testing trick).
+
+NOTE: in this environment the JAX_PLATFORMS env var is ignored (the axon
+TPU plugin wins), so the platform is forced via jax.config before any
+device is touched.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)

@@ -1,0 +1,134 @@
+"""Autograd tests (modeled on reference tests/python/unittest/test_autograd.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_basic_backward():
+    x = nd.array([[1.0, 2], [3, 4]])
+    x.attach_grad()
+    with ag.record():
+        y = (x * x + 2 * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad.asnumpy(), 2 * x.asnumpy() + 2)
+
+
+def test_chain():
+    x = nd.array(np.random.rand(3, 4).astype(np.float32))
+    x.attach_grad()
+    with ag.record():
+        y = nd.exp(nd.log(x + 1))
+        z = (y * y).sum()
+    z.backward()
+    assert_almost_equal(x.grad.asnumpy(), 2 * (x.asnumpy() + 1), rtol=1e-4)
+
+
+def test_multi_head():
+    x = nd.array([1.0, 2, 3])
+    x.attach_grad()
+    with ag.record():
+        a = x * 2
+        b = x * 3
+    ag.backward([a, b])
+    assert_almost_equal(x.grad.asnumpy(), np.full(3, 5.0))
+
+
+def test_head_grads():
+    x = nd.array([1.0, 2])
+    x.attach_grad()
+    with ag.record():
+        y = x * x
+    y.backward(out_grad=nd.array([2.0, 0.5]))
+    assert_almost_equal(x.grad.asnumpy(), np.array([4.0, 2.0]))
+
+
+def test_grad_add_req():
+    x = nd.array([1.0, 1])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with ag.record():
+            y = (x * 2).sum()
+        y.backward()
+    assert_almost_equal(x.grad.asnumpy(), np.full(2, 6.0))
+
+
+def test_pause_and_modes():
+    assert not ag.is_recording()
+    with ag.record():
+        assert ag.is_recording()
+        assert ag.is_training()
+        with ag.pause():
+            assert not ag.is_recording()
+        with ag.predict_mode():
+            assert not ag.is_training()
+    with ag.record(train_mode=False):
+        assert not ag.is_training()
+
+
+def test_detach():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    # z = const(4) * x -> dz/dx = 4
+    assert_almost_equal(x.grad.asnumpy(), np.array([4.0]))
+
+
+def test_grad_function():
+    x = nd.array([1.0, 2, 3])
+    g = ag.grad(_loss(x, record=True), x)
+    assert_almost_equal(g.asnumpy(), 2 * x.asnumpy())
+
+
+def _loss(x, record=False):
+    x.attach_grad()
+    with ag.record():
+        return (x * x).sum()
+
+
+def test_mark_variables():
+    x = nd.array([1.0, 4.0])
+    gbuf = nd.zeros((2,))
+    ag.mark_variables([x], [gbuf])
+    with ag.record():
+        y = (nd.sqrt(x)).sum()
+    y.backward()
+    assert_almost_equal(gbuf.asnumpy(), 0.5 / np.sqrt(x.asnumpy()), rtol=1e-4)
+
+
+def test_custom_function():
+    class Sigmoid(ag.Function):
+        def forward(self, x):
+            y = nd.sigmoid(x)
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            return dy * y * (1 - y)
+
+    x = nd.array(np.random.uniform(-3, 3, size=(5,)).astype(np.float32))
+    x.attach_grad()
+    f = Sigmoid()
+    with ag.record():
+        y = f(x)
+    y.backward()
+    s = 1 / (1 + np.exp(-x.asnumpy()))
+    assert_almost_equal(x.grad.asnumpy(), s * (1 - s), rtol=1e-4)
+
+
+def test_rng_op_under_autograd():
+    x = nd.ones((4, 4))
+    x.attach_grad()
+    with ag.record(train_mode=True):
+        y = nd.Dropout(x, p=0.5)
+        z = y.sum()
+    z.backward()
+    # grad equals the dropout mask scaling
+    g = x.grad.asnumpy()
+    assert set(np.unique(g)).issubset({0.0, 2.0})

@@ -1,0 +1,183 @@
+"""IO + metric + recordio tests (reference test_io.py / test_metric.py /
+test_recordio.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_ndarray_iter():
+    data = np.arange(40).reshape(10, 4).astype(np.float32)
+    labels = np.arange(10).astype(np.float32)
+    it = mx.io.NDArrayIter(data, labels, batch_size=3, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[0].data[0].shape == (3, 4)
+    assert batches[-1].pad == 2
+    it.reset()
+    assert len(list(it)) == 4
+    it2 = mx.io.NDArrayIter(data, labels, batch_size=5,
+                            last_batch_handle="discard")
+    assert len(list(it2)) == 2
+
+
+def test_ndarray_iter_dict_and_shuffle():
+    data = {"a": np.random.rand(8, 2), "b": np.random.rand(8, 3)}
+    it = mx.io.NDArrayIter(data, None, batch_size=4, shuffle=True)
+    names = [d.name for d in it.provide_data]
+    assert set(names) == {"a", "b"}
+    batch = next(it)
+    assert len(batch.data) == 2
+
+
+def test_resize_iter():
+    data = np.random.rand(10, 2).astype(np.float32)
+    it = mx.io.ResizeIter(mx.io.NDArrayIter(data, batch_size=2), size=7)
+    assert len(list(it)) == 7
+
+
+def test_prefetching_iter():
+    data = np.random.rand(16, 2).astype(np.float32)
+    base = mx.io.NDArrayIter(data, np.zeros(16, np.float32), batch_size=4)
+    it = mx.io.PrefetchingIter(base)
+    n = 0
+    for batch in it:
+        assert batch.data[0].shape == (4, 2)
+        n += 1
+    assert n == 4
+
+
+def test_csv_iter(tmp_path):
+    data = np.random.rand(10, 3)
+    np.savetxt(tmp_path / "d.csv", data, delimiter=",")
+    np.savetxt(tmp_path / "l.csv", np.arange(10), delimiter=",")
+    it = mx.io.CSVIter(data_csv=str(tmp_path / "d.csv"), data_shape=(3,),
+                       label_csv=str(tmp_path / "l.csv"), batch_size=5)
+    batch = next(it)
+    assert batch.data[0].shape == (5, 3)
+    assert_almost_equal(batch.data[0].asnumpy(), data[:5], rtol=1e-5)
+
+
+def test_libsvm_iter(tmp_path):
+    with open(tmp_path / "d.svm", "w") as f:
+        f.write("1 0:1.5 3:2.0\n0 1:1.0\n1 2:3.0 3:1.0\n0 0:0.5\n")
+    it = mx.io.LibSVMIter(data_libsvm=str(tmp_path / "d.svm"),
+                          data_shape=(4,), batch_size=2)
+    batch = next(it)
+    assert batch.data[0].stype == "csr"
+    dense = batch.data[0].asnumpy()
+    assert dense[0, 0] == 1.5 and dense[0, 3] == 2.0
+
+
+def test_recordio_roundtrip(tmp_path):
+    from mxnet_tpu import recordio
+    path = str(tmp_path / "t.rec")
+    rec = recordio.MXRecordIO(path, "w")
+    for i in range(5):
+        rec.write(b"record%d" % i)
+    rec.close()
+    rec = recordio.MXRecordIO(path, "r")
+    for i in range(5):
+        assert rec.read() == b"record%d" % i
+    assert rec.read() is None
+    rec.close()
+
+
+def test_indexed_recordio(tmp_path):
+    from mxnet_tpu import recordio
+    path = str(tmp_path / "t.rec")
+    idxp = str(tmp_path / "t.idx")
+    rec = recordio.MXIndexedRecordIO(idxp, path, "w")
+    for i in range(5):
+        rec.write_idx(i, b"rec%d" % i)
+    rec.close()
+    rec = recordio.MXIndexedRecordIO(idxp, path, "r")
+    assert rec.read_idx(3) == b"rec3"
+    assert rec.read_idx(0) == b"rec0"
+    assert rec.keys == list(range(5))
+
+
+def test_recordio_pack_unpack():
+    from mxnet_tpu import recordio
+    header = recordio.IRHeader(0, 2.0, 7, 0)
+    s = recordio.pack(header, b"payload")
+    h2, data = recordio.unpack(s)
+    assert h2.label == 2.0 and h2.id == 7 and data == b"payload"
+    header = recordio.IRHeader(0, np.array([1.0, 2, 3], np.float32), 1, 0)
+    s = recordio.pack(header, b"x")
+    h2, data = recordio.unpack(s)
+    assert (h2.label == [1, 2, 3]).all() and data == b"x"
+
+
+def test_accuracy_metric():
+    acc = mx.metric.create("acc")
+    pred = nd.array([[0.3, 0.7], [0.9, 0.1], [0.4, 0.6]])
+    label = nd.array([1, 0, 0])
+    acc.update([label], [pred])
+    assert acc.get()[1] == pytest.approx(2.0 / 3)
+    acc.reset()
+    assert np.isnan(acc.get()[1])
+
+
+def test_topk_f1_mse():
+    topk = mx.metric.create("top_k_accuracy", top_k=2)
+    pred = nd.array([[0.1, 0.5, 0.4], [0.8, 0.1, 0.1]])
+    label = nd.array([2, 1])
+    topk.update([label], [pred])
+    assert topk.get()[1] == pytest.approx(0.5)
+
+    mse = mx.metric.create("mse")
+    mse.update([nd.array([1.0, 2])], [nd.array([1.5, 2.5])])
+    assert mse.get()[1] == pytest.approx(0.25)
+
+    f1 = mx.metric.F1()
+    f1.update([nd.array([1, 0, 1, 1])],
+              [nd.array([[0.2, 0.8], [0.9, 0.1], [0.3, 0.7], [0.6, 0.4]])])
+    assert 0 < f1.get()[1] <= 1
+
+
+def test_perplexity_crossentropy():
+    pred = nd.array([[0.5, 0.5], [0.9, 0.1]])
+    label = nd.array([0, 0])
+    ce = mx.metric.create("ce")
+    ce.update([label], [pred])
+    expected = -(np.log(0.5) + np.log(0.9)) / 2
+    assert ce.get()[1] == pytest.approx(expected, rel=1e-4)
+    ppl = mx.metric.Perplexity(ignore_label=None)
+    ppl.update([label], [pred])
+    assert ppl.get()[1] == pytest.approx(np.exp(expected), rel=1e-4)
+
+
+def test_composite_and_custom():
+    comp = mx.metric.create(["acc", "mse"])
+    names, values = None, None
+    comp.update([nd.array([1, 1])], [nd.array([[0.1, 0.9], [0.2, 0.8]])])
+    out = dict(comp.get_name_value())
+    assert "accuracy" in out and "mse" in out
+
+    custom = mx.metric.np(lambda label, pred: float((label == 1).mean()))
+    custom.update([nd.array([1, 0])], [nd.array([[1.0], [0.0]])])
+    assert custom.get()[1] == pytest.approx(0.5)
+
+
+def test_mnist_iter(tmp_path):
+    # write tiny idx files
+    import struct
+    imgs = (np.random.rand(6, 28, 28) * 255).astype(np.uint8)
+    labels = np.arange(6, dtype=np.uint8)
+    with open(tmp_path / "img", "wb") as f:
+        f.write(struct.pack(">I", 0x00000803))
+        f.write(struct.pack(">III", 6, 28, 28))
+        f.write(imgs.tobytes())
+    with open(tmp_path / "lbl", "wb") as f:
+        f.write(struct.pack(">I", 0x00000801))
+        f.write(struct.pack(">I", 6))
+        f.write(labels.tobytes())
+    it = mx.io.MNISTIter(image=str(tmp_path / "img"),
+                         label=str(tmp_path / "lbl"),
+                         batch_size=2, shuffle=False)
+    batch = next(it)
+    assert batch.data[0].shape == (2, 1, 28, 28)
+    assert batch.data[0].asnumpy().max() <= 1.0

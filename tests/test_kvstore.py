@@ -1,0 +1,123 @@
+"""KVStore tests (reference tests/python/unittest/test_kvstore.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+SHAPE = (4, 4)
+KEYS = [5, 7, 11]
+
+
+def init_kv(kv_type="local"):
+    kv = mx.kv.create(kv_type)
+    kv.init(3, nd.zeros(SHAPE))
+    kv.init(KEYS, [nd.zeros(SHAPE)] * len(KEYS))
+    return kv
+
+
+def test_single_kv_pair():
+    kv = init_kv()
+    kv.push(3, nd.ones(SHAPE))
+    out = nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    assert_almost_equal(out.asnumpy(), np.ones(SHAPE))
+
+
+def test_list_kv_pair():
+    kv = init_kv()
+    kv.push(KEYS, [nd.ones(SHAPE) * 4] * len(KEYS))
+    out = [nd.empty(SHAPE)] * len(KEYS)
+    kv.pull(KEYS, out=out)
+    for o in out:
+        assert_almost_equal(o.asnumpy(), np.full(SHAPE, 4.0))
+
+
+def test_aggregator_multi_devs():
+    """Values from 4 'devices' are summed (reference test_kvstore.py
+    test_aggregator)."""
+    kv = init_kv("device")
+    num_devs = 4
+    devs = [mx.cpu(i) for i in range(num_devs)]
+    vals = [nd.ones(SHAPE, ctx=d) for d in devs]
+    kv.push(3, vals)
+    out = nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    assert_almost_equal(out.asnumpy(), np.full(SHAPE, num_devs))
+
+
+def test_updater():
+    kv = init_kv()
+
+    def updater(key, recv, local):
+        local += recv
+
+    kv.set_updater(updater)
+    kv.push(3, [nd.ones(SHAPE, ctx=mx.cpu(i)) for i in range(4)])
+    out = nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    assert_almost_equal(out.asnumpy(), np.full(SHAPE, 4.0))
+    # twice
+    kv.push(3, [nd.ones(SHAPE, ctx=mx.cpu(i)) for i in range(4)])
+    kv.pull(3, out=out)
+    assert_almost_equal(out.asnumpy(), np.full(SHAPE, 8.0))
+
+
+def test_set_optimizer_updates():
+    kv = init_kv()
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, rescale_grad=1.0))
+    kv.push(3, nd.ones(SHAPE))
+    out = nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    assert_almost_equal(out.asnumpy(), np.full(SHAPE, -0.1), rtol=1e-5)
+
+
+def test_sparse_row_pull():
+    kv = mx.kv.create("local")
+    from mxnet_tpu.ndarray.sparse import row_sparse_array
+    w = np.random.rand(8, 4).astype(np.float32)
+    kv.init("emb", nd.array(w))
+    out = row_sparse_array((np.zeros((2, 4), np.float32),
+                            np.array([0, 1])), shape=(8, 4))
+    kv.row_sparse_pull("emb", out=out, row_ids=nd.array([2, 5], dtype="int64"))
+    assert_almost_equal(out.data.asnumpy(), w[[2, 5]])
+
+
+def test_gradient_compression():
+    kv = mx.kv.create("device")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init(3, nd.zeros(SHAPE))
+    grad = np.full(SHAPE, 0.3, np.float32)
+    kv.push(3, nd.array(grad))
+    out = nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    # 0.3 < threshold → quantised to 0; residual kept
+    assert_almost_equal(out.asnumpy(), np.zeros(SHAPE))
+    kv.push(3, nd.array(grad))
+    kv.pull(3, out=out)
+    # residual 0.3 + 0.3 = 0.6 ≥ 0.5 → emits +0.5
+    assert_almost_equal(out.asnumpy(), np.full(SHAPE, 0.5))
+
+
+def test_strkey_and_rank():
+    kv = mx.kv.create("local")
+    kv.init("w0", nd.ones((2, 2)))
+    assert kv.rank == 0
+    assert kv.num_workers == 1
+    kv.barrier()
+    out = nd.empty((2, 2))
+    kv.pull("w0", out=out)
+    assert_almost_equal(out.asnumpy(), np.ones((2, 2)))
+
+
+def test_dist_kv_single_process():
+    """dist_sync degrades to local semantics in one process (the reference
+    needs a launcher; our DCN path activates under jax.distributed)."""
+    kv = mx.kv.create("dist_sync")
+    assert kv.num_workers == 1
+    kv.init(3, nd.zeros(SHAPE))
+    kv.push(3, nd.ones(SHAPE))
+    out = nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    assert_almost_equal(out.asnumpy(), np.ones(SHAPE))

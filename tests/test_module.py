@@ -1,0 +1,177 @@
+"""Module API tests (reference tests/python/unittest/test_module.py) +
+small end-to-end convergence (reference tests/python/train/test_mlp.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _mlp_sym():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def _toy_data(n=256, dim=16, nclass=4, seed=0):
+    rs = np.random.RandomState(seed)
+    labels = rs.randint(0, nclass, n)
+    X = rs.rand(n, dim).astype(np.float32) * 0.1
+    for i in range(n):
+        X[i, labels[i] * (dim // nclass):(labels[i] + 1) * (dim // nclass)] += 1
+    return X, labels.astype(np.float32)
+
+
+def test_module_bind_init_forward():
+    net = _mlp_sym()
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 16))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params(initializer=mx.init.Xavier())
+    batch = mx.io.DataBatch(data=[nd.ones((8, 16))],
+                            label=[nd.zeros((8,))])
+    mod.forward(batch, is_train=False)
+    out = mod.get_outputs()[0]
+    assert out.shape == (8, 4)
+    assert_almost_equal(out.asnumpy().sum(axis=1), np.ones(8), rtol=1e-4)
+
+
+def test_module_fit_converges():
+    X, y = _toy_data()
+    train = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=True)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            initializer=mx.init.Xavier(), num_epoch=5)
+    score = mod.score(mx.io.NDArrayIter(X, y, batch_size=32), "acc")
+    assert score[0][1] > 0.95, score
+
+
+def test_module_multi_device():
+    """Data-parallel over 2 virtual cpu devices (reference
+    DataParallelExecutorGroup path)."""
+    X, y = _toy_data()
+    train = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=True)
+    mod = mx.mod.Module(_mlp_sym(), context=[mx.cpu(0), mx.cpu(1)])
+    mod.fit(train, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            initializer=mx.init.Xavier(), num_epoch=5, kvstore="local")
+    score = mod.score(mx.io.NDArrayIter(X, y, batch_size=32), "acc")
+    assert score[0][1] > 0.95, score
+
+
+def test_module_checkpoint(tmp_path):
+    X, y = _toy_data()
+    train = mx.io.NDArrayIter(X, y, batch_size=32)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            initializer=mx.init.Xavier(), num_epoch=2)
+    prefix = str(tmp_path / "mlp")
+    mod.save_checkpoint(prefix, 2, save_optimizer_states=True)
+    ref = mod.score(mx.io.NDArrayIter(X, y, batch_size=32), "acc")[0][1]
+
+    mod2 = mx.mod.Module.load(prefix, 2, context=mx.cpu())
+    mod2.bind(data_shapes=train.provide_data,
+              label_shapes=train.provide_label, for_training=False)
+    got = mod2.score(mx.io.NDArrayIter(X, y, batch_size=32), "acc")[0][1]
+    assert got == ref
+
+
+def test_module_predict():
+    X, y = _toy_data(64)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=False)
+    mod.init_params()
+    out = mod.predict(it)
+    assert out.shape == (64, 4)
+
+
+def test_module_input_grads():
+    net = _mlp_sym()
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 16))],
+             label_shapes=[("softmax_label", (8,))],
+             for_training=True, inputs_need_grad=True)
+    mod.init_params()
+    batch = mx.io.DataBatch(data=[nd.ones((8, 16))], label=[nd.zeros((8,))])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    ig = mod.get_input_grads()[0]
+    assert ig.shape == (8, 16)
+    assert np.abs(ig.asnumpy()).sum() > 0
+
+
+def test_module_reshape():
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 16))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    batch = mx.io.DataBatch(data=[nd.ones((4, 16))], label=[nd.zeros((4,))])
+    mod.forward(batch, is_train=False)  # auto-reshape
+    assert mod.get_outputs()[0].shape == (4, 4)
+
+
+def test_module_update_on_kvstore_paths():
+    X, y = _toy_data()
+    for kv in ["local", "device", None]:
+        train = mx.io.NDArrayIter(X, y, batch_size=32)
+        mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+        mod.fit(train, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.5},
+                initializer=mx.init.Xavier(), num_epoch=3, kvstore=kv)
+        score = mod.score(mx.io.NDArrayIter(X, y, batch_size=32), "acc")
+        assert score[0][1] > 0.9, (kv, score)
+
+
+def test_bucketing_module():
+    """Variable-length 'sequences' via buckets sharing params."""
+    def sym_gen(seq_len):
+        # params independent of seq_len (like RNN/embedding models)
+        data = sym.Variable("data")
+        emb = sym.Embedding(data, input_dim=20, output_dim=8,
+                            name="emb_shared")
+        pooled = sym.mean(emb, axis=1)
+        net = sym.FullyConnected(pooled, num_hidden=2, name="out_shared")
+        net = sym.SoftmaxOutput(net, name="softmax")
+        return net, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=10,
+                                 context=mx.cpu())
+    rs = np.random.RandomState(0)
+
+    def make_batch(seq_len, bs=8):
+        return mx.io.DataBatch(
+            data=[nd.array(rs.randint(0, 20, (bs, seq_len)).astype(np.float32))],
+            label=[nd.array(rs.randint(0, 2, bs).astype(np.float32))],
+            bucket_key=seq_len,
+            provide_data=[mx.io.DataDesc("data", (bs, seq_len))],
+            provide_label=[mx.io.DataDesc("softmax_label", (bs,))])
+
+    mod.bind(data_shapes=[("data", (8, 10))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.1})
+    for seq_len in [10, 5, 7, 10, 5]:
+        batch = make_batch(seq_len)
+        mod.forward_backward(batch)
+        mod.update()
+    # parameters are shared across buckets
+    p5 = mod._buckets[5]._exec_group.execs[0].arg_dict["emb_shared_weight"]
+    p10 = mod._buckets[10]._exec_group.execs[0].arg_dict["emb_shared_weight"]
+    assert p5 is p10
+
+
+def test_feedforward_api():
+    X, y = _toy_data(256)
+    model = mx.model.FeedForward(_mlp_sym(), ctx=mx.cpu(), num_epoch=8,
+                                 learning_rate=0.5, numpy_batch_size=32)
+    model.fit(X, y)
+    preds = model.predict(X)
+    acc = (preds.argmax(1) == y).mean()
+    assert acc > 0.9, acc

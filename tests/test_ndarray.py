@@ -1,0 +1,203 @@
+"""NDArray tests (modeled on reference tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_creation():
+    a = nd.zeros((3, 4))
+    assert a.shape == (3, 4) and a.dtype == np.float32
+    assert (a.asnumpy() == 0).all()
+    b = nd.ones((2,), dtype="int32")
+    assert b.dtype == np.int32
+    c = nd.full((2, 2), 7.5)
+    assert (c.asnumpy() == 7.5).all()
+    d = nd.array([[1, 2], [3, 4]])
+    assert d.dtype == np.float32  # python list defaults to f32
+    e = nd.array(np.arange(4, dtype=np.float64))
+    assert e.dtype == np.float64
+    f = nd.arange(0, 10, 2)
+    assert (f.asnumpy() == np.arange(0, 10, 2)).all()
+    g = nd.eye(3)
+    assert (g.asnumpy() == np.eye(3)).all()
+
+
+def test_arithmetic():
+    a = nd.array([[1.0, 2], [3, 4]])
+    b = nd.array([[5.0, 6], [7, 8]])
+    assert_almost_equal((a + b).asnumpy(), np.array([[6, 8], [10, 12.]]))
+    assert_almost_equal((a - b).asnumpy(), np.array([[-4.0] * 2] * 2))
+    assert_almost_equal((a * 2 + 1).asnumpy(), np.array([[3, 5], [7, 9.]]))
+    assert_almost_equal((1 / a).asnumpy(), 1 / a.asnumpy(), rtol=1e-6)
+    assert_almost_equal((b % a).asnumpy(), np.array([[0, 0], [1, 0.]]))
+    assert_almost_equal((a ** 2).asnumpy(), a.asnumpy() ** 2)
+    assert_almost_equal((-a).asnumpy(), -a.asnumpy())
+    assert_almost_equal((2 - a).asnumpy(), 2 - a.asnumpy())
+    assert_almost_equal((2 ** a).asnumpy(), 2 ** a.asnumpy())
+
+
+def test_broadcast_arith():
+    a = nd.ones((3, 4))
+    b = nd.arange(0, 4).reshape((1, 4))
+    out = a + b
+    assert out.shape == (3, 4)
+    assert_almost_equal(out.asnumpy(), 1 + np.arange(4)[None, :] * np.ones((3, 4)))
+
+
+def test_comparison():
+    a = nd.array([1.0, 2, 3])
+    b = nd.array([3.0, 2, 1])
+    assert ((a == b).asnumpy() == [0, 1, 0]).all()
+    assert ((a > b).asnumpy() == [0, 0, 1]).all()
+    assert ((a >= 2).asnumpy() == [0, 1, 1]).all()
+
+
+def test_inplace():
+    a = nd.ones((2, 2))
+    aid = id(a)
+    a += 1
+    assert id(a) == aid
+    assert (a.asnumpy() == 2).all()
+    a *= 3
+    assert (a.asnumpy() == 6).all()
+
+
+def test_indexing():
+    a = nd.array(np.arange(12).reshape(3, 4))
+    assert (a[1].asnumpy() == [4, 5, 6, 7]).all()
+    assert (a[1:3].asnumpy() == np.arange(12).reshape(3, 4)[1:3]).all()
+    assert a[1, 2].asscalar() == 6
+    a[0] = 9
+    assert (a.asnumpy()[0] == 9).all()
+    a[1:3] = 0
+    assert (a.asnumpy()[1:] == 0).all()
+    idx = nd.array([0, 2], dtype="int32")
+    assert (a[idx].asnumpy() == a.asnumpy()[[0, 2]]).all()
+
+
+def test_shape_ops():
+    a = nd.array(np.arange(24).reshape(2, 3, 4))
+    assert a.reshape((6, 4)).shape == (6, 4)
+    assert a.reshape((-1, 4)).shape == (6, 4)
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert nd.Reshape(a, shape=(-3, 4)).shape == (6, 4)
+    assert nd.Reshape(a, shape=(-4, 1, 2, -2)).shape == (1, 2, 3, 4)
+    assert a.transpose().shape == (4, 3, 2)
+    assert a.transpose((1, 0, 2)).shape == (3, 2, 4)
+    assert a.flatten().shape == (2, 12)
+    assert a.expand_dims(0).shape == (1, 2, 3, 4)
+    assert a.swapaxes(0, 2).shape == (4, 3, 2)
+    assert nd.tile(a, reps=(2, 1, 1)).shape == (4, 3, 4)
+    assert nd.repeat(a, repeats=2, axis=1).shape == (2, 6, 4)
+    assert nd.squeeze(a.expand_dims(0), axis=0).shape == (2, 3, 4)
+
+
+def test_reduce():
+    x = np.random.rand(3, 4, 5).astype(np.float32)
+    a = nd.array(x)
+    assert_almost_equal(a.sum().asnumpy(), x.sum().reshape(1), rtol=1e-4)
+    assert_almost_equal(nd.sum(a, axis=1).asnumpy(), x.sum(1), rtol=1e-4)
+    assert_almost_equal(nd.sum(a, axis=(0, 2)).asnumpy(), x.sum((0, 2)), rtol=1e-4)
+    assert_almost_equal(nd.sum(a, axis=1, keepdims=True).asnumpy(),
+                        x.sum(1, keepdims=True), rtol=1e-4)
+    assert_almost_equal(nd.sum(a, axis=1, exclude=True).asnumpy(),
+                        x.sum((0, 2)), rtol=1e-4)
+    assert_almost_equal(nd.mean(a, axis=0).asnumpy(), x.mean(0), rtol=1e-4)
+    assert_almost_equal(nd.max(a, axis=2).asnumpy(), x.max(2))
+    assert_almost_equal(nd.min(a, axis=0).asnumpy(), x.min(0))
+    assert_almost_equal(nd.prod(a, axis=2).asnumpy(), x.prod(2), rtol=1e-4)
+
+
+def test_dot():
+    a = np.random.rand(4, 5).astype(np.float32)
+    b = np.random.rand(5, 6).astype(np.float32)
+    assert_almost_equal(nd.dot(nd.array(a), nd.array(b)).asnumpy(),
+                        a.dot(b), rtol=1e-4)
+    assert_almost_equal(
+        nd.dot(nd.array(a), nd.array(b.T), transpose_b=True).asnumpy(),
+        a.dot(b), rtol=1e-4)
+    assert_almost_equal(
+        nd.dot(nd.array(a.T), nd.array(b), transpose_a=True).asnumpy(),
+        a.dot(b), rtol=1e-4)
+    # batch_dot
+    x = np.random.rand(3, 4, 5).astype(np.float32)
+    y = np.random.rand(3, 5, 2).astype(np.float32)
+    assert_almost_equal(nd.batch_dot(nd.array(x), nd.array(y)).asnumpy(),
+                        np.matmul(x, y), rtol=1e-4)
+
+
+def test_concat_split_stack():
+    a = nd.ones((2, 3))
+    b = nd.zeros((2, 3))
+    c = nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    c2 = nd.Concat(a, b, dim=1)
+    assert c2.shape == (2, 6)
+    parts = nd.split(nd.array(np.arange(12).reshape(4, 3)), num_outputs=2, axis=0)
+    assert len(parts) == 2 and parts[0].shape == (2, 3)
+    s = nd.stack(a, b, axis=0, num_args=2)
+    assert s.shape == (2, 2, 3)
+
+
+def test_take_onehot():
+    w = nd.array(np.arange(20).reshape(10, 2))
+    idx = nd.array([1, 3, 5], dtype="int32")
+    out = nd.take(w, idx)
+    assert (out.asnumpy() == w.asnumpy()[[1, 3, 5]]).all()
+    oh = nd.one_hot(idx, depth=10)
+    assert oh.shape == (3, 10)
+    assert oh.asnumpy()[0, 1] == 1
+    emb = nd.Embedding(idx, w, input_dim=10, output_dim=2)
+    assert (emb.asnumpy() == w.asnumpy()[[1, 3, 5]]).all()
+
+
+def test_ordering():
+    x = np.random.rand(5, 10).astype(np.float32)
+    a = nd.array(x)
+    topv, topi = nd.topk(a, k=3, ret_typ="both")
+    ref = np.sort(x, axis=1)[:, ::-1][:, :3]
+    assert_almost_equal(topv.asnumpy(), ref, rtol=1e-5)
+    assert_almost_equal(nd.sort(a, axis=1).asnumpy(), np.sort(x, 1), rtol=1e-6)
+    assert (nd.argmax(a, axis=1).asnumpy() == x.argmax(1)).all()
+    assert (nd.argmin(a, axis=1).asnumpy() == x.argmin(1)).all()
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "t.params")
+    a = nd.array(np.random.rand(3, 3))
+    b = nd.array(np.random.rand(2,))
+    nd.save(fname, {"a": a, "b": b})
+    loaded = nd.load(fname)
+    assert set(loaded) == {"a", "b"}
+    assert_almost_equal(loaded["a"].asnumpy(), a.asnumpy())
+    nd.save(fname, [a, b])
+    lst = nd.load(fname)
+    assert len(lst) == 2
+    assert_almost_equal(lst[1].asnumpy(), b.asnumpy())
+
+
+def test_astype_copy_context():
+    a = nd.ones((2, 2))
+    b = a.astype("float64")
+    assert b.dtype == np.float64
+    c = a.copy()
+    c[0] = 5
+    assert (a.asnumpy() == 1).all()
+    d = a.as_in_context(mx.cpu())
+    assert d.context.device_type == "cpu"
+
+
+def test_clip_where_maximum():
+    x = np.array([-2, -1, 0, 1, 2], dtype=np.float32)
+    a = nd.array(x)
+    assert_almost_equal(nd.clip(a, a_min=-1, a_max=1).asnumpy(),
+                        np.clip(x, -1, 1))
+    assert_almost_equal(nd.maximum(a, 0).asnumpy(), np.maximum(x, 0))
+    assert_almost_equal(nd.minimum(a, 0).asnumpy(), np.minimum(x, 0))
+    cond = nd.array([1, 0, 1, 0, 1], dtype="float32")
+    y = nd.array(-x)
+    assert_almost_equal(nd.where(cond, a, y).asnumpy(),
+                        np.where(cond.asnumpy() != 0, x, -x))

@@ -1,0 +1,315 @@
+"""Operator correctness tests (modeled on reference test_operator.py),
+including finite-difference gradient checks."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.test_utils import (assert_almost_equal, check_numeric_gradient,
+                                  check_symbolic_forward, check_consistency)
+
+
+def test_unary_math():
+    x = np.random.rand(3, 4).astype(np.float32) + 0.5
+    a = nd.array(x)
+    for name, ref in [("sqrt", np.sqrt), ("exp", np.exp), ("log", np.log),
+                      ("square", np.square), ("abs", np.abs),
+                      ("sin", np.sin), ("cos", np.cos), ("tanh", np.tanh),
+                      ("floor", np.floor), ("ceil", np.ceil),
+                      ("sign", np.sign), ("log1p", np.log1p),
+                      ("expm1", np.expm1), ("rint", np.rint)]:
+        out = getattr(nd, name)(a)
+        assert_almost_equal(out.asnumpy(), ref(x), rtol=1e-4, atol=1e-6)
+    assert_almost_equal(nd.rsqrt(a).asnumpy(), 1 / np.sqrt(x), rtol=1e-4)
+    assert_almost_equal(nd.reciprocal(a).asnumpy(), 1 / x, rtol=1e-4)
+    assert_almost_equal(nd.sigmoid(a).asnumpy(), 1 / (1 + np.exp(-x)), rtol=1e-4)
+    assert_almost_equal(nd.relu(nd.array(x - 1)).asnumpy(),
+                        np.maximum(x - 1, 0), rtol=1e-5)
+
+
+def test_activation():
+    x = np.random.randn(2, 5).astype(np.float32)
+    for act, ref in [("relu", lambda v: np.maximum(v, 0)),
+                     ("sigmoid", lambda v: 1 / (1 + np.exp(-v))),
+                     ("tanh", np.tanh),
+                     ("softrelu", lambda v: np.log1p(np.exp(v)))]:
+        out = nd.Activation(nd.array(x), act_type=act)
+        assert_almost_equal(out.asnumpy(), ref(x), rtol=1e-4, atol=1e-5)
+
+
+def test_leaky_relu():
+    x = np.array([[-2.0, -1, 0, 1, 2]], dtype=np.float32)
+    out = nd.LeakyReLU(nd.array(x), act_type="leaky", slope=0.1)
+    assert_almost_equal(out.asnumpy(), np.where(x >= 0, x, 0.1 * x), rtol=1e-5)
+    out = nd.LeakyReLU(nd.array(x), act_type="elu", slope=1.0)
+    assert_almost_equal(out.asnumpy(), np.where(x >= 0, x, np.expm1(x)),
+                        rtol=1e-5)
+
+
+def test_softmax():
+    x = np.random.randn(4, 10).astype(np.float32)
+    out = nd.softmax(nd.array(x))
+    e = np.exp(x - x.max(-1, keepdims=True))
+    assert_almost_equal(out.asnumpy(), e / e.sum(-1, keepdims=True), rtol=1e-4)
+    lout = nd.log_softmax(nd.array(x))
+    assert_almost_equal(lout.asnumpy(), np.log(e / e.sum(-1, keepdims=True)),
+                        rtol=1e-3, atol=1e-5)
+
+
+def test_fully_connected():
+    x = np.random.rand(4, 3, 2).astype(np.float32)
+    w = np.random.rand(5, 6).astype(np.float32)
+    b = np.random.rand(5).astype(np.float32)
+    out = nd.FullyConnected(nd.array(x), nd.array(w), nd.array(b), num_hidden=5)
+    ref = x.reshape(4, 6).dot(w.T) + b
+    assert_almost_equal(out.asnumpy(), ref, rtol=1e-4)
+    out2 = nd.FullyConnected(nd.array(x.reshape(4, 6)), nd.array(w),
+                             num_hidden=5, no_bias=True)
+    assert_almost_equal(out2.asnumpy(), ref - b, rtol=1e-4)
+
+
+def test_convolution_vs_numpy():
+    # 1x1 conv equals matmul over channels
+    x = np.random.rand(2, 3, 5, 5).astype(np.float32)
+    w = np.random.rand(4, 3, 1, 1).astype(np.float32)
+    b = np.zeros(4, np.float32)
+    out = nd.Convolution(nd.array(x), nd.array(w), nd.array(b),
+                         kernel=(1, 1), num_filter=4)
+    ref = np.einsum("nchw,kc->nkhw", x, w[:, :, 0, 0])
+    assert_almost_equal(out.asnumpy(), ref, rtol=1e-4)
+
+
+def test_conv_grad_numeric():
+    data = sym.Variable("data")
+    net = sym.Convolution(data, kernel=(3, 3), num_filter=2, pad=(1, 1),
+                          name="conv")
+    x = np.random.rand(1, 2, 5, 5)
+    w = np.random.rand(2, 2, 3, 3)
+    b = np.random.rand(2)
+    check_numeric_gradient(net, {"data": x, "conv_weight": w, "conv_bias": b},
+                           numeric_eps=1e-2, rtol=0.1, atol=1e-2)
+
+
+def test_pooling():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    out = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                     pool_type="max")
+    assert_almost_equal(out.asnumpy(),
+                        np.array([[[[5, 7], [13, 15.]]]]))
+    out = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                     pool_type="avg")
+    assert_almost_equal(out.asnumpy(),
+                        np.array([[[[2.5, 4.5], [10.5, 12.5]]]]))
+    out = nd.Pooling(nd.array(x), global_pool=True, pool_type="max")
+    assert out.asnumpy().reshape(()) == 15
+
+
+def test_batchnorm_train_stats():
+    x = np.random.rand(8, 3, 4, 4).astype(np.float32) * 5
+    gamma = np.ones(3, np.float32)
+    beta = np.zeros(3, np.float32)
+    mm = nd.zeros((3,))
+    mv = nd.ones((3,))
+    with mx.autograd.train_mode():
+        out = nd.BatchNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                           mm, mv, fix_gamma=False, momentum=0.9)
+    # normalized output has ~zero mean / unit var per channel
+    o = out.asnumpy()
+    assert abs(o.mean(axis=(0, 2, 3))).max() < 1e-4
+    assert abs(o.var(axis=(0, 2, 3)) - 1).max() < 1e-2
+    # moving stats updated toward batch stats
+    assert (mm.asnumpy() != 0).all()
+
+
+def test_dropout_modes():
+    x = nd.ones((100, 100))
+    with mx.autograd.train_mode():
+        out = nd.Dropout(x, p=0.5)
+    frac = (out.asnumpy() == 0).mean()
+    assert 0.3 < frac < 0.7
+    out_eval = nd.Dropout(x, p=0.5)  # predict mode: identity
+    assert (out_eval.asnumpy() == 1).all()
+
+
+def test_softmax_output_grad():
+    """Backward must be softmax - onehot regardless of out_grad."""
+    data = sym.Variable("data")
+    label = sym.Variable("label")
+    net = sym.SoftmaxOutput(data, label, name="sm")
+    x = np.random.randn(4, 5).astype(np.float32)
+    y = np.array([0, 1, 2, 3], np.float32)
+    ex = net.bind(mx.cpu(), {"data": nd.array(x), "label": nd.array(y)},
+                  args_grad={"data": nd.zeros((4, 5))},
+                  grad_req={"data": "write", "label": "null"})
+    ex.forward(is_train=True)
+    ex.backward()
+    prob = np.exp(x) / np.exp(x).sum(-1, keepdims=True)
+    oh = np.eye(5, dtype=np.float32)[y.astype(int)]
+    assert_almost_equal(ex.grad_dict["data"].asnumpy(), prob - oh, rtol=1e-4,
+                        atol=1e-5)
+
+
+def test_regression_outputs():
+    x = np.random.randn(4, 3).astype(np.float32)
+    y = np.random.randn(4, 3).astype(np.float32)
+    data = sym.Variable("data")
+    label = sym.Variable("label")
+    net = sym.LinearRegressionOutput(data, label)
+    ex = net.bind(mx.cpu(), {"data": nd.array(x), "label": nd.array(y)},
+                  args_grad={"data": nd.zeros((4, 3))},
+                  grad_req={"data": "write", "label": "null"})
+    out = ex.forward(is_train=True)
+    assert_almost_equal(out[0].asnumpy(), x)
+    ex.backward()
+    assert_almost_equal(ex.grad_dict["data"].asnumpy(), (x - y) / 3, rtol=1e-4)
+
+
+def test_elemwise_grad_numeric():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    net = sym.elemwise_add(a * 2, sym.elemwise_mul(a, b))
+    check_numeric_gradient(net, {"a": np.random.rand(3, 3),
+                                 "b": np.random.rand(3, 3)},
+                           numeric_eps=1e-3, rtol=0.05, atol=1e-3)
+
+
+def test_reshape_infer_codes():
+    from mxnet_tpu.ops.matrix import infer_reshape
+    assert infer_reshape((2, 3, 4), (0, -1)) == (2, 12)
+    assert infer_reshape((2, 3, 4), (-1, 0), reverse=True) == (6, 4)
+    assert infer_reshape((2, 3, 4), (-2,)) == (2, 3, 4)
+    assert infer_reshape((2, 3, 4), (0, -3)) == (2, 12)
+    assert infer_reshape((2, 12), (0, -4, 3, 4)) == (2, 3, 4)
+    assert infer_reshape((2, 12), (0, -4, -1, 4)) == (2, 3, 4)
+
+
+def test_sequence_ops():
+    x = np.random.rand(4, 3, 2).astype(np.float32)  # (T, N, C)
+    seq_len = np.array([2, 4, 1], np.float32)
+    out = nd.SequenceMask(nd.array(x), nd.array(seq_len),
+                          use_sequence_length=True, value=-1)
+    o = out.asnumpy()
+    assert (o[2:, 0] == -1).all() and (o[1:, 2] == -1).all()
+    assert_almost_equal(o[:2, 1], x[:2, 1])
+    last = nd.SequenceLast(nd.array(x), nd.array(seq_len),
+                           use_sequence_length=True)
+    assert_almost_equal(last.asnumpy()[0], x[1, 0])
+    assert_almost_equal(last.asnumpy()[1], x[3, 1])
+    rev = nd.SequenceReverse(nd.array(x), nd.array(seq_len),
+                             use_sequence_length=True)
+    assert_almost_equal(rev.asnumpy()[0, 0], x[1, 0])
+    assert_almost_equal(rev.asnumpy()[1, 0], x[0, 0])
+    assert_almost_equal(rev.asnumpy()[2, 0], x[2, 0])
+
+
+def test_rnn_op_shapes():
+    T, N, C, H, L = 5, 3, 4, 6, 2
+    from mxnet_tpu.ops.rnn import rnn_param_size
+    for mode in ["rnn_tanh", "gru", "lstm"]:
+        nparam = rnn_param_size(L, C, H, False, mode)
+        data = nd.array(np.random.rand(T, N, C).astype(np.float32))
+        params = nd.array(np.random.rand(nparam).astype(np.float32) * 0.1)
+        state = nd.zeros((L, N, H))
+        if mode == "lstm":
+            out, hN, cN = nd.RNN(data, params, state, nd.zeros((L, N, H)),
+                                 state_size=H, num_layers=L, mode=mode,
+                                 state_outputs=True)
+            assert cN.shape == (L, N, H)
+        else:
+            out, hN = nd.RNN(data, params, state, state_size=H, num_layers=L,
+                             mode=mode, state_outputs=True)
+        assert out.shape == (T, N, H)
+        assert hN.shape == (L, N, H)
+    # bidirectional
+    nparam = rnn_param_size(1, C, H, True, "lstm")
+    out = nd.RNN(nd.array(np.random.rand(T, N, C).astype(np.float32)),
+                 nd.array(np.random.rand(nparam).astype(np.float32) * 0.1),
+                 nd.zeros((2, N, H)), nd.zeros((2, N, H)),
+                 state_size=H, num_layers=1, mode="lstm", bidirectional=True)
+    assert out.shape == (T, N, 2 * H)
+
+
+def test_lstm_matches_manual():
+    """Single-layer LSTM against a hand-rolled numpy step."""
+    T, N, C, H = 3, 2, 4, 5
+    from mxnet_tpu.ops.rnn import rnn_param_size
+    nparam = rnn_param_size(1, C, H, False, "lstm")
+    rng = np.random.RandomState(0)
+    params = rng.rand(nparam).astype(np.float32) * 0.2 - 0.1
+    x = rng.rand(T, N, C).astype(np.float32)
+    out = nd.RNN(nd.array(x), nd.array(params), nd.zeros((1, N, H)),
+                 nd.zeros((1, N, H)), state_size=H, num_layers=1, mode="lstm")
+    wx = params[:4 * H * C].reshape(4 * H, C)
+    wh = params[4 * H * C:4 * H * C + 4 * H * H].reshape(4 * H, H)
+    bx = params[4 * H * (C + H):4 * H * (C + H) + 4 * H]
+    bh = params[4 * H * (C + H) + 4 * H:]
+    sigmoid = lambda v: 1 / (1 + np.exp(-v))
+    h = np.zeros((N, H), np.float32)
+    c = np.zeros((N, H), np.float32)
+    outs = []
+    for t in range(T):
+        g = x[t] @ wx.T + bx + h @ wh.T + bh
+        i, f, gg, o = np.split(g, 4, axis=-1)
+        c = sigmoid(f) * c + sigmoid(i) * np.tanh(gg)
+        h = sigmoid(o) * np.tanh(c)
+        outs.append(h.copy())
+    assert_almost_equal(out.asnumpy(), np.stack(outs), rtol=1e-4, atol=1e-5)
+
+
+def test_check_consistency_dtypes():
+    """The backend-equivalence harness: same op, float32 vs float64."""
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=8, name="fc")
+    ctx_list = [
+        {"ctx": mx.cpu(), "data": (4, 10), "type_dict": {"data": np.float64}},
+        {"ctx": mx.cpu(), "data": (4, 10), "type_dict": {"data": np.float32}},
+    ]
+    check_consistency(net, ctx_list)
+
+
+def test_linalg_ops():
+    a = np.random.rand(3, 3).astype(np.float32)
+    spd = a @ a.T + 3 * np.eye(3, dtype=np.float32)
+    l = nd.linalg_potrf(nd.array(spd))
+    assert_almost_equal(l.asnumpy() @ l.asnumpy().T, spd, rtol=1e-3)
+    sld = nd.linalg_sumlogdiag(nd.array(spd))
+    assert_almost_equal(sld.asnumpy(),
+                        np.log(np.diag(spd)).sum().reshape(sld.shape),
+                        rtol=1e-4)
+    x = np.random.rand(2, 3).astype(np.float32)
+    y = np.random.rand(3, 4).astype(np.float32)
+    c = np.random.rand(2, 4).astype(np.float32)
+    out = nd.linalg_gemm(nd.array(x), nd.array(y), nd.array(c),
+                         alpha=2.0, beta=0.5)
+    assert_almost_equal(out.asnumpy(), 2 * x @ y + 0.5 * c, rtol=1e-4)
+
+
+def test_gather_scatter():
+    data = np.random.rand(4, 5).astype(np.float32)
+    indices = np.array([[1, 3], [2, 4]], np.int32)
+    out = nd.gather_nd(nd.array(data), nd.array(indices, dtype="int32"))
+    assert_almost_equal(out.asnumpy(), data[[1, 3], [2, 4]])
+    sc = nd.scatter_nd(out, nd.array(indices, dtype="int32"), shape=(4, 5))
+    ref = np.zeros((4, 5), np.float32)
+    ref[[1, 3], [2, 4]] = data[[1, 3], [2, 4]]
+    assert_almost_equal(sc.asnumpy(), ref)
+
+
+def test_pick_batch_take():
+    x = np.random.rand(4, 6).astype(np.float32)
+    idx = np.array([0, 2, 5, 1], np.float32)
+    out = nd.pick(nd.array(x), nd.array(idx))
+    assert_almost_equal(out.asnumpy(), x[np.arange(4), idx.astype(int)])
+    bt = nd.batch_take(nd.array(x), nd.array(idx, dtype="int32"))
+    assert_almost_equal(bt.asnumpy(), x[np.arange(4), idx.astype(int)])
+
+
+def test_cast_block_grad():
+    a = nd.array([1.5, 2.5])
+    assert nd.Cast(a, dtype="int32").dtype == np.int32
+    v = nd.array([1.0, 2.0])
+    v.attach_grad()
+    with mx.autograd.record():
+        out = (nd.BlockGrad(v) * v).sum()
+    out.backward()
+    assert_almost_equal(v.grad.asnumpy(), v.asnumpy())  # only one path flows

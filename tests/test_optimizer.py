@@ -1,0 +1,155 @@
+"""Optimizer tests vs numpy references (reference test_optimizer.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import optimizer as opt
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _step(optimizer, w0, g0, nsteps=3):
+    w = nd.array(w0.copy())
+    state = optimizer.create_state(0, w)
+    for _ in range(nsteps):
+        optimizer.update(0, w, nd.array(g0), state)
+    return w.asnumpy()
+
+
+def test_sgd():
+    w0 = np.random.rand(4, 3).astype(np.float32)
+    g0 = np.random.rand(4, 3).astype(np.float32)
+    got = _step(opt.SGD(learning_rate=0.1, rescale_grad=1.0, wd=0.0), w0, g0, 1)
+    assert_almost_equal(got, w0 - 0.1 * g0, rtol=1e-5)
+
+
+def test_sgd_momentum_wd():
+    w0 = np.random.rand(5).astype(np.float32)
+    g0 = np.random.rand(5).astype(np.float32)
+    lr, mom, wd = 0.1, 0.9, 0.01
+    got = _step(opt.SGD(learning_rate=lr, momentum=mom, wd=wd,
+                        rescale_grad=1.0), w0, g0, 3)
+    w = w0.copy()
+    v = np.zeros_like(w)
+    for _ in range(3):
+        v = mom * v - lr * (g0 + wd * w)
+        w = w + v
+    assert_almost_equal(got, w, rtol=1e-5)
+
+
+def test_adam():
+    w0 = np.random.rand(6).astype(np.float32)
+    g0 = np.random.rand(6).astype(np.float32)
+    o = opt.Adam(learning_rate=0.01, rescale_grad=1.0)
+    got = _step(o, w0, g0, 2)
+    # numpy reference (bias-corrected lr form used by the fused op)
+    w = w0.copy()
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    for t in range(1, 3):
+        lr = 0.01 * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        m = b1 * m + (1 - b1) * g0
+        v = b2 * v + (1 - b2) * g0 * g0
+        w = w - lr * m / (np.sqrt(v) + eps)
+    assert_almost_equal(got, w, rtol=1e-4)
+
+
+def test_rmsprop():
+    w0 = np.random.rand(4).astype(np.float32)
+    g0 = np.random.rand(4).astype(np.float32)
+    o = opt.RMSProp(learning_rate=0.01, gamma1=0.9, rescale_grad=1.0)
+    got = _step(o, w0, g0, 2)
+    w = w0.copy()
+    n = np.zeros_like(w)
+    for _ in range(2):
+        n = 0.1 * g0 * g0 + 0.9 * n
+        w = w - 0.01 * g0 / np.sqrt(n + 1e-8)
+    assert_almost_equal(got, w, rtol=1e-4)
+
+
+def test_signum():
+    w0 = np.random.rand(4).astype(np.float32)
+    g0 = np.random.randn(4).astype(np.float32)
+    o = opt.Signum(learning_rate=0.1, momentum=0.0, rescale_grad=1.0, wd=0.0)
+    got = _step(o, w0, g0, 1)
+    assert_almost_equal(got, w0 - 0.1 * np.sign(g0), rtol=1e-5)
+
+
+def test_adagrad_adadelta_ftrl_run():
+    w0 = np.random.rand(4).astype(np.float32)
+    g0 = np.random.rand(4).astype(np.float32)
+    for o in [opt.AdaGrad(learning_rate=0.1, rescale_grad=1.0),
+              opt.AdaDelta(rescale_grad=1.0),
+              opt.Ftrl(rescale_grad=1.0),
+              opt.Adamax(rescale_grad=1.0),
+              opt.Nadam(rescale_grad=1.0),
+              opt.NAG(learning_rate=0.1, momentum=0.9, rescale_grad=1.0),
+              opt.FTML(rescale_grad=1.0),
+              opt.DCASGD(rescale_grad=1.0),
+              opt.SGLD(rescale_grad=1.0)]:
+        got = _step(o, w0, g0, 2)
+        assert got.shape == w0.shape
+        assert not np.allclose(got, w0)  # moved
+
+
+def test_lr_wd_mult():
+    o = opt.SGD(learning_rate=0.1,
+                param_idx2name={0: "w_weight", 1: "b_bias"})
+    o.set_lr_mult({"w_weight": 2.0})
+    o.set_wd_mult({})
+    assert o._get_lr(0) == pytest.approx(0.2)
+    assert o._get_lr(1) == pytest.approx(0.1)
+    # bias gets wd 0 by default naming rule
+    assert o._get_wd(1) == 0.0
+
+
+def test_clip_gradient():
+    w0 = np.zeros(3, np.float32)
+    g0 = np.array([10.0, -10, 0.1], np.float32)
+    o = opt.SGD(learning_rate=1.0, rescale_grad=1.0, clip_gradient=1.0)
+    got = _step(o, w0, g0, 1)
+    assert_almost_equal(got, -np.array([1.0, -1, 0.1]), rtol=1e-5)
+
+
+def test_lr_scheduler():
+    from mxnet_tpu.lr_scheduler import (FactorScheduler, MultiFactorScheduler,
+                                        PolyScheduler)
+    s = FactorScheduler(step=10, factor=0.5)
+    s.base_lr = 1.0
+    assert s(1) == 1.0
+    assert s(11) == 0.5
+    assert s(21) == 0.25
+    m = MultiFactorScheduler(step=[5, 15], factor=0.1)
+    m.base_lr = 1.0
+    assert m(1) == 1.0
+    assert m(6) == pytest.approx(0.1)
+    assert m(16) == pytest.approx(0.01)
+    p = PolyScheduler(max_update=100, base_lr=1.0, pwr=1)
+    assert p(0) == 1.0
+    assert p(50) == pytest.approx(0.5)
+
+
+def test_multi_precision():
+    w0 = np.random.rand(4).astype(np.float16)
+    g0 = np.random.rand(4).astype(np.float16)
+    o = opt.SGD(learning_rate=0.1, momentum=0.9, multi_precision=True,
+                rescale_grad=1.0)
+    w = nd.array(w0)
+    state = o.create_state_multi_precision(0, w)
+    assert state[0].dtype == np.float32  # fp32 master weight
+    o.update_multi_precision(0, w, nd.array(g0), state)
+    assert w.dtype == np.float16
+
+
+def test_updater_states_roundtrip():
+    o = opt.SGD(learning_rate=0.1, momentum=0.9, rescale_grad=1.0)
+    u = opt.get_updater(o)
+    w = nd.array(np.random.rand(3).astype(np.float32))
+    g = nd.array(np.random.rand(3).astype(np.float32))
+    u(0, g, w)
+    blob = u.get_states()
+    u2 = opt.get_updater(opt.SGD(learning_rate=0.1, momentum=0.9,
+                                 rescale_grad=1.0))
+    u2.set_states(blob)
+    assert 0 in u2.states

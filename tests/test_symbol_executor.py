@@ -1,0 +1,140 @@
+"""Symbol + Executor tests (reference test_symbol.py / test_executor.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.executor import Executor, infer_shapes
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _mlp():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=10, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_compose_and_lists():
+    net = _mlp()
+    assert net.list_arguments() == ["data", "fc1_weight", "fc1_bias",
+                                    "fc2_weight", "fc2_bias", "softmax_label"]
+    assert net.list_outputs() == ["softmax_output"]
+    assert net.name == "softmax"
+    internals = net.get_internals()
+    assert "fc1_output" in internals.list_outputs()
+    fc1 = internals["fc1_output"]
+    assert fc1.list_arguments() == ["data", "fc1_weight", "fc1_bias"]
+
+
+def test_infer_shape():
+    net = _mlp()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(8, 100))
+    assert arg_shapes[1] == (16, 100)
+    assert arg_shapes[3] == (10, 16)
+    assert out_shapes == [(8, 10)]
+    a2, o2, _ = net.infer_shape(data=(32, 50))
+    assert a2[1] == (16, 50) and o2 == [(32, 10)]
+
+
+def test_infer_shape_partial():
+    data = sym.Variable("data")
+    out = sym.FullyConnected(data, num_hidden=4, name="fc")
+    arg_shapes, out_shapes, _ = out.infer_shape_partial()
+    assert out_shapes[0] is None
+
+
+def test_symbol_arithmetic_and_json():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = (a + b * 2) / (a - 1)
+    js = c.tojson()
+    c2 = sym.load_json(js)
+    assert c2.list_arguments() == c.list_arguments()
+    ex = c2.bind(mx.cpu(), {"a": nd.array([4.0]), "b": nd.array([3.0])})
+    out = ex.forward()
+    assert_almost_equal(out[0].asnumpy(), np.array([10.0 / 3]), rtol=1e-5)
+
+
+def test_group_and_multiouts():
+    a = sym.Variable("a")
+    s1 = a * 2
+    s2 = a + 1
+    g = sym.Group([s1, s2])
+    assert len(g.list_outputs()) == 2
+    ex = g.bind(mx.cpu(), {"a": nd.array([1.0, 2])})
+    outs = ex.forward()
+    assert_almost_equal(outs[0].asnumpy(), [2, 4.0])
+    assert_almost_equal(outs[1].asnumpy(), [2, 3.0])
+
+
+def test_attr_scope_and_attrs():
+    with mx.AttrScope(ctx_group="dev1"):
+        a = sym.Variable("a")
+    assert a.attr("ctx_group") == "dev1"
+    b = sym.Variable("b", shape=(3, 4))
+    arg_shapes, _, _ = (b * 2).infer_shape()
+    assert arg_shapes[0] == (3, 4)
+
+
+def test_executor_backward_grad_req():
+    x = sym.Variable("x")
+    y = sym.Variable("y")
+    net = (x * y).sum()
+    xv = nd.array(np.random.rand(3, 3).astype(np.float32))
+    yv = nd.array(np.random.rand(3, 3).astype(np.float32))
+    gx = nd.zeros((3, 3))
+    ex = net.bind(mx.cpu(), {"x": xv, "y": yv},
+                  args_grad={"x": gx},
+                  grad_req={"x": "write", "y": "null"})
+    ex.forward(is_train=True)
+    ex.backward()
+    assert_almost_equal(gx.asnumpy(), yv.asnumpy())
+    # add req accumulates
+    ex2 = net.bind(mx.cpu(), {"x": xv, "y": yv}, args_grad={"x": gx},
+                   grad_req={"x": "add", "y": "null"})
+    ex2.forward(is_train=True)
+    ex2.backward()
+    assert_almost_equal(gx.asnumpy(), 2 * yv.asnumpy(), rtol=1e-5)
+
+
+def test_simple_bind_and_run_fwd_bwd():
+    net = _mlp()
+    ex = Executor.simple_bind(net, mx.cpu(), data=(4, 20), softmax_label=(4,))
+    for name, arr in ex.arg_dict.items():
+        if name.endswith("weight"):
+            arr[:] = np.random.uniform(-0.1, 0.1, arr.shape)
+    ex.arg_dict["data"][:] = np.random.rand(4, 20)
+    ex.arg_dict["softmax_label"][:] = [0, 1, 2, 3]
+    outs = ex.run_fwd_bwd(is_train=True)
+    assert outs[0].shape == (4, 10)
+    assert np.abs(ex.grad_dict["fc1_weight"].asnumpy()).sum() > 0
+
+
+def test_executor_reshape():
+    net = _mlp()
+    ex = Executor.simple_bind(net, mx.cpu(), data=(4, 20), softmax_label=(4,))
+    ex2 = ex.reshape(data=(8, 20), softmax_label=(8,))
+    ex2.arg_dict["data"][:] = np.random.rand(8, 20)
+    out = ex2.forward()
+    assert out[0].shape == (8, 10)
+
+
+def test_eval_shortcut():
+    a = sym.Variable("a")
+    out = (a * 3).eval(a=nd.array([1.0, 2]))
+    assert_almost_equal(out[0].asnumpy(), [3, 6.0])
+
+
+def test_save_load_file(tmp_path):
+    net = _mlp()
+    fname = str(tmp_path / "net.json")
+    net.save(fname)
+    net2 = mx.sym.load(fname)
+    assert net2.tojson() == net.tojson()
+
+
+def test_variable_init_attr():
+    w = sym.Variable("w", lr_mult=2.0, wd_mult=0.5)
+    assert w.attr("__lr_mult__") == "2.0"
+    assert w.attr("__wd_mult__") == "0.5"
