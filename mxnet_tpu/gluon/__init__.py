@@ -1,1 +1,11 @@
-"""Gluon imperative API."""
+"""Gluon imperative/hybrid API (reference python/mxnet/gluon/)."""
+from .block import Block, HybridBlock, SymbolBlock
+from .parameter import Constant, Parameter, ParameterDict
+from .trainer import Trainer
+from . import nn
+from . import rnn
+from . import loss
+from . import data
+from . import utils
+from . import model_zoo
+from . import contrib

@@ -342,7 +342,7 @@ class HybridBlock(Block):
             # record one tape node for the whole fused program
             def pure(*arrays):
                 o, _ = fn(tuple(arrays), aux_handles, keys)
-                return tuple(o)
+                return o[0] if len(o) == 1 else tuple(o)
             _ag._record_op(pure, list(arg_handles), arg_nds, out_nds)
         ret, _ = _regroup(out_nds, self._out_format)
         return ret
@@ -445,7 +445,13 @@ class SymbolBlock(HybridBlock):
 
     def forward(self, x, *args):
         if isinstance(x, NDArray):
-            return self._call_cached_op(x, *args)
+            try:
+                return self._call_cached_op(x, *args)
+            except DeferredInitializationError:
+                self._deferred_infer_shape(x, *args)
+                for _, p in self.collect_params().items():
+                    p._finish_deferred_init()
+                return self._call_cached_op(x, *args)
         assert isinstance(x, Symbol)
         return copy.copy(self._cached_graph[1])
 

@@ -1,0 +1,3 @@
+"""Contrib RNN cells (reference python/mxnet/gluon/contrib/rnn/):
+Conv1DRNNCell family + VariationalDropoutCell."""
+from .conv_rnn_cell import Conv2DLSTMCell

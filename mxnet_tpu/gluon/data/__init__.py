@@ -1,0 +1,7 @@
+"""Gluon data API (reference python/mxnet/gluon/data/)."""
+from .dataset import (ArrayDataset, Dataset, RecordFileDataset,
+                      SimpleDataset)
+from .dataloader import DataLoader
+from .sampler import (BatchSampler, RandomSampler, Sampler,
+                      SequentialSampler)
+from . import vision

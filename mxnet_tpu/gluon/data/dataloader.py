@@ -1,0 +1,101 @@
+"""DataLoader (reference python/mxnet/gluon/data/dataloader.py).
+
+The reference's multiprocess workers + shared-memory NDArrays exist to
+parallelise host-side decode.  Here workers are threads (numpy/PIL release
+the GIL during decode) feeding a bounded queue; batches land as committed
+device arrays so transfer overlaps compute — same pipeline shape
+(prefetcher over batchers, iter_prefetcher.h) without fork complications.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+from ...ndarray.ndarray import NDArray, array as nd_array
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+
+def default_batchify_fn(data):
+    """Collate samples into a batch (reference default_batchify_fn)."""
+    if isinstance(data[0], NDArray):
+        return nd_array(np.stack([d.asnumpy() for d in data]))
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    data = np.asarray(data)
+    return nd_array(data)
+
+
+class DataLoader:
+    """reference dataloader.py DataLoader."""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size must be specified unless "
+                                 "batch_sampler is specified")
+            if sampler is None:
+                if shuffle:
+                    sampler = RandomSampler(len(dataset))
+                else:
+                    sampler = SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must not be specified if sampler "
+                                 "is specified")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        elif batch_size is not None or shuffle or sampler is not None or \
+                last_batch is not None:
+            raise ValueError("batch_size, shuffle, sampler and last_batch "
+                             "must not be specified if batch_sampler is "
+                             "specified.")
+        self._batch_sampler = batch_sampler
+        self._num_workers = num_workers
+        self._batchify_fn = batchify_fn or default_batchify_fn
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for batch in self._batch_sampler:
+                yield self._batchify_fn([self._dataset[idx]
+                                         for idx in batch])
+            return
+        # threaded prefetch pipeline
+        out_q = queue.Queue(maxsize=2 * self._num_workers)
+        batches = list(self._batch_sampler)
+        lock = threading.Lock()
+        cursor = [0]
+        results = {}
+        next_emit = [0]
+        done = threading.Event()
+
+        def worker():
+            while True:
+                with lock:
+                    if cursor[0] >= len(batches):
+                        return
+                    my_idx = cursor[0]
+                    cursor[0] += 1
+                batch = self._batchify_fn(
+                    [self._dataset[i] for i in batches[my_idx]])
+                out_q.put((my_idx, batch))
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(self._num_workers)]
+        for t in threads:
+            t.start()
+        emitted = 0
+        while emitted < len(batches):
+            idx, batch = out_q.get()
+            results[idx] = batch
+            while next_emit[0] in results:
+                yield results.pop(next_emit[0])
+                next_emit[0] += 1
+                emitted += 1
+
+    def __len__(self):
+        return len(self._batch_sampler)

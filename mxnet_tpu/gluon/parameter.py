@@ -93,7 +93,10 @@ class Parameter:
             "%s." % (self.name, str(self.shape))
         if data is None:
             data = nd_zeros(self.shape, dtype=self.dtype, ctx=ctx or cpu())
-            (init or default_init or Uniform())(InitDesc(self.name), data)
+            initializer = init or self.init or default_init or Uniform()
+            if isinstance(initializer, str):
+                initializer = init_create(initializer)
+            initializer(InitDesc(self.name), data)
         self._init_impl(data, ctx)
 
     def _init_impl(self, data, ctx):
@@ -134,6 +137,22 @@ class Parameter:
         if self._data is not None:
             self._data = self._data.as_in_context(
                 ctx[0] if isinstance(ctx, (list, tuple)) else ctx)
+
+    def _load_init(self, data, ctx=None):
+        """Initialize directly from loaded data (reference _load_init)."""
+        if self.shape is not None and len(self.shape) == len(data.shape):
+            merged = tuple(s if s else d
+                           for s, d in zip(self.shape, data.shape))
+            assert merged == tuple(data.shape), \
+                "Failed loading Parameter '%s' from saved params: shape " \
+                "incompatible expected %s vs saved %s" % (
+                    self.name, str(self.shape), str(data.shape))
+        self.shape = tuple(data.shape)
+        if self._data is None:
+            self._deferred_init = ()
+            self._init_impl(data if isinstance(data, NDArray) else data, ctx)
+        else:
+            self.set_data(data)
 
     def set_data(self, data):
         if self._data is None:
@@ -355,4 +374,4 @@ class ParameterDict:
                     "Parameter '%s' loaded from file '%s' is not present in " \
                     "ParameterDict" % (name[len(restore_prefix):], filename)
                 continue
-            self[name].set_data(arg_dict[name])
+            self[name]._load_init(arg_dict[name], ctx)

@@ -456,9 +456,13 @@ def _make_wrapper(op: Operator):
         inputs = [a for a in args if isinstance(a, NDArray)]
         extra = [a for a in args if not isinstance(a, NDArray)]
         if extra:
-            raise MXNetError(
-                "op %s: positional args must be NDArrays, got %r (pass "
-                "parameters as keyword arguments)" % (op.name, extra))
+            # positional attrs map onto the schema in declaration order
+            free = [p for p in op.params if p not in kwargs]
+            if len(extra) > len(free):
+                raise MXNetError(
+                    "op %s: too many positional arguments %r" % (op.name,
+                                                                 extra))
+            kwargs.update(zip(free, extra))
         if op.variadic and "num_args" not in kwargs:
             kwargs["num_args"] = len(inputs)
         # inputs may also arrive as keywords (data=..., weight=...)

@@ -1,1 +1,8 @@
-"""Symbolic RNN cells (reference python/mxnet/rnn/)."""
+"""Symbolic RNN API (reference python/mxnet/rnn/)."""
+from .rnn_cell import (BaseRNNCell, BidirectionalCell, DropoutCell,
+                       FusedRNNCell, GRUCell, LSTMCell, ModifierCell,
+                       ResidualCell, RNNCell, RNNParams, SequentialRNNCell,
+                       ZoneoutCell)
+from .rnn import (do_rnn_checkpoint, load_rnn_checkpoint,
+                  save_rnn_checkpoint)
+from .io import BucketSentenceIter, encode_sentences

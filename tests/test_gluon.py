@@ -1,0 +1,296 @@
+"""Gluon tests (reference tests/python/unittest/test_gluon*.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_parameter():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    p.initialize(init="xavier")
+    assert p.data().shape == (10, 10)
+    assert p.grad().shape == (10, 10)
+    assert p.list_ctx()[0].device_type == "cpu"
+
+
+def test_parameter_dict_sharing():
+    params1 = gluon.ParameterDict("net1_")
+    # sharing adopts the shared dict's prefix (reference Block(params=...))
+    params2 = gluon.ParameterDict(params1.prefix, shared=params1)
+    params1.get("w0", shape=(10, 10))
+    assert list(params2.get("w0").shape) == [10, 10]
+    assert params2.get("w0") is params1.get("w0")
+
+
+def test_constant():
+    c = gluon.Constant("const", [[1, 2], [3, 4]])
+    c.initialize()
+    assert c.grad_req == "null"
+    assert_almost_equal(c.data().asnumpy(), np.array([[1, 2], [3, 4.]]))
+
+
+def test_dense():
+    net = nn.Dense(8, in_units=4, activation="relu")
+    net.initialize()
+    x = nd.random.uniform(shape=(2, 4))
+    out = net(x)
+    assert out.shape == (2, 8)
+    assert (out.asnumpy() >= 0).all()
+    # deferred init
+    net2 = nn.Dense(8)
+    net2.initialize()
+    out2 = net2(nd.ones((3, 5)))
+    assert out2.shape == (3, 8)
+    assert net2.weight.shape == (8, 5)
+
+
+def test_sequential_and_hybridize():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"))
+        net.add(nn.Dense(4))
+    net.initialize()
+    x = nd.random.uniform(shape=(5, 10))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    assert_almost_equal(eager, hybrid, rtol=1e-4, atol=1e-5)
+
+
+def test_hybrid_backward_matches_eager():
+    def run(hybridize):
+        mx.seed(42)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(8, activation="tanh"), nn.Dense(2))
+        net.initialize(mx.init.Constant(0.05))
+        if hybridize:
+            net.hybridize()
+        x = nd.array(np.random.RandomState(0).rand(4, 6))
+        with mx.autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        return [p.grad().asnumpy() for _, p in
+                sorted(net.collect_params().items())]
+
+    g1 = run(False)
+    g2 = run(True)
+    for a, b in zip(g1, g2):
+        assert_almost_equal(a, b, rtol=1e-4, atol=1e-5)
+
+
+def test_conv_layers():
+    x = nd.random.uniform(shape=(2, 3, 16, 16))
+    conv = nn.Conv2D(8, kernel_size=3, padding=1, in_channels=3)
+    conv.initialize()
+    assert conv(x).shape == (2, 8, 16, 16)
+    pool = nn.MaxPool2D(2, 2)
+    assert pool(x).shape == (2, 3, 8, 8)
+    gap = nn.GlobalAvgPool2D()
+    assert gap(x).shape == (2, 3, 1, 1)
+    tconv = nn.Conv2DTranspose(4, kernel_size=2, strides=2, in_channels=3)
+    tconv.initialize()
+    assert tconv(x).shape == (2, 4, 32, 32)
+    c1 = nn.Conv1D(4, kernel_size=3, in_channels=3)
+    c1.initialize()
+    assert c1(nd.ones((2, 3, 10))).shape == (2, 4, 8)
+
+
+def test_batchnorm_layer():
+    bn = nn.BatchNorm(in_channels=4)
+    bn.initialize()
+    x = nd.random.uniform(shape=(8, 4, 3, 3))
+    rm0 = bn.running_mean.data().asnumpy().copy()
+    with mx.autograd.record():
+        out = bn(x)
+    assert out.shape == x.shape
+    assert not np.allclose(rm0, bn.running_mean.data().asnumpy())
+
+
+def test_embedding_flatten_dropout():
+    emb = nn.Embedding(10, 6)
+    emb.initialize()
+    out = emb(nd.array([1, 2, 3], dtype="int32"))
+    assert out.shape == (3, 6)
+    assert nn.Flatten()(nd.ones((2, 3, 4))).shape == (2, 12)
+    do = nn.Dropout(0.5)
+    assert (do(nd.ones((4, 4))).asnumpy() == 1).all()  # predict mode
+
+
+def test_losses():
+    pred = nd.array(np.random.rand(4, 5).astype(np.float32))
+    label = nd.array([0, 1, 2, 3], dtype="float32")
+    for loss_fn, args in [
+            (gluon.loss.SoftmaxCrossEntropyLoss(), (pred, label)),
+            (gluon.loss.L2Loss(), (pred, nd.zeros((4, 5)))),
+            (gluon.loss.L1Loss(), (pred, nd.zeros((4, 5)))),
+            (gluon.loss.SigmoidBinaryCrossEntropyLoss(),
+             (pred, nd.zeros((4, 5)))),
+            (gluon.loss.HuberLoss(), (pred, nd.zeros((4, 5)))),
+            (gluon.loss.HingeLoss(), (pred, nd.ones((4, 5)))),
+            (gluon.loss.KLDivLoss(from_logits=False),
+             (pred, nd.softmax(pred)))]:
+        out = loss_fn(*args)
+        assert out.shape == (4,)
+    # CE matches manual
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label).asnumpy()
+    p = pred.asnumpy()
+    ls = p - np.log(np.exp(p).sum(-1, keepdims=True))
+    manual = -ls[np.arange(4), label.asnumpy().astype(int)]
+    assert_almost_equal(ce, manual, rtol=1e-4)
+
+
+def test_trainer_convergence():
+    mx.seed(0)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(32, activation="relu"), nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    rs = np.random.RandomState(0)
+    y = rs.randint(0, 4, 256)
+    X = rs.rand(256, 16).astype(np.float32) * 0.1
+    for i in range(256):
+        X[i, y[i] * 4:(y[i] + 1) * 4] += 1
+    for epoch in range(10):
+        for i in range(0, 256, 64):
+            xb = nd.array(X[i:i + 64])
+            yb = nd.array(y[i:i + 64].astype(np.float32))
+            with mx.autograd.record():
+                l = loss_fn(net(xb), yb)
+            l.backward()
+            trainer.step(64)
+    preds = net(nd.array(X)).asnumpy().argmax(1)
+    assert (preds == y).mean() > 0.95
+
+
+def test_save_load_params(tmp_path):
+    net = nn.HybridSequential(prefix="model_")
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=3))
+    net.initialize(mx.init.Xavier())
+    fname = str(tmp_path / "net.params")
+    net.save_params(fname)
+    w0 = net[0].weight.data().asnumpy()
+
+    net2 = nn.HybridSequential(prefix="model_")
+    with net2.name_scope():
+        net2.add(nn.Dense(4, in_units=3))
+    net2.load_params(fname)
+    assert_almost_equal(net2[0].weight.data().asnumpy(), w0)
+
+
+def test_rnn_layers():
+    for cls, nstate in [(gluon.rnn.RNN, 1), (gluon.rnn.LSTM, 2),
+                        (gluon.rnn.GRU, 1)]:
+        layer = cls(12, num_layers=2, input_size=6)
+        layer.initialize()
+        x = nd.random.uniform(shape=(7, 3, 6))
+        out = layer(x)
+        assert out.shape == (7, 3, 12)
+        states = layer.begin_state(batch_size=3)
+        out, new_states = layer(x, states)
+        assert out.shape == (7, 3, 12)
+        assert len(new_states) == nstate
+    bi = gluon.rnn.LSTM(12, num_layers=1, bidirectional=True, input_size=6)
+    bi.initialize()
+    assert bi(nd.random.uniform(shape=(7, 3, 6))).shape == (7, 3, 24)
+    # NTC layout
+    ntc = gluon.rnn.GRU(5, layout="NTC", input_size=4)
+    ntc.initialize()
+    assert ntc(nd.random.uniform(shape=(2, 9, 4))).shape == (2, 9, 5)
+
+
+def test_rnn_cells():
+    for cell_cls in [gluon.rnn.RNNCell, gluon.rnn.LSTMCell, gluon.rnn.GRUCell]:
+        cell = cell_cls(8, input_size=4)
+        cell.initialize()
+        outs, states = cell.unroll(5, nd.random.uniform(shape=(2, 5, 4)),
+                                   merge_outputs=True)
+        assert outs.shape == (2, 5, 8)
+    stack = gluon.rnn.SequentialRNNCell()
+    stack.add(gluon.rnn.LSTMCell(8, input_size=4))
+    stack.add(gluon.rnn.LSTMCell(6, input_size=8))
+    stack.initialize()
+    outs, states = stack.unroll(3, nd.random.uniform(shape=(2, 3, 4)),
+                                merge_outputs=True)
+    assert outs.shape == (2, 3, 6)
+    # residual
+    res = gluon.rnn.ResidualCell(gluon.rnn.GRUCell(4, input_size=4))
+    res.initialize()
+    outs, _ = res.unroll(3, nd.random.uniform(shape=(2, 3, 4)),
+                         merge_outputs=True)
+    assert outs.shape == (2, 3, 4)
+
+
+def test_rnn_fused_vs_cell():
+    """Fused LSTM layer output matches the unfused cell stack."""
+    mx.seed(7)
+    layer = gluon.rnn.LSTM(8, num_layers=1, input_size=5, prefix="m_")
+    layer.initialize(mx.init.Xavier())
+    x = nd.random.uniform(shape=(6, 2, 5))
+    fused_out = layer(x).asnumpy()
+    cell = layer._unfuse()
+    outs, _ = cell.unroll(6, x, layout="TNC", merge_outputs=True)
+    assert_almost_equal(outs.asnumpy(), fused_out, rtol=1e-4, atol=1e-5)
+
+
+def test_model_zoo_shapes():
+    for name, shape in [("resnet18_v1", (2, 3, 32, 32)),
+                        ("resnet18_v2", (2, 3, 32, 32)),
+                        ("squeezenet1.1", (2, 3, 64, 64)),
+                        ("mobilenet0.25", (2, 3, 32, 32))]:
+        net = gluon.model_zoo.get_model(name, classes=10)
+        net.initialize(mx.init.Xavier())
+        out = net(nd.random.uniform(shape=shape))
+        assert out.shape == (2, 10), name
+
+
+def test_dataset_dataloader():
+    X = np.random.rand(20, 3).astype(np.float32)
+    y = np.arange(20, dtype=np.float32)
+    dataset = gluon.data.ArrayDataset(X, y)
+    assert len(dataset) == 20
+    loader = gluon.data.DataLoader(dataset, batch_size=6, shuffle=False,
+                                   last_batch="keep")
+    batches = list(loader)
+    assert len(batches) == 4
+    assert batches[0][0].shape == (6, 3)
+    assert batches[-1][0].shape == (2, 3)
+    # threaded workers give same content
+    loader2 = gluon.data.DataLoader(dataset, batch_size=5, num_workers=2)
+    total = sum(b[1].asnumpy().sum() for b in loader2)
+    assert total == y.sum()
+    # transform
+    t = dataset.transform_first(lambda x: x * 2)
+    assert_almost_equal(t[3][0], X[3] * 2, rtol=1e-6)
+
+
+def test_split_and_load():
+    data = nd.arange(0, 16).reshape((8, 2))
+    parts = gluon.utils.split_and_load(data, [mx.cpu(0), mx.cpu(1)])
+    assert len(parts) == 2
+    assert parts[0].shape == (4, 2)
+
+
+def test_clip_global_norm():
+    arrays = [nd.ones((2, 2)) * 3, nd.ones((2,)) * 4]
+    norm = gluon.utils.clip_global_norm(arrays, 1.0)
+    total = np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrays))
+    assert total <= 1.01
+
+
+def test_symbol_block():
+    from mxnet_tpu import sym
+    data = sym.Variable("data")
+    net_sym = sym.FullyConnected(data, num_hidden=6, name="fc")
+    blk = gluon.SymbolBlock(net_sym, data)
+    blk.collect_params().initialize()
+    out = blk(nd.ones((2, 4)))
+    assert out.shape == (2, 6)
