@@ -67,6 +67,12 @@ class GraphProgram:
         """Pure evaluation. Returns (outputs, new_aux)."""
         arg_map = dict(zip(self.arg_names, arg_arrays))
         aux_map = dict(zip(self.aux_names, aux_arrays))
+        batch_hint = None
+        if "data" in arg_map and hasattr(arg_map["data"], "shape"):
+            batch_hint = arg_map["data"].shape[0]
+        elif arg_arrays and hasattr(arg_arrays[0], "shape") \
+                and arg_arrays[0].shape:
+            batch_hint = arg_arrays[0].shape[0]
         key_idx = 0
         raw: Dict[int, tuple] = {}
         for node in self.nodes:
@@ -76,6 +82,12 @@ class GraphProgram:
                 raw[id(node)] = (val,)
                 continue
             attrs = node.parsed_attrs()
+            # creation ops with 0-dims: 0 means "infer at bind" (reference
+            # begin_state convention) — resolved against the batch size
+            if not node.inputs and 0 in (attrs.get("shape") or ()):
+                attrs = type(attrs)(attrs)
+                attrs["shape"] = tuple(batch_hint if d == 0 else d
+                                       for d in attrs["shape"])
             if node.op.mode_dependent:
                 attrs = type(attrs)(attrs)
                 attrs["_train"] = train
@@ -139,6 +151,14 @@ def _resolve_structs(symbol: Symbol, kwargs: Dict[str, Any],
             known[k] = _struct(v, type_dict.get(k, "float32"))
         elif isinstance(v, NDArray):
             known[k] = _struct(v.shape, v.dtype)
+    batch_hint = None
+    for cand in ("data", "data0"):
+        if cand in known:
+            batch_hint = known[cand].shape[0] if known[cand].shape else None
+            break
+    if batch_hint is None and known:
+        first = next(iter(known.values()))
+        batch_hint = first.shape[0] if first.shape else None
     shapes: Dict[int, tuple] = {}  # node id -> tuple of output structs
     for node in prog.nodes:
         if node.is_var:
@@ -159,6 +179,10 @@ def _resolve_structs(symbol: Symbol, kwargs: Dict[str, Any],
                 shapes[id(node)] = (None,)
             continue
         attrs = node.parsed_attrs()
+        if not node.inputs and 0 in (attrs.get("shape") or ()) and batch_hint:
+            attrs = type(attrs)(attrs)
+            attrs["shape"] = tuple(batch_hint if d == 0 else d
+                                   for d in attrs["shape"])
         in_structs = [shapes[id(e.node)][e.index] for e in node.inputs]
         hook = getattr(node.op, "infer_params", None)
         if hook is not None and any(s is None for s in in_structs):

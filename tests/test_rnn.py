@@ -1,0 +1,128 @@
+"""Symbolic RNN cell tests (reference tests/python/unittest/test_rnn.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_rnn_cell_unroll_shapes():
+    for cell_cls, name in [(mx.rnn.RNNCell, "rnn_"), (mx.rnn.LSTMCell, "lstm_"),
+                           (mx.rnn.GRUCell, "gru_")]:
+        cell = cell_cls(10, prefix=name)
+        inputs = [sym.Variable("t%d_data" % i) for i in range(3)]
+        outputs, states = cell.unroll(3, inputs)
+        outputs = sym.Group(outputs)
+        arg_shapes, out_shapes, _ = outputs.infer_shape(
+            t0_data=(4, 7), t1_data=(4, 7), t2_data=(4, 7))
+        assert out_shapes == [(4, 10)] * 3
+
+
+def test_lstm_forward_matches_fused():
+    """Unrolled LSTMCell == FusedRNNCell given packed weights."""
+    T, N, C, H = 4, 2, 5, 6
+    fused = mx.rnn.FusedRNNCell(H, num_layers=1, mode="lstm", prefix="l_")
+    cell = fused.unfuse()
+    data = sym.Variable("data")
+    f_out, _ = fused.unroll(T, data, layout="NTC", merge_outputs=True)
+    c_out, _ = cell.unroll(T, data, layout="NTC", merge_outputs=True)
+
+    rs = np.random.RandomState(0)
+    x = rs.rand(N, T, C).astype(np.float32)
+    from mxnet_tpu.ops.rnn import rnn_param_size
+    nparam = rnn_param_size(1, C, H, False, "lstm")
+    blob = (rs.rand(nparam).astype(np.float32) - 0.5) * 0.2
+
+    ex_f = f_out.bind(mx.cpu(), {"data": nd.array(x),
+                                 "l_parameters": nd.array(blob)})
+    res_f = ex_f.forward()[0].asnumpy()
+
+    # unpack blob into per-gate cell weights
+    cell_args = {"data": nd.array(x)}
+    h = H
+    wx = blob[:4 * H * C].reshape(4 * H, C)
+    wh = blob[4 * H * C:4 * H * (C + H)].reshape(4 * H, H)
+    bx = blob[4 * H * (C + H):4 * H * (C + H) + 4 * H]
+    bh = blob[4 * H * (C + H) + 4 * H:]
+    cell_args["l_l0_i2h_weight"] = nd.array(wx)
+    cell_args["l_l0_h2h_weight"] = nd.array(wh)
+    cell_args["l_l0_i2h_bias"] = nd.array(bx)
+    cell_args["l_l0_h2h_bias"] = nd.array(bh)
+    ex_c = c_out.bind(mx.cpu(), cell_args)
+    res_c = ex_c.forward()[0].asnumpy()
+    assert_almost_equal(res_f, res_c, rtol=1e-4, atol=1e-5)
+
+
+def test_bidirectional_cell():
+    cell = mx.rnn.BidirectionalCell(mx.rnn.LSTMCell(4, prefix="l_"),
+                                    mx.rnn.LSTMCell(4, prefix="r_"))
+    inputs = [sym.Variable("t%d_data" % i) for i in range(3)]
+    outputs, _ = cell.unroll(3, inputs)
+    outputs = sym.Group(outputs)
+    _, out_shapes, _ = outputs.infer_shape(
+        t0_data=(2, 5), t1_data=(2, 5), t2_data=(2, 5))
+    assert out_shapes == [(2, 8)] * 3
+
+
+def test_residual_zoneout_dropout_cells():
+    base = mx.rnn.GRUCell(6, prefix="g_")
+    res = mx.rnn.ResidualCell(base)
+    inputs = [sym.Variable("t%d_data" % i) for i in range(2)]
+    outputs, _ = res.unroll(2, inputs)
+    _, out_shapes, _ = sym.Group(outputs).infer_shape(
+        t0_data=(3, 6), t1_data=(3, 6))
+    assert out_shapes == [(3, 6)] * 2
+
+    zo = mx.rnn.ZoneoutCell(mx.rnn.RNNCell(4, prefix="z_"),
+                            zoneout_outputs=0.3)
+    outputs, _ = zo.unroll(2, [sym.Variable("u%d" % i) for i in range(2)])
+    assert len(outputs) == 2
+
+    do = mx.rnn.DropoutCell(0.5)
+    outputs, _ = do.unroll(2, [sym.Variable("v%d" % i) for i in range(2)])
+    assert len(outputs) == 2
+
+
+def test_sequential_stack_unroll():
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.LSTMCell(8, prefix="l0_"))
+    stack.add(mx.rnn.LSTMCell(4, prefix="l1_"))
+    outputs, states = stack.unroll(3, sym.Variable("data"),
+                                   merge_outputs=True)
+    _, out_shapes, _ = outputs.infer_shape(data=(2, 3, 10))
+    assert out_shapes == [(2, 3, 4)]
+    assert len(states) == 4  # 2 cells x (h, c)
+
+
+def test_bucket_sentence_iter():
+    sents = [[1, 2, 3], [4, 5], [1, 2, 3, 4, 5, 6], [7, 8, 9], [1, 2]]
+    it = mx.rnn.BucketSentenceIter(sents, batch_size=1, buckets=[3, 6],
+                                   invalid_label=0)
+    seen = 0
+    for batch in it:
+        assert batch.bucket_key in (3, 6)
+        assert batch.data[0].shape[1] == batch.bucket_key
+        seen += 1
+    assert seen == 5  # 2-length sentences padded into bucket 3
+
+
+def test_encode_sentences():
+    sents, vocab = mx.rnn.encode_sentences([["a", "b"], ["b", "c"]],
+                                           invalid_label=0, start_label=1)
+    assert len(vocab) >= 3
+    assert sents[0][1] == sents[1][0]  # "b" same id
+
+
+def test_rnn_save_load_checkpoint(tmp_path):
+    cell = mx.rnn.FusedRNNCell(6, num_layers=1, mode="lstm", prefix="l_")
+    data = sym.Variable("data")
+    out, _ = cell.unroll(3, data, layout="NTC", merge_outputs=True)
+    from mxnet_tpu.ops.rnn import rnn_param_size
+    nparam = rnn_param_size(1, 4, 6, False, "lstm")
+    args = {"l_parameters": nd.array(np.random.rand(nparam).astype(np.float32))}
+    prefix = str(tmp_path / "rnnmodel")
+    mx.rnn.save_rnn_checkpoint(cell, prefix, 1, out, args, {})
+    sym2, arg2, aux2 = mx.rnn.load_rnn_checkpoint(cell, prefix, 1)
+    assert_almost_equal(arg2["l_parameters"].asnumpy(),
+                        args["l_parameters"].asnumpy(), rtol=1e-6)
