@@ -1,1 +1,5 @@
-"""Contrib namespace."""
+"""Contrib namespace (reference python/mxnet/contrib/)."""
+from . import ndarray
+from . import symbol
+from . import autograd
+from . import tensorboard

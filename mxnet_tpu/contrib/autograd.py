@@ -1,0 +1,3 @@
+"""mx.contrib.autograd (reference contrib/autograd.py) — re-export."""
+from ..autograd import *  # noqa: F401,F403
+from ..autograd import grad, backward, record, pause  # noqa: F401

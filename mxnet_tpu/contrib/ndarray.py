@@ -1,0 +1,4 @@
+"""mx.contrib.ndarray — contrib ops as functions."""
+import sys as _sys
+from ..ndarray.ndarray import populate_module as _pop
+_pop(_sys.modules[__name__])

@@ -1,0 +1,6 @@
+"""mx.contrib.symbol — contrib ops as symbol constructors."""
+import sys as _sys
+from ..symbol import _make_sym_wrapper as _mk
+from ..ops.registry import list_ops as _list
+for _n in _list():
+    setattr(_sys.modules[__name__], _n, _mk(_n))

@@ -1,1 +1,13 @@
-"""Image pipeline (filled in by image/ modules)."""
+"""Image pipeline (reference python/mxnet/image/)."""
+from .image import (Augmenter, BrightnessJitterAug, CastAug, CenterCropAug,
+                    ColorJitterAug, ColorNormalizeAug, ContrastJitterAug,
+                    CreateAugmenter, ForceResizeAug, HorizontalFlipAug,
+                    HueJitterAug, ImageIter, LightingAug, RandomCropAug,
+                    RandomGrayAug, RandomOrderAug, RandomSizedCropAug,
+                    ResizeAug, SaturationJitterAug, SequentialAug,
+                    center_crop, color_normalize, fixed_crop, imdecode,
+                    imread, imresize, random_crop, random_size_crop,
+                    resize_short, scale_down)
+from .record_iter import ImageRecordIter, ImageRecordUInt8Iter
+from . import detection
+from .detection import CreateDetAugmenter, ImageDetIter

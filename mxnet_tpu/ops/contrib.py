@@ -1,0 +1,513 @@
+"""Contrib operators.
+
+Reference: src/operator/contrib/ — multibox_prior/target/detection (SSD),
+roi_pooling (src/operator/roi_pooling-inl.h), proposal (RCNN), fft/ifft,
+count_sketch, quantize/dequantize.
+
+TPU notes: all fixed-shape formulations — NMS is a bounded fori_loop greedy
+suppression (no dynamic shapes), ROI pooling is a gather+reduce_window per
+ROI via vmap.  These compile to single XLA programs like everything else.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import (attr_bool, attr_float, attr_int, attr_shape, attr_str,
+                    Param, dtype_np)
+from .registry import register
+
+
+def _parse_floats(v, default):
+    if v is None:
+        return default
+    if isinstance(v, str):
+        import ast
+        v = ast.literal_eval(v)
+    if isinstance(v, (int, float)):
+        return (float(v),)
+    return tuple(float(x) for x in v)
+
+
+_floats = lambda default: Param(lambda v: _parse_floats(v, default),
+                                default, kind="tuple of floats")
+
+
+# ---------------------------------------------------------------------------
+# SSD multibox family
+# ---------------------------------------------------------------------------
+
+@register("_contrib_MultiBoxPrior", inputs=("data",),
+          params=dict(sizes=_floats((1.0,)), ratios=_floats((1.0,)),
+                      clip=attr_bool(False), steps=_floats((-1.0, -1.0)),
+                      offsets=_floats((0.5, 0.5))),
+          aliases=("MultiBoxPrior", "_contrib_multibox_prior"))
+def _multibox_prior(attrs, data):
+    """Anchor generation (reference contrib/multibox_prior-inl.h): per pixel
+    num_sizes + num_ratios - 1 boxes, corner format, normalised."""
+    h, w = data.shape[2], data.shape[3]
+    sizes = attrs.sizes
+    ratios = attrs.ratios
+    step_y = attrs.steps[0] if attrs.steps[0] > 0 else 1.0 / h
+    step_x = attrs.steps[1] if attrs.steps[1] > 0 else 1.0 / w
+    cy = (jnp.arange(h) + attrs.offsets[0]) * step_y
+    cx = (jnp.arange(w) + attrs.offsets[1]) * step_x
+    cyx = jnp.stack(jnp.meshgrid(cy, cx, indexing="ij"), axis=-1)  # h,w,2
+    # anchor half-sizes: sizes with ratio[0], then ratios[1:] with size[0]
+    whs = []
+    for s in sizes:
+        r = ratios[0]
+        whs.append((s * np.sqrt(r), s / np.sqrt(r)))
+    for r in ratios[1:]:
+        s = sizes[0]
+        whs.append((s * np.sqrt(r), s / np.sqrt(r)))
+    whs = jnp.asarray(whs)  # (A, 2) of (w, h)
+    A = whs.shape[0]
+    centers = jnp.broadcast_to(cyx[:, :, None, :], (h, w, A, 2))
+    half_w = whs[None, None, :, 0] / 2
+    half_h = whs[None, None, :, 1] / 2
+    xmin = centers[..., 1] - half_w
+    ymin = centers[..., 0] - half_h
+    xmax = centers[..., 1] + half_w
+    ymax = centers[..., 0] + half_h
+    out = jnp.stack([xmin, ymin, xmax, ymax], axis=-1).reshape(-1, 4)
+    if attrs.clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out[None].astype(data.dtype)
+
+
+def _box_iou(a, b):
+    """a: (N,4), b: (M,4) corner boxes → (N,M) IoU."""
+    ix0 = jnp.maximum(a[:, None, 0], b[None, :, 0])
+    iy0 = jnp.maximum(a[:, None, 1], b[None, :, 1])
+    ix1 = jnp.minimum(a[:, None, 2], b[None, :, 2])
+    iy1 = jnp.minimum(a[:, None, 3], b[None, :, 3])
+    iw = jnp.maximum(ix1 - ix0, 0)
+    ih = jnp.maximum(iy1 - iy0, 0)
+    inter = iw * ih
+    area_a = jnp.maximum((a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1]), 0)
+    area_b = jnp.maximum((b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1]), 0)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return inter / jnp.maximum(union, 1e-12)
+
+
+@register("_contrib_MultiBoxTarget",
+          inputs=("anchor", "label", "cls_pred"),
+          params=dict(overlap_threshold=attr_float(0.5),
+                      ignore_label=attr_float(-1.0),
+                      negative_mining_ratio=attr_float(-1.0),
+                      negative_mining_thresh=attr_float(0.5),
+                      minimum_negative_samples=attr_int(0),
+                      variances=_floats((0.1, 0.1, 0.2, 0.2))),
+          num_outputs=3,
+          aliases=("MultiBoxTarget", "_contrib_multibox_target"))
+def _multibox_target(attrs, anchor, label, cls_pred):
+    """Anchor matching + target encoding (reference multibox_target-inl.h).
+    anchor (1,N,4); label (B,M,5) padded -1; cls_pred (B,C,N).
+    Returns loc_target (B,N*4), loc_mask (B,N*4), cls_target (B,N)."""
+    anchors = anchor[0]  # (N,4)
+    N = anchors.shape[0]
+    var = jnp.asarray(attrs.variances)
+
+    def one_sample(lab):
+        valid = lab[:, 0] >= 0  # (M,)
+        gt = lab[:, 1:5]
+        iou = _box_iou(anchors, gt)  # (N, M)
+        iou = jnp.where(valid[None, :], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=1)          # (N,)
+        best_iou = jnp.max(iou, axis=1)
+        # force-match: each VALID gt's best anchor (invalid gts scatter to an
+        # out-of-range index and are dropped)
+        best_anchor = jnp.argmax(iou, axis=0)      # (M,)
+        scatter_idx = jnp.where(valid, best_anchor, N)
+        forced = jnp.zeros(N, bool).at[scatter_idx].set(True, mode="drop")
+        forced_gt = jnp.zeros(N, jnp.int32).at[scatter_idx].set(
+            jnp.arange(lab.shape[0], dtype=jnp.int32), mode="drop")
+        pos = forced | (best_iou >= attrs.overlap_threshold)
+        match = jnp.where(forced, forced_gt, best_gt)
+        g = gt[match]  # (N,4)
+        # encode offsets (center form, variance-normalised)
+        aw = anchors[:, 2] - anchors[:, 0]
+        ah = anchors[:, 3] - anchors[:, 1]
+        acx = (anchors[:, 0] + anchors[:, 2]) / 2
+        acy = (anchors[:, 1] + anchors[:, 3]) / 2
+        gw = jnp.maximum(g[:, 2] - g[:, 0], 1e-12)
+        gh = jnp.maximum(g[:, 3] - g[:, 1], 1e-12)
+        gcx = (g[:, 0] + g[:, 2]) / 2
+        gcy = (g[:, 1] + g[:, 3]) / 2
+        tx = (gcx - acx) / jnp.maximum(aw, 1e-12) / var[0]
+        ty = (gcy - acy) / jnp.maximum(ah, 1e-12) / var[1]
+        tw = jnp.log(gw / jnp.maximum(aw, 1e-12)) / var[2]
+        th = jnp.log(gh / jnp.maximum(ah, 1e-12)) / var[3]
+        loc_t = jnp.stack([tx, ty, tw, th], axis=-1)  # (N,4)
+        mask = pos[:, None].astype(anchors.dtype)
+        cls_t = jnp.where(pos, lab[match, 0] + 1, 0.0)
+        return (loc_t * mask).reshape(-1), \
+            jnp.broadcast_to(mask, (N, 4)).reshape(-1), cls_t
+
+    loc_t, loc_m, cls_t = jax.vmap(one_sample)(label)
+    return (loc_t.astype(cls_pred.dtype), loc_m.astype(cls_pred.dtype),
+            cls_t.astype(cls_pred.dtype))
+
+
+def _greedy_nms(boxes, scores, iou_thresh, topk):
+    """Greedy NMS over pre-sorted candidates; returns keep mask."""
+    n = boxes.shape[0]
+
+    def body(i, state):
+        keep = state
+        cur_box = boxes[i]
+        iou = _box_iou(cur_box[None], boxes)[0]
+        suppress = (iou > iou_thresh) & (jnp.arange(n) > i) & keep[i]
+        return keep & ~suppress
+
+    keep0 = jnp.ones(n, bool)
+    return jax.lax.fori_loop(0, n, body, keep0)
+
+
+@register("_contrib_MultiBoxDetection",
+          inputs=("cls_prob", "loc_pred", "anchor"),
+          params=dict(clip=attr_bool(True), threshold=attr_float(0.01),
+                      background_id=attr_int(0), nms_threshold=attr_float(0.5),
+                      force_suppress=attr_bool(False),
+                      variances=_floats((0.1, 0.1, 0.2, 0.2)),
+                      nms_topk=attr_int(-1)),
+          aliases=("MultiBoxDetection", "_contrib_multibox_detection"))
+def _multibox_detection(attrs, cls_prob, loc_pred, anchor):
+    """Decode + per-class NMS (reference multibox_detection-inl.h).
+    cls_prob (B,C,N), loc_pred (B,N*4), anchor (1,N,4) →
+    (B, N, 6) rows [cls_id, score, xmin, ymin, xmax, ymax], cls_id=-1 pad."""
+    anchors = anchor[0]
+    N = anchors.shape[0]
+    var = jnp.asarray(attrs.variances)
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+
+    def one_sample(probs, locs):
+        loc = locs.reshape(N, 4)
+        cx = loc[:, 0] * var[0] * aw + acx
+        cy = loc[:, 1] * var[1] * ah + acy
+        w = jnp.exp(loc[:, 2] * var[2]) * aw
+        h = jnp.exp(loc[:, 3] * var[3]) * ah
+        boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                          axis=-1)
+        if attrs.clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        # best non-background class per anchor
+        bg = attrs.background_id
+        cls_scores = probs.at[bg].set(-1.0)
+        best_cls = jnp.argmax(cls_scores, axis=0)
+        best_score = jnp.max(cls_scores, axis=0)
+        keep = best_score > attrs.threshold
+        order = jnp.argsort(-jnp.where(keep, best_score, -jnp.inf))
+        sboxes = boxes[order]
+        sscores = jnp.where(keep, best_score, -1.0)[order]
+        scls = best_cls[order]
+        nms_keep = _greedy_nms(sboxes, sscores, attrs.nms_threshold,
+                               attrs.nms_topk)
+        final_valid = nms_keep & (sscores > attrs.threshold)
+        cls_out = jnp.where(final_valid, scls.astype(probs.dtype), -1.0)
+        score_out = jnp.where(final_valid, sscores, 0.0)
+        return jnp.concatenate([cls_out[:, None], score_out[:, None],
+                                sboxes], axis=1)
+
+    return jax.vmap(one_sample)(cls_prob, loc_pred).astype(cls_prob.dtype)
+
+
+# ---------------------------------------------------------------------------
+# ROI pooling (reference src/operator/roi_pooling-inl.h)
+# ---------------------------------------------------------------------------
+
+@register("ROIPooling", inputs=("data", "rois"),
+          params=dict(pooled_size=attr_shape(required=True),
+                      spatial_scale=attr_float(required=True)),
+          aliases=("_contrib_ROIPooling",))
+def _roi_pooling(attrs, data, rois):
+    """data (B,C,H,W), rois (R,5) [batch_idx,x1,y1,x2,y2] image coords."""
+    ph, pw = attrs.pooled_size
+    B, C, H, W = data.shape
+    scale = attrs.spatial_scale
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * scale).astype(jnp.int32)
+        y1 = jnp.round(roi[2] * scale).astype(jnp.int32)
+        x2 = jnp.round(roi[3] * scale).astype(jnp.int32)
+        y2 = jnp.round(roi[4] * scale).astype(jnp.int32)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        img = data[bidx]  # (C,H,W)
+
+        ys = jnp.arange(H)
+        xs = jnp.arange(W)
+
+        def pool_cell(py, px):
+            hstart = y1 + (py * rh) // ph
+            hend = y1 + jnp.maximum(((py + 1) * rh + ph - 1) // ph, 1)
+            wstart = x1 + (px * rw) // pw
+            wend = x1 + jnp.maximum(((px + 1) * rw + pw - 1) // pw, 1)
+            hstart = jnp.clip(hstart, 0, H)
+            hend = jnp.clip(hend, 0, H)
+            wstart = jnp.clip(wstart, 0, W)
+            wend = jnp.clip(wend, 0, W)
+            mask = ((ys[:, None] >= hstart) & (ys[:, None] < hend) &
+                    (xs[None, :] >= wstart) & (xs[None, :] < wend))
+            masked = jnp.where(mask[None], img, -jnp.inf)
+            val = jnp.max(masked, axis=(1, 2))
+            return jnp.where(jnp.isfinite(val), val, 0.0)
+
+        py_idx, px_idx = jnp.meshgrid(jnp.arange(ph), jnp.arange(pw),
+                                      indexing="ij")
+        cells = jax.vmap(jax.vmap(pool_cell))(py_idx, px_idx)  # (ph,pw,C)
+        return jnp.transpose(cells, (2, 0, 1))
+
+    return jax.vmap(one_roi)(rois).astype(data.dtype)
+
+
+@register("_contrib_Proposal",
+          inputs=("cls_prob", "bbox_pred", "im_info"),
+          params=dict(rpn_pre_nms_top_n=attr_int(6000),
+                      rpn_post_nms_top_n=attr_int(300),
+                      threshold=attr_float(0.7),
+                      rpn_min_size=attr_int(16),
+                      scales=_floats((4.0, 8.0, 16.0, 32.0)),
+                      ratios=_floats((0.5, 1.0, 2.0)),
+                      feature_stride=attr_int(16),
+                      output_score=attr_bool(False),
+                      iou_loss=attr_bool(False)),
+          aliases=("Proposal", "_contrib_proposal"))
+def _proposal(attrs, cls_prob, bbox_pred, im_info):
+    """RPN proposal layer (reference contrib/proposal-inl.h), fixed-shape:
+    returns (post_nms_top_n, 5) rois [batch0, x1,y1,x2,y2]."""
+    B, A2, H, W = cls_prob.shape
+    A = A2 // 2
+    stride = attrs.feature_stride
+    # base anchors at each cell
+    base = []
+    for r in attrs.ratios:
+        for s in attrs.scales:
+            size = stride * stride
+            ws = np.sqrt(size / r) * s / stride
+            hs = ws * r
+            base.append([-ws * stride / 2, -hs * stride / 2,
+                         ws * stride / 2, hs * stride / 2])
+    base = jnp.asarray(base[:A])  # (A,4)
+    shift_x = jnp.arange(W) * stride
+    shift_y = jnp.arange(H) * stride
+    sy, sx = jnp.meshgrid(shift_y, shift_x, indexing="ij")
+    shifts = jnp.stack([sx, sy, sx, sy], axis=-1).reshape(-1, 4)  # (HW,4)
+    anchors = (shifts[:, None, :] + base[None]).reshape(-1, 4)  # (HW*A,4)
+
+    scores = cls_prob[0, A:].transpose(1, 2, 0).reshape(-1)  # fg scores
+    deltas = bbox_pred[0].transpose(1, 2, 0).reshape(-1, 4)
+    aw = anchors[:, 2] - anchors[:, 0] + 1
+    ah = anchors[:, 3] - anchors[:, 1] + 1
+    acx = anchors[:, 0] + aw / 2
+    acy = anchors[:, 1] + ah / 2
+    cx = deltas[:, 0] * aw + acx
+    cy = deltas[:, 1] * ah + acy
+    w = jnp.exp(jnp.clip(deltas[:, 2], -10, 10)) * aw
+    h = jnp.exp(jnp.clip(deltas[:, 3], -10, 10)) * ah
+    boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                      axis=-1)
+    imh, imw = im_info[0, 0], im_info[0, 1]
+    boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, imw - 1),
+                       jnp.clip(boxes[:, 1], 0, imh - 1),
+                       jnp.clip(boxes[:, 2], 0, imw - 1),
+                       jnp.clip(boxes[:, 3], 0, imh - 1)], axis=-1)
+    keep_size = ((boxes[:, 2] - boxes[:, 0]) >= attrs.rpn_min_size) & \
+        ((boxes[:, 3] - boxes[:, 1]) >= attrs.rpn_min_size)
+    scores = jnp.where(keep_size, scores, -1.0)
+    pre_n = min(attrs.rpn_pre_nms_top_n, scores.shape[0])
+    top_scores, top_idx = jax.lax.top_k(scores, pre_n)
+    top_boxes = boxes[top_idx]
+    keep = _greedy_nms(top_boxes, top_scores, attrs.threshold, pre_n)
+    final_score = jnp.where(keep, top_scores, -jnp.inf)
+    post_n = min(attrs.rpn_post_nms_top_n, pre_n)
+    _, sel = jax.lax.top_k(final_score, post_n)
+    rois = top_boxes[sel]
+    out = jnp.concatenate([jnp.zeros((post_n, 1), rois.dtype), rois], axis=1)
+    if attrs.output_score:
+        return out
+    return out
+
+
+# ---------------------------------------------------------------------------
+# fft / count_sketch / quantization (reference contrib/)
+# ---------------------------------------------------------------------------
+
+@register("_contrib_fft", inputs=("data",),
+          params=dict(compute_size=attr_int(128)), aliases=("fft",))
+def _fft(attrs, x):
+    """reference contrib/fft-inl.h: rfft→ interleaved re/im, out last dim 2n."""
+    out = jnp.fft.fft(x.astype(jnp.complex64), axis=-1)
+    inter = jnp.stack([out.real, out.imag], axis=-1)
+    return inter.reshape(x.shape[:-1] + (2 * x.shape[-1],)).astype(x.dtype)
+
+
+@register("_contrib_ifft", inputs=("data",),
+          params=dict(compute_size=attr_int(128)), aliases=("ifft",))
+def _ifft(attrs, x):
+    n = x.shape[-1] // 2
+    pairs = x.reshape(x.shape[:-1] + (n, 2))
+    comp = pairs[..., 0] + 1j * pairs[..., 1]
+    out = jnp.fft.ifft(comp, axis=-1).real * n
+    return out.astype(x.dtype)
+
+
+@register("_contrib_count_sketch", inputs=("data", "h", "s"),
+          params=dict(out_dim=attr_int(required=True),
+                      processing_batch_size=attr_int(32)),
+          aliases=("count_sketch",))
+def _count_sketch(attrs, data, h, s):
+    """reference contrib/count_sketch-inl.h: y[h[i]] += s[i]*x[i]."""
+    d = attrs.out_dim
+    hi = h.reshape(-1).astype(jnp.int32)
+    si = s.reshape(-1)
+    out = jnp.zeros(data.shape[:-1] + (d,), data.dtype)
+    return out.at[..., hi].add(data * si)
+
+
+@register("_contrib_quantize", inputs=("data", "min_range", "max_range"),
+          params=dict(out_type=attr_str("uint8")),
+          num_outputs=3, aliases=("quantize",))
+def _quantize(attrs, data, min_range, max_range):
+    """Affine quantization (reference contrib/quantize-inl.h)."""
+    if attrs.out_type == "uint8":
+        qmin, qmax, dt = 0.0, 255.0, jnp.uint8
+    else:
+        qmin, qmax, dt = -127.0, 127.0, jnp.int8
+    scale = (qmax - qmin) / jnp.maximum(max_range - min_range, 1e-12)
+    q = jnp.clip(jnp.round((data - min_range) * scale + qmin), qmin, qmax)
+    return q.astype(dt), min_range, max_range
+
+
+@register("_contrib_dequantize", inputs=("data", "min_range", "max_range"),
+          params=dict(out_type=attr_str("float32")),
+          aliases=("dequantize",))
+def _dequantize(attrs, data, min_range, max_range):
+    if data.dtype == jnp.uint8:
+        qmin, qmax = 0.0, 255.0
+    else:
+        qmin, qmax = -127.0, 127.0
+    scale = jnp.maximum(max_range - min_range, 1e-12) / (qmax - qmin)
+    return ((data.astype(jnp.float32) - qmin) * scale + min_range).astype(
+        dtype_np(attrs.out_type))
+
+
+@register("_contrib_DeformableConvolution",
+          inputs=("data", "offset", "weight", "bias"),
+          params=dict(kernel=attr_shape(required=True), stride=attr_shape(()),
+                      dilate=attr_shape(()), pad=attr_shape(()),
+                      num_filter=attr_int(required=True),
+                      num_group=attr_int(1), num_deformable_group=attr_int(1),
+                      workspace=attr_int(1024), no_bias=attr_bool(False)),
+          aliases=("DeformableConvolution",))
+def _deformable_conv(attrs, data, offset, weight, bias=None):
+    """Deformable conv v1 (reference contrib/deformable_convolution-inl.h):
+    bilinear sampling at offset positions then standard conv contraction."""
+    B, C, H, W = data.shape
+    kh, kw = attrs.kernel
+    stride = attrs.stride or (1, 1)
+    pad = attrs.pad or (0, 0)
+    dil = attrs.dilate or (1, 1)
+    OH = (H + 2 * pad[0] - dil[0] * (kh - 1) - 1) // stride[0] + 1
+    OW = (W + 2 * pad[1] - dil[1] * (kw - 1) - 1) // stride[1] + 1
+
+    ys = jnp.arange(OH) * stride[0] - pad[0]
+    xs = jnp.arange(OW) * stride[1] - pad[1]
+    ky = jnp.arange(kh) * dil[0]
+    kx = jnp.arange(kw) * dil[1]
+    base_y = ys[:, None, None, None] + ky[None, None, :, None]  # OH,1,kh,1
+    base_x = xs[None, :, None, None] + kx[None, None, None, :]  # 1,OW,1,kw
+
+    def sample(img, py, px):
+        """bilinear sample img (H,W) at float coords py/px (broadcast)."""
+        y0 = jnp.floor(py).astype(jnp.int32)
+        x0 = jnp.floor(px).astype(jnp.int32)
+        y1, x1 = y0 + 1, x0 + 1
+        wy1 = py - y0
+        wx1 = px - x0
+        wy0 = 1 - wy1
+        wx0 = 1 - wx1
+
+        def at(yy, xx):
+            valid = (yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
+            yy = jnp.clip(yy, 0, H - 1)
+            xx = jnp.clip(xx, 0, W - 1)
+            return jnp.where(valid, img[yy, xx], 0.0)
+
+        return (wy0 * wx0 * at(y0, x0) + wy0 * wx1 * at(y0, x1) +
+                wy1 * wx0 * at(y1, x0) + wy1 * wx1 * at(y1, x1))
+
+    def one_image(img, off):
+        # off: (2*kh*kw*G, OH, OW) with G deformable groups (G=1 support)
+        off = off.reshape(-1, 2, kh, kw, OH, OW)[0]
+        dy = off[0].transpose(2, 3, 0, 1)  # OH,OW,kh,kw
+        dx = off[1].transpose(2, 3, 0, 1)
+        py = base_y + dy
+        px = base_x + dx
+
+        def per_channel(ch):
+            return sample(ch, py, px)  # OH,OW,kh,kw
+
+        patches = jax.vmap(per_channel)(img)  # C,OH,OW,kh,kw
+        out = jnp.einsum("cijhw,ochw->oij", patches,
+                         weight.reshape(weight.shape[0], C, kh, kw))
+        return out
+
+    out = jax.vmap(one_image)(data, offset)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out.astype(data.dtype)
+
+
+@register("_contrib_PSROIPooling",
+          inputs=("data", "rois"),
+          params=dict(spatial_scale=attr_float(required=True),
+                      output_dim=attr_int(required=True),
+                      pooled_size=attr_int(required=True),
+                      group_size=attr_int(0)),
+          aliases=("PSROIPooling",))
+def _psroi_pooling(attrs, data, rois):
+    """Position-sensitive ROI pooling (reference contrib/psroi_pooling).
+    data (B, output_dim*k*k, H, W); rois (R,5)."""
+    k = attrs.pooled_size
+    od = attrs.output_dim
+    B, C, H, W = data.shape
+    scale = attrs.spatial_scale
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = roi[1] * scale
+        y1 = roi[2] * scale
+        x2 = roi[3] * scale
+        y2 = roi[4] * scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_w = rw / k
+        bin_h = rh / k
+        img = data[bidx].reshape(od, k * k, H, W)
+        ys = jnp.arange(H)
+        xs = jnp.arange(W)
+
+        def pool_cell(py, px):
+            hstart = jnp.floor(y1 + py * bin_h).astype(jnp.int32)
+            hend = jnp.ceil(y1 + (py + 1) * bin_h).astype(jnp.int32)
+            wstart = jnp.floor(x1 + px * bin_w).astype(jnp.int32)
+            wend = jnp.ceil(x1 + (px + 1) * bin_w).astype(jnp.int32)
+            mask = ((ys[:, None] >= hstart) & (ys[:, None] < hend) &
+                    (xs[None, :] >= wstart) & (xs[None, :] < wend))
+            chan = img[:, py * k + px]  # (od, H, W)
+            cnt = jnp.maximum(mask.sum(), 1)
+            return jnp.where(mask[None], chan, 0.0).sum(axis=(1, 2)) / cnt
+
+        py_idx, px_idx = jnp.meshgrid(jnp.arange(k), jnp.arange(k),
+                                      indexing="ij")
+        cells = jax.vmap(jax.vmap(pool_cell))(py_idx, px_idx)  # k,k,od
+        return jnp.transpose(cells, (2, 0, 1))
+
+    return jax.vmap(one_roi)(rois).astype(data.dtype)
