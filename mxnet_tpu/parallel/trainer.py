@@ -78,7 +78,10 @@ class ShardedTrainer:
                                             factor_type="in", magnitude=2)
         rep = self.spec.replicated()
         params = []
-        rs = _np.random.RandomState(seed)
+        # deterministic init independent of global RNG history
+        from .. import rng as _rng_mod
+        saved = (_rng_mod._get().key, _rng_mod._get().counter)
+        _rng_mod.seed(seed)
         for n in self.param_names:
             s = known[n]
             host = _np.zeros(s.shape, _np.float32)
@@ -95,6 +98,7 @@ class ShardedTrainer:
             else:
                 dt = s.dtype
             params.append(jax.device_put(host.astype(dt), rep))
+        _rng_mod._get().key, _rng_mod._get().counter = saved
         mom = tuple(jax.device_put(np.zeros(known[n].shape, np.float32), rep)
                     for n in self.param_names)
         aux = tuple(jax.device_put(

@@ -1,0 +1,177 @@
+// im2rec — pack an image list into a RecordIO file (+ .idx).
+//
+// Reference: tools/im2rec.cc (OpenCV + dmlc recordio).  This version packs
+// encoded JPEG bytes directly (optional decode+resize+re-encode path via
+// libjpeg), multi-threaded with OpenMP.
+//
+// Usage: im2rec <prefix.lst> <image_root> <output_prefix> [resize=0]
+//        [quality=95] [num_thread=4]
+// .lst line: index \t label[ \t label...] \t relative_path
+#include <cstdio>
+#include <jpeglib.h>
+#include <omp.h>
+
+#include <cstring>
+#include <fstream>
+#include <iostream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "image_decode.h"
+#include "recordio.h"
+
+namespace {
+
+struct ListEntry {
+  uint64_t index;
+  std::vector<float> labels;
+  std::string path;
+};
+
+bool ReadList(const std::string& path, std::vector<ListEntry>* out) {
+  std::ifstream fin(path);
+  if (!fin) return false;
+  std::string line;
+  while (std::getline(fin, line)) {
+    if (line.empty()) continue;
+    std::istringstream ss(line);
+    std::vector<std::string> toks;
+    std::string tok;
+    while (std::getline(ss, tok, '\t')) toks.push_back(tok);
+    if (toks.size() < 3) continue;
+    ListEntry e;
+    e.index = std::stoull(toks[0]);
+    for (size_t i = 1; i + 1 < toks.size(); ++i)
+      e.labels.push_back(std::stof(toks[i]));
+    e.path = toks.back();
+    out->push_back(std::move(e));
+  }
+  return true;
+}
+
+bool ReadFile(const std::string& path, std::vector<uint8_t>* out) {
+  std::ifstream fin(path, std::ios::binary);
+  if (!fin) return false;
+  fin.seekg(0, std::ios::end);
+  out->resize((size_t)fin.tellg());
+  fin.seekg(0);
+  fin.read(reinterpret_cast<char*>(out->data()), out->size());
+  return true;
+}
+
+bool EncodeJPEG(const uint8_t* rgb, int h, int w, int quality,
+                std::vector<uint8_t>* out) {
+  jpeg_compress_struct cinfo;
+  jpeg_error_mgr jerr;
+  cinfo.err = jpeg_std_error(&jerr);
+  jpeg_create_compress(&cinfo);
+  unsigned char* mem = nullptr;
+  unsigned long mem_size = 0;
+  jpeg_mem_dest(&cinfo, &mem, &mem_size);
+  cinfo.image_width = w;
+  cinfo.image_height = h;
+  cinfo.input_components = 3;
+  cinfo.in_color_space = JCS_RGB;
+  jpeg_set_defaults(&cinfo);
+  jpeg_set_quality(&cinfo, quality, TRUE);
+  jpeg_start_compress(&cinfo, TRUE);
+  while (cinfo.next_scanline < cinfo.image_height) {
+    JSAMPROW row = const_cast<uint8_t*>(rgb + (size_t)cinfo.next_scanline * w * 3);
+    jpeg_write_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_compress(&cinfo);
+  out->assign(mem, mem + mem_size);
+  jpeg_destroy_compress(&cinfo);
+  free(mem);
+  return true;
+}
+
+std::vector<uint8_t> PackRecord(const ListEntry& e,
+                                const std::vector<uint8_t>& img) {
+  mxt::IRHeader hdr;
+  hdr.id = e.index;
+  hdr.id2 = 0;
+  std::vector<uint8_t> payload;
+  if (e.labels.size() == 1) {
+    hdr.flag = 0;
+    hdr.label = e.labels[0];
+  } else {
+    hdr.flag = (uint32_t)e.labels.size();
+    hdr.label = 0;
+    payload.resize(e.labels.size() * 4);
+    std::memcpy(payload.data(), e.labels.data(), payload.size());
+  }
+  std::vector<uint8_t> rec(sizeof(hdr) + payload.size() + img.size());
+  std::memcpy(rec.data(), &hdr, sizeof(hdr));
+  std::memcpy(rec.data() + sizeof(hdr), payload.data(), payload.size());
+  std::memcpy(rec.data() + sizeof(hdr) + payload.size(), img.data(),
+              img.size());
+  return rec;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    std::cerr << "Usage: im2rec <prefix.lst> <image_root> <output_prefix> "
+                 "[resize=0] [quality=95] [num_thread=4]\n";
+    return 1;
+  }
+  std::string lst_path = argv[1];
+  std::string root = argv[2];
+  std::string out_prefix = argv[3];
+  int resize = argc > 4 ? std::stoi(argv[4]) : 0;
+  int quality = argc > 5 ? std::stoi(argv[5]) : 95;
+  int threads = argc > 6 ? std::stoi(argv[6]) : 4;
+
+  std::vector<ListEntry> entries;
+  if (!ReadList(lst_path, &entries)) {
+    std::cerr << "cannot read list " << lst_path << "\n";
+    return 1;
+  }
+  mxt::RecordWriter writer(out_prefix + ".rec");
+  std::ofstream idx(out_prefix + ".idx");
+
+  const int chunk = 256;
+  size_t done = 0;
+  for (size_t start = 0; start < entries.size(); start += chunk) {
+    size_t n = std::min((size_t)chunk, entries.size() - start);
+    std::vector<std::vector<uint8_t>> recs(n);
+    #pragma omp parallel for num_threads(threads) schedule(dynamic)
+    for (int i = 0; i < (int)n; ++i) {
+      const ListEntry& e = entries[start + i];
+      std::vector<uint8_t> img;
+      if (!ReadFile(root + "/" + e.path, &img)) continue;
+      if (resize > 0) {
+        std::vector<uint8_t> decoded;
+        int h, w, c;
+        if (mxt::DecodeJPEG(img.data(), img.size(), &decoded, &h, &w, &c) &&
+            c == 3) {
+          int nh, nw;
+          if (h < w) {
+            nh = resize;
+            nw = (int)((int64_t)w * resize / h);
+          } else {
+            nw = resize;
+            nh = (int)((int64_t)h * resize / w);
+          }
+          std::vector<uint8_t> resized((size_t)nh * nw * 3);
+          mxt::ResizeBilinear(decoded.data(), h, w, 3, resized.data(), nh, nw);
+          EncodeJPEG(resized.data(), nh, nw, quality, &img);
+        }
+      }
+      recs[i] = PackRecord(e, img);
+    }
+    for (size_t i = 0; i < n; ++i) {
+      if (recs[i].empty()) continue;
+      uint64_t pos = writer.Write(recs[i].data(), recs[i].size());
+      idx << entries[start + i].index << "\t" << pos << "\n";
+    }
+    done += n;
+    if (done % 4096 < chunk)
+      std::cerr << "packed " << done << "/" << entries.size() << "\n";
+  }
+  std::cerr << "done: " << entries.size() << " records\n";
+  return 0;
+}

@@ -1,0 +1,155 @@
+#include "image_decode.h"
+
+#include <cstdio>
+#include <jpeglib.h>
+
+#include <algorithm>
+#include <csetjmp>
+#include <cstring>
+
+namespace mxt {
+
+namespace {
+struct JpegErr {
+  jpeg_error_mgr mgr;
+  jmp_buf jump;
+};
+
+void jpeg_error_exit(j_common_ptr cinfo) {
+  JpegErr* err = reinterpret_cast<JpegErr*>(cinfo->err);
+  longjmp(err->jump, 1);
+}
+
+inline uint64_t xorshift(uint64_t* s) {
+  uint64_t x = *s;
+  x ^= x << 13;
+  x ^= x >> 7;
+  x ^= x << 17;
+  *s = x;
+  return x;
+}
+}  // namespace
+
+bool DecodeJPEG(const uint8_t* data, size_t len, std::vector<uint8_t>* out,
+                int* height, int* width, int* channels) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = jpeg_error_exit;
+  if (setjmp(jerr.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(data), len);
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  int h = cinfo.output_height;
+  int w = cinfo.output_width;
+  int c = cinfo.output_components;
+  out->resize((size_t)h * w * c);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* row = out->data() + (size_t)cinfo.output_scanline * w * c;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  *height = h;
+  *width = w;
+  *channels = c;
+  return true;
+}
+
+void ResizeBilinear(const uint8_t* src, int sh, int sw, int c, uint8_t* dst,
+                    int dh, int dw) {
+  const float sy = (float)sh / dh;
+  const float sx = (float)sw / dw;
+  for (int y = 0; y < dh; ++y) {
+    float fy = (y + 0.5f) * sy - 0.5f;
+    int y0 = std::max(0, (int)fy);
+    int y1 = std::min(sh - 1, y0 + 1);
+    float wy = fy - y0;
+    if (wy < 0) wy = 0;
+    for (int x = 0; x < dw; ++x) {
+      float fx = (x + 0.5f) * sx - 0.5f;
+      int x0 = std::max(0, (int)fx);
+      int x1 = std::min(sw - 1, x0 + 1);
+      float wx = fx - x0;
+      if (wx < 0) wx = 0;
+      for (int ch = 0; ch < c; ++ch) {
+        float v00 = src[((size_t)y0 * sw + x0) * c + ch];
+        float v01 = src[((size_t)y0 * sw + x1) * c + ch];
+        float v10 = src[((size_t)y1 * sw + x0) * c + ch];
+        float v11 = src[((size_t)y1 * sw + x1) * c + ch];
+        float v = v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+                  v10 * wy * (1 - wx) + v11 * wy * wx;
+        dst[((size_t)y * dw + x) * c + ch] = (uint8_t)(v + 0.5f);
+      }
+    }
+  }
+}
+
+bool DecodeAugment(const uint8_t* jpeg, size_t len, const AugmentParams& p,
+                   float* out, uint64_t* rng_state) {
+  std::vector<uint8_t> img;
+  int h, w, c;
+  if (!DecodeJPEG(jpeg, len, &img, &h, &w, &c)) return false;
+
+  std::vector<uint8_t> resized;
+  if (p.resize_short > 0) {
+    int nh, nw;
+    if (h < w) {
+      nh = p.resize_short;
+      nw = (int)((int64_t)w * p.resize_short / h);
+    } else {
+      nw = p.resize_short;
+      nh = (int)((int64_t)h * p.resize_short / w);
+    }
+    resized.resize((size_t)nh * nw * c);
+    ResizeBilinear(img.data(), h, w, c, resized.data(), nh, nw);
+    img.swap(resized);
+    h = nh;
+    w = nw;
+  }
+
+  // crop to out_h x out_w (random or center); resize if too small
+  int ch_ = p.out_h, cw_ = p.out_w;
+  std::vector<uint8_t> crop((size_t)ch_ * cw_ * c);
+  if (h < ch_ || w < cw_) {
+    ResizeBilinear(img.data(), h, w, c, crop.data(), ch_, cw_);
+  } else {
+    int y0, x0;
+    if (p.rand_crop) {
+      y0 = (int)(xorshift(rng_state) % (uint64_t)(h - ch_ + 1));
+      x0 = (int)(xorshift(rng_state) % (uint64_t)(w - cw_ + 1));
+    } else {
+      y0 = (h - ch_) / 2;
+      x0 = (w - cw_) / 2;
+    }
+    for (int y = 0; y < ch_; ++y) {
+      std::memcpy(crop.data() + (size_t)y * cw_ * c,
+                  img.data() + ((size_t)(y + y0) * w + x0) * c,
+                  (size_t)cw_ * c);
+    }
+  }
+
+  bool mirror = p.rand_mirror && (xorshift(rng_state) & 1);
+
+  // HWC uint8 -> CHW float32 normalised
+  for (int ch2 = 0; ch2 < c && ch2 < 3; ++ch2) {
+    float mean = p.mean[ch2];
+    float stdv = p.std[ch2] != 0 ? p.std[ch2] : 1.0f;
+    float* dst = out + (size_t)ch2 * ch_ * cw_;
+    for (int y = 0; y < ch_; ++y) {
+      for (int x = 0; x < cw_; ++x) {
+        int sx2 = mirror ? (cw_ - 1 - x) : x;
+        dst[(size_t)y * cw_ + x] =
+            (crop[((size_t)y * cw_ + sx2) * c + ch2] - mean) / stdv;
+      }
+    }
+  }
+  return true;
+}
+
+}  // namespace mxt

@@ -1,0 +1,35 @@
+// JPEG decode + minimal augmentation — the native hot loop of the data
+// pipeline (reference: src/io/iter_image_recordio_2.cc:138-171, OpenCV
+// decode under OMP; here libjpeg + hand-rolled bilinear resize).
+#pragma once
+
+#include <cstdint>
+#include <cstddef>
+#include <vector>
+
+namespace mxt {
+
+// Decode JPEG bytes to RGB HWC uint8.  Returns false on failure.
+bool DecodeJPEG(const uint8_t* data, size_t len, std::vector<uint8_t>* out,
+                int* height, int* width, int* channels);
+
+// Bilinear resize HWC uint8.
+void ResizeBilinear(const uint8_t* src, int sh, int sw, int c, uint8_t* dst,
+                    int dh, int dw);
+
+struct AugmentParams {
+  int out_h = 224;
+  int out_w = 224;
+  int resize_short = 0;   // resize shorter edge first if > 0
+  bool rand_crop = false;
+  bool rand_mirror = false;
+  float mean[3] = {0, 0, 0};
+  float std[3] = {1, 1, 1};
+};
+
+// Decode + augment into float32 CHW at `out` (size c*out_h*out_w).
+// `rng_state` is a per-thread xorshift state for crop/mirror draws.
+bool DecodeAugment(const uint8_t* jpeg, size_t len, const AugmentParams& p,
+                   float* out, uint64_t* rng_state);
+
+}  // namespace mxt

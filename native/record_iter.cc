@@ -1,0 +1,237 @@
+// Threaded RecordIO image-batch loader, exported with a C ABI consumed by
+// Python via ctypes (mxnet_tpu/io/native.py).
+//
+// Pipeline shape mirrors the reference (src/io/iter_image_recordio_2.cc +
+// iter_prefetcher.h): a producer reads record frames, an OpenMP loop
+// decodes+augments JPEGs into pinned float batches, and a bounded queue of
+// ready batches feeds the consumer.  This is the host-side hot loop that
+// keeps the TPU fed.
+#include <omp.h>
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <random>
+#include <thread>
+#include <vector>
+
+#include "image_decode.h"
+#include "recordio.h"
+
+namespace mxt {
+
+struct Batch {
+  std::vector<float> data;
+  std::vector<float> label;
+  int pad = 0;
+};
+
+class RecordBatchIter {
+ public:
+  RecordBatchIter(const std::string& rec_path, const std::string& idx_path,
+                  int batch_size, int c, int h, int w, int label_width,
+                  int threads, bool shuffle, uint64_t seed,
+                  const AugmentParams& aug, int prefetch)
+      : reader_(rec_path), batch_size_(batch_size), c_(c), h_(h), w_(w),
+        label_width_(label_width), threads_(threads > 0 ? threads : 1),
+        shuffle_(shuffle), rng_(seed), aug_(aug),
+        prefetch_(prefetch > 0 ? prefetch : 2) {
+    if (!idx_path.empty()) {
+      LoadIndex(idx_path, &keys_, &offsets_);
+    }
+    Reset();
+  }
+
+  ~RecordBatchIter() { Stop(); }
+
+  void Reset() {
+    Stop();
+    if (!offsets_.empty()) {
+      order_.resize(offsets_.size());
+      for (size_t i = 0; i < order_.size(); ++i) order_[i] = i;
+      if (shuffle_) {
+        std::shuffle(order_.begin(), order_.end(), rng_);
+      }
+    }
+    cursor_ = 0;
+    reader_.Reset();
+    done_ = false;
+    stop_ = false;
+    producer_ = std::thread([this] { ProducerLoop(); });
+  }
+
+  // Copies the next batch into caller buffers. Returns pad (>=0), or -1 at
+  // epoch end.
+  int Next(float* data_out, float* label_out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_pop_.wait(lk, [this] { return !queue_.empty() || done_; });
+    if (queue_.empty()) return -1;
+    Batch b = std::move(queue_.front());
+    queue_.pop_front();
+    cv_push_.notify_one();
+    lk.unlock();
+    std::memcpy(data_out, b.data.data(), b.data.size() * sizeof(float));
+    std::memcpy(label_out, b.label.data(), b.label.size() * sizeof(float));
+    return b.pad;
+  }
+
+ private:
+  void Stop() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_push_.notify_all();
+    cv_pop_.notify_all();
+    if (producer_.joinable()) producer_.join();
+    queue_.clear();
+  }
+
+  bool ReadRaw(std::vector<uint8_t>* out) {
+    if (!order_.empty()) {
+      if (cursor_ >= order_.size()) return false;
+      reader_.Seek(offsets_[order_[cursor_++]]);
+      return reader_.Next(out);
+    }
+    return reader_.Next(out);
+  }
+
+  void ProducerLoop() {
+    const size_t img_elems = (size_t)c_ * h_ * w_;
+    while (true) {
+      // gather raw records for one batch
+      std::vector<std::vector<uint8_t>> raws;
+      raws.reserve(batch_size_);
+      for (int i = 0; i < batch_size_; ++i) {
+        std::vector<uint8_t> r;
+        if (!ReadRaw(&r)) break;
+        raws.push_back(std::move(r));
+      }
+      if (raws.empty()) break;
+      Batch b;
+      b.data.assign((size_t)batch_size_ * img_elems, 0.f);
+      b.label.assign((size_t)batch_size_ * label_width_, 0.f);
+      b.pad = batch_size_ - (int)raws.size();
+
+      // the OMP hot loop: parallel decode + augment
+      #pragma omp parallel for num_threads(threads_) schedule(dynamic)
+      for (int i = 0; i < (int)raws.size(); ++i) {
+        const auto& raw = raws[i];
+        if (raw.size() < sizeof(IRHeader)) continue;
+        IRHeader hdr;
+        std::memcpy(&hdr, raw.data(), sizeof(IRHeader));
+        const uint8_t* payload = raw.data() + sizeof(IRHeader);
+        size_t plen = raw.size() - sizeof(IRHeader);
+        if (hdr.flag > 0) {
+          size_t lbytes = (size_t)hdr.flag * 4;
+          for (int j = 0; j < label_width_ && j < (int)hdr.flag; ++j) {
+            float lv;
+            std::memcpy(&lv, payload + j * 4, 4);
+            b.label[(size_t)i * label_width_ + j] = lv;
+          }
+          payload += lbytes;
+          plen -= lbytes;
+        } else {
+          b.label[(size_t)i * label_width_] = hdr.label;
+        }
+        uint64_t rng = 0x9e3779b97f4a7c15ULL ^ ((uint64_t)seed_ctr_ + i);
+        DecodeAugment(payload, plen, aug_, b.data.data() + (size_t)i * img_elems,
+                      &rng);
+      }
+      ++seed_ctr_;
+      // fill pad slots by repeating
+      for (int j = (int)raws.size(); j < batch_size_; ++j) {
+        int src = j % (int)raws.size();
+        std::memcpy(b.data.data() + (size_t)j * img_elems,
+                    b.data.data() + (size_t)src * img_elems,
+                    img_elems * sizeof(float));
+        std::memcpy(b.label.data() + (size_t)j * label_width_,
+                    b.label.data() + (size_t)src * label_width_,
+                    label_width_ * sizeof(float));
+      }
+      std::unique_lock<std::mutex> lk(mu_);
+      cv_push_.wait(lk, [this] {
+        return queue_.size() < (size_t)prefetch_ || stop_;
+      });
+      if (stop_) return;
+      queue_.push_back(std::move(b));
+      cv_pop_.notify_one();
+    }
+    std::lock_guard<std::mutex> lk(mu_);
+    done_ = true;
+    cv_pop_.notify_all();
+  }
+
+  RecordReader reader_;
+  std::vector<uint64_t> keys_, offsets_;
+  std::vector<size_t> order_;
+  size_t cursor_ = 0;
+  int batch_size_, c_, h_, w_, label_width_, threads_;
+  bool shuffle_;
+  std::mt19937_64 rng_;
+  AugmentParams aug_;
+  int prefetch_;
+  uint64_t seed_ctr_ = 0;
+
+  std::thread producer_;
+  std::mutex mu_;
+  std::condition_variable cv_push_, cv_pop_;
+  std::deque<Batch> queue_;
+  bool done_ = false;
+  bool stop_ = false;
+};
+
+}  // namespace mxt
+
+// ----------------------------------------------------------------------
+// C ABI (consumed via ctypes)
+// ----------------------------------------------------------------------
+extern "C" {
+
+void* MXTRecordIterCreate(const char* rec_path, const char* idx_path,
+                          int batch_size, int c, int h, int w,
+                          int label_width, int threads, int shuffle,
+                          unsigned long long seed, int resize_short,
+                          int rand_crop, int rand_mirror, const float* mean,
+                          const float* stdv, int prefetch) {
+  mxt::AugmentParams aug;
+  aug.out_h = h;
+  aug.out_w = w;
+  aug.resize_short = resize_short;
+  aug.rand_crop = rand_crop != 0;
+  aug.rand_mirror = rand_mirror != 0;
+  for (int i = 0; i < 3; ++i) {
+    if (mean) aug.mean[i] = mean[i];
+    if (stdv) aug.std[i] = stdv[i];
+  }
+  return new mxt::RecordBatchIter(rec_path, idx_path ? idx_path : "",
+                                  batch_size, c, h, w, label_width, threads,
+                                  shuffle != 0, seed, aug, prefetch);
+}
+
+int MXTRecordIterNext(void* handle, float* data_out, float* label_out) {
+  return static_cast<mxt::RecordBatchIter*>(handle)->Next(data_out,
+                                                          label_out);
+}
+
+void MXTRecordIterReset(void* handle) {
+  static_cast<mxt::RecordBatchIter*>(handle)->Reset();
+}
+
+void MXTRecordIterFree(void* handle) {
+  delete static_cast<mxt::RecordBatchIter*>(handle);
+}
+
+// Standalone decode helper (for tests / tools).
+int MXTDecodeJPEG(const unsigned char* buf, size_t len, unsigned char* out,
+                  int out_capacity, int* h, int* w, int* c) {
+  std::vector<uint8_t> img;
+  if (!mxt::DecodeJPEG(buf, len, &img, h, w, c)) return -1;
+  if ((int)img.size() > out_capacity) return -2;
+  std::memcpy(out, img.data(), img.size());
+  return (int)img.size();
+}
+}

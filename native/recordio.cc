@@ -1,0 +1,63 @@
+#include "recordio.h"
+
+#include <cstring>
+
+namespace mxt {
+
+RecordReader::RecordReader(const std::string& path) {
+  fp_ = std::fopen(path.c_str(), "rb");
+}
+
+RecordReader::~RecordReader() {
+  if (fp_) std::fclose(fp_);
+}
+
+bool RecordReader::Next(std::vector<uint8_t>* out) {
+  uint32_t head[2];
+  if (std::fread(head, 4, 2, fp_) != 2) return false;
+  if (head[0] != kRecordMagic) return false;
+  uint32_t n = head[1] & kLenMask;
+  out->resize(n);
+  if (n && std::fread(out->data(), 1, n, fp_) != n) return false;
+  uint32_t pad = (4 - n % 4) % 4;
+  if (pad) std::fseek(fp_, pad, SEEK_CUR);
+  return true;
+}
+
+void RecordReader::Seek(uint64_t pos) { std::fseek(fp_, (long)pos, SEEK_SET); }
+uint64_t RecordReader::Tell() const { return (uint64_t)std::ftell(fp_); }
+void RecordReader::Reset() { std::fseek(fp_, 0, SEEK_SET); }
+
+RecordWriter::RecordWriter(const std::string& path) {
+  fp_ = std::fopen(path.c_str(), "wb");
+}
+
+RecordWriter::~RecordWriter() {
+  if (fp_) std::fclose(fp_);
+}
+
+uint64_t RecordWriter::Write(const uint8_t* data, size_t len) {
+  uint64_t pos = (uint64_t)std::ftell(fp_);
+  uint32_t head[2] = {kRecordMagic, (uint32_t)(len & kLenMask)};
+  std::fwrite(head, 4, 2, fp_);
+  std::fwrite(data, 1, len, fp_);
+  static const uint8_t zeros[4] = {0, 0, 0, 0};
+  uint32_t pad = (4 - len % 4) % 4;
+  if (pad) std::fwrite(zeros, 1, pad, fp_);
+  return pos;
+}
+
+bool LoadIndex(const std::string& idx_path, std::vector<uint64_t>* keys,
+               std::vector<uint64_t>* offsets) {
+  FILE* f = std::fopen(idx_path.c_str(), "r");
+  if (!f) return false;
+  unsigned long long k, off;
+  while (std::fscanf(f, "%llu\t%llu", &k, &off) == 2) {
+    keys->push_back(k);
+    offsets->push_back(off);
+  }
+  std::fclose(f);
+  return true;
+}
+
+}  // namespace mxt

@@ -1,0 +1,61 @@
+// RecordIO container format — binary-compatible with the reference
+// (dmlc-core recordio + src/io/image_recordio.h IRHeader).
+//
+// Frame: u32 magic 0xced7230a, u32 lrec (upper 3 bits continuation flag,
+// lower 29 length), payload, zero-pad to 4-byte alignment.
+// IRHeader: u32 flag, f32 label, u64 id, u64 id2 (little-endian), followed
+// by flag*4 bytes of extra float labels when flag > 0.
+#pragma once
+
+#include <cstdint>
+#include <cstdio>
+#include <string>
+#include <vector>
+
+namespace mxt {
+
+constexpr uint32_t kRecordMagic = 0xced7230a;
+constexpr uint32_t kLenMask = (1u << 29) - 1;
+
+#pragma pack(push, 1)
+struct IRHeader {
+  uint32_t flag;
+  float label;
+  uint64_t id;
+  uint64_t id2;
+};
+#pragma pack(pop)
+
+class RecordReader {
+ public:
+  explicit RecordReader(const std::string& path);
+  ~RecordReader();
+  // Read next record payload into `out`; returns false at EOF.
+  bool Next(std::vector<uint8_t>* out);
+  // Random access: seek to byte offset.
+  void Seek(uint64_t pos);
+  uint64_t Tell() const;
+  void Reset();
+  bool ok() const { return fp_ != nullptr; }
+
+ private:
+  FILE* fp_;
+};
+
+class RecordWriter {
+ public:
+  explicit RecordWriter(const std::string& path);
+  ~RecordWriter();
+  // Returns the byte offset the record was written at.
+  uint64_t Write(const uint8_t* data, size_t len);
+  bool ok() const { return fp_ != nullptr; }
+
+ private:
+  FILE* fp_;
+};
+
+// Parse .idx file (key \t offset per line).
+bool LoadIndex(const std::string& idx_path, std::vector<uint64_t>* keys,
+               std::vector<uint64_t>* offsets);
+
+}  // namespace mxt
