@@ -1,0 +1,98 @@
+"""ctypes binding to the native IO plane (native/libmxnet_tpu_io.so).
+
+The C++ side (native/record_iter.cc) implements the reference's hot host
+loop — RecordIO frame parsing + OMP-parallel JPEG decode/augment + bounded
+prefetch queue (iter_image_recordio_2.cc / iter_prefetcher.h) — and hands
+complete float32 NCHW batches across the ABI.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+_LIB = None
+
+
+def _find_lib():
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    cands = [os.path.join(here, "native", "build", "libmxnet_tpu_io.so"),
+             os.path.join(here, "libmxnet_tpu_io.so")]
+    for c in cands:
+        if os.path.isfile(c):
+            return c
+    return None
+
+
+def load_native():
+    """Load (and cache) the native library; returns None if not built."""
+    global _LIB
+    if _LIB is not None:
+        return _LIB
+    path = _find_lib()
+    if path is None:
+        return None
+    lib = ctypes.CDLL(path)
+    lib.MXTRecordIterCreate.restype = ctypes.c_void_p
+    lib.MXTRecordIterCreate.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_ulonglong, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int]
+    lib.MXTRecordIterNext.restype = ctypes.c_int
+    lib.MXTRecordIterNext.argtypes = [ctypes.c_void_p,
+                                      ctypes.POINTER(ctypes.c_float),
+                                      ctypes.POINTER(ctypes.c_float)]
+    lib.MXTRecordIterReset.argtypes = [ctypes.c_void_p]
+    lib.MXTRecordIterFree.argtypes = [ctypes.c_void_p]
+    _LIB = lib
+    return lib
+
+
+class NativeRecordIter:
+    """Python wrapper over the native batch iterator."""
+
+    def __init__(self, rec_path, data_shape, batch_size, idx_path=None,
+                 label_width=1, threads=4, shuffle=False, seed=0,
+                 resize_short=0, rand_crop=False, rand_mirror=False,
+                 mean=None, std=None, prefetch=4):
+        lib = load_native()
+        if lib is None:
+            raise RuntimeError(
+                "native IO library not built; run `make -C native`")
+        self._lib = lib
+        c, h, w = data_shape
+        self._shape = (batch_size, c, h, w)
+        self._label_shape = (batch_size, label_width)
+        mean_arr = (ctypes.c_float * 3)(*(mean or (0.0, 0.0, 0.0)))
+        std_arr = (ctypes.c_float * 3)(*(std or (1.0, 1.0, 1.0)))
+        self._handle = lib.MXTRecordIterCreate(
+            rec_path.encode(), (idx_path or "").encode(), batch_size, c, h,
+            w, label_width, threads, int(shuffle), seed, resize_short,
+            int(rand_crop), int(rand_mirror), mean_arr, std_arr, prefetch)
+        if not self._handle:
+            raise RuntimeError("failed to open %s" % rec_path)
+        self._data_buf = np.empty(self._shape, np.float32)
+        self._label_buf = np.empty(self._label_shape, np.float32)
+
+    def next(self):
+        """Returns (data, label, pad) or raises StopIteration."""
+        pad = self._lib.MXTRecordIterNext(
+            self._handle,
+            self._data_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            self._label_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if pad < 0:
+            raise StopIteration
+        return self._data_buf.copy(), self._label_buf.copy(), pad
+
+    def reset(self):
+        self._lib.MXTRecordIterReset(self._handle)
+
+    def __del__(self):
+        if getattr(self, "_handle", None):
+            self._lib.MXTRecordIterFree(self._handle)
+            self._handle = None

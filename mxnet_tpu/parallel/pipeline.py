@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 __all__ = ["pipeline_apply", "PipelineRunner"]
 
@@ -39,8 +39,9 @@ def pipeline_apply(stage_fn: Callable, num_stages: int, mesh: Mesh,
     S = num_stages
 
     def per_device(params_local, x_all):
-        # params_local: this device's stage params (leading axis removed by
-        # shard_map); x_all: full micro-batch stream (replicated)
+        # params_local: this device's stage params — shard_map keeps the
+        # (sharded) leading stage axis as size 1; squeeze it off
+        params_local = jax.tree_util.tree_map(lambda p: p[0], params_local)
         stage = jax.lax.axis_index(axis)
         mb_shape = x_all.shape[1:]
         T = M + S - 1
@@ -65,8 +66,9 @@ def pipeline_apply(stage_fn: Callable, num_stages: int, mesh: Mesh,
                 outputs)
             return (buf_next, outputs), None
 
-        buf0 = jnp.zeros(mb_shape, x_all.dtype)
-        outs0 = jnp.zeros((M,) + mb_shape, x_all.dtype)
+        buf0 = jax.lax.pvary(jnp.zeros(mb_shape, x_all.dtype), (axis,))
+        outs0 = jax.lax.pvary(jnp.zeros((M,) + mb_shape, x_all.dtype),
+                              (axis,))
         (_, outputs), _ = jax.lax.scan(tick, (buf0, outs0), jnp.arange(T))
         # only the last stage holds real outputs; broadcast them ring-wide
         outputs = jax.lax.psum(
