@@ -308,6 +308,7 @@ class Executor:
 
         self.outputs: List[NDArray] = []
         self._monitor_callback = None
+        self._last_keys = None  # RNG keys of the last forward, for backward
 
     # -- binding helpers -------------------------------------------------
     @staticmethod
@@ -351,7 +352,12 @@ class Executor:
         fn = self._prog._jit_forward(bool(is_train))
         args = tuple(a._handle for a in self.arg_arrays)
         aux = tuple(a._handle for a in self.aux_arrays)
-        outs, new_aux = fn(args, aux, self._keys())
+        keys = self._keys()
+        if is_train:
+            # only a train forward defines the mask backward must reuse; an
+            # interleaved eval forward (monitor/validation) must not clobber it
+            self._last_keys = keys
+        outs, new_aux = fn(args, aux, keys)
         if is_train:
             for nd_, na in zip(self.aux_arrays, new_aux):
                 nd_._handle = na
@@ -384,19 +390,23 @@ class Executor:
         fn = self._prog._jit_fwd_bwd(bool(is_train), mask)
         args = tuple(a._handle for a in self.arg_arrays)
         aux = tuple(a._handle for a in self.aux_arrays)
+        # Reuse the RNG keys of the preceding forward so dropout masks etc.
+        # match between the forward outputs and these gradients (reference
+        # reuses forward state); only draw fresh keys with no prior forward.
+        keys = self._last_keys if self._last_keys is not None else self._keys()
         if out_grads is None:
             if self.outputs:
                 cots = tuple(jnp.ones_like(o._handle) for o in self.outputs)
             else:
                 structs = jax.eval_shape(self._prog._jit_forward(bool(is_train)),
-                                         args, aux, self._keys())[0]
+                                         args, aux, keys)[0]
                 cots = tuple(jnp.ones(s.shape, s.dtype) for s in structs)
         else:
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]
             cots = tuple(g._handle if isinstance(g, NDArray) else jnp.asarray(g)
                          for g in out_grads)
-        _, _, grads = fn(args, aux, self._keys(), cots)
+        _, _, grads = fn(args, aux, keys, cots)
         self._write_grads(grads, mask)
 
     def run_fwd_bwd(self, out_cots=None, is_train=True):
@@ -408,6 +418,7 @@ class Executor:
         args = tuple(a._handle for a in self.arg_arrays)
         aux = tuple(a._handle for a in self.aux_arrays)
         keys = self._keys()
+        self._last_keys = keys
         if not any(mask):
             outs, new_aux = self._prog._jit_forward(bool(is_train))(
                 args, aux, keys)

@@ -97,8 +97,20 @@ class KVStore:
             if self._updater is not None:
                 self._updater(self._updater_key(k), merged, self._store[k])
             else:
+                # No updater: the merged value REPLACES the stored one
+                # (reference kvstore_local.h:190 "local = merged"); adding
+                # here would corrupt update_on_kvstore=False training.
                 stored = self._store[k]
-                stored._handle = stored._handle + merged._handle
+                if isinstance(merged, RowSparseNDArray) or \
+                        isinstance(stored, RowSparseNDArray):
+                    if isinstance(merged, RowSparseNDArray):
+                        # snapshot: don't alias the caller's object, which it
+                        # may mutate after push (reference copies on merge)
+                        merged = RowSparseNDArray(
+                            merged._data, merged._indices, merged.shape)
+                    self._store[k] = merged
+                else:
+                    stored._handle = merged._handle
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         """Broadcast the stored value to each out array, keeping each on its

@@ -578,23 +578,15 @@ def waitall():
 
 
 def save(fname: str, data):
-    """Save NDArrays (list or str->NDArray dict) — reference MXNDArraySave.
-    The reference's binary container becomes an npz archive written at the
-    exact path given (same call signature, same list/dict round-trip)."""
-    if isinstance(data, NDArray):
-        data = [data]
-    with open(fname, "wb") as f:
-        if isinstance(data, dict):
-            np.savez(f, **{"dict:" + k: v.asnumpy() for k, v in data.items()})
-        else:
-            np.savez(f, **{"list:%d" % i: v.asnumpy()
-                           for i, v in enumerate(data)})
+    """Save NDArrays (list or str->NDArray dict) in the reference's binary
+    container so checkpoints interchange with upstream (MXNDArraySave;
+    format in serialization.py)."""
+    from .serialization import save as _save
+    _save(fname, data)
 
 
 def load(fname: str):
-    with np.load(fname, allow_pickle=False) as f:
-        keys = list(f.keys())
-        if keys and keys[0].startswith("dict:"):
-            return {k[5:]: array(f[k]) for k in keys}
-        pairs = sorted((int(k.split(":")[1]), f[k]) for k in keys)
-        return [array(v) for _, v in pairs]
+    """Load a reference binary NDArray container (MXNDArrayLoad); legacy
+    npz checkpoints from round 1 still load."""
+    from .serialization import load as _load
+    return _load(fname)

@@ -1,0 +1,234 @@
+"""Reference-binary-compatible NDArray container serialization.
+
+Implements the exact on-disk format of the reference's
+``MXNDArraySave/Load`` (src/ndarray/ndarray.cc:890-1129) so ``-%04d.params``
+checkpoints and pretrained weights can be exchanged with upstream MXNet:
+
+  file  := uint64 0x112 (kMXAPINDArrayListMagic) | uint64 reserved
+           | vec<ndarray> | vec<string names>
+  vec   := uint64 count | elements                 (dmlc serializer layout)
+  string:= uint64 length | bytes
+  ndarray (V2, magic 0xF993fac9, ndarray.cc:896-961):
+           uint32 magic | int32 stype
+           | [storage_shape  if stype sparse]
+           | shape | int32 dev_type,int32 dev_id (Context::Save, base.h:197)
+           | int32 type_flag
+           | per-aux: int32 aux_type | aux_shape   (sparse only)
+           | raw data bytes | raw aux bytes
+  shape := uint32 ndim | int64[ndim]               (nnvm TShape::Save)
+
+Storage types (include/mxnet/ndarray.h:60-65): dense=0, row_sparse=1, csr=2.
+Aux layouts: row_sparse -> [indices]; csr -> [indptr, indices]
+(ndarray.h:52-58).  Type flags mirror python/mxnet/ndarray/ndarray.py:57-66.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Union
+
+import numpy as np
+
+from ..base import MXNetError
+
+_LIST_MAGIC = 0x112
+_ND_MAGIC_V2 = 0xF993FAC9
+_ND_MAGIC_V1 = 0xF993FAC8
+
+_FLAG_OF_DTYPE = {
+    np.dtype(np.float32): 0,
+    np.dtype(np.float64): 1,
+    np.dtype(np.float16): 2,
+    np.dtype(np.uint8): 3,
+    np.dtype(np.int32): 4,
+    np.dtype(np.int8): 5,
+    np.dtype(np.int64): 6,
+}
+_DTYPE_OF_FLAG = {v: k for k, v in _FLAG_OF_DTYPE.items()}
+
+_STYPE_DENSE, _STYPE_ROW_SPARSE, _STYPE_CSR = 0, 1, 2
+_DEV_CPU = 1  # Context::kCPU
+
+
+def _write_shape(out, shape):
+    out.append(struct.pack("<I", len(shape)))
+    if shape:
+        out.append(np.asarray(shape, "<i8").tobytes())
+
+
+def _write_dense_record(out, arr: np.ndarray):
+    if arr.ndim == 0:
+        # the reference format has no 0-d representation (an ndim-0 shape
+        # marks a "none" array and carries no payload), so scalars are
+        # stored as shape (1,) — the MXNet-1.x convention for scalars
+        arr = arr.reshape(1)
+    arr = np.ascontiguousarray(arr)
+    flag = _FLAG_OF_DTYPE.get(arr.dtype)
+    if flag is None:
+        raise MXNetError(
+            "dtype %s has no reference binary encoding (save as float32 or "
+            "use a supported dtype)" % arr.dtype)
+    out.append(struct.pack("<Ii", _ND_MAGIC_V2, _STYPE_DENSE))
+    _write_shape(out, arr.shape)
+    out.append(struct.pack("<iii", _DEV_CPU, 0, flag))
+    out.append(arr.tobytes())
+
+
+def _write_sparse_record(out, stype, data, shape, aux):
+    """aux: list of (np int64 array, shape tuple)."""
+    data = np.ascontiguousarray(data)
+    flag = _FLAG_OF_DTYPE[data.dtype]
+    out.append(struct.pack("<Ii", _ND_MAGIC_V2, stype))
+    _write_shape(out, data.shape)      # storage_shape
+    _write_shape(out, shape)           # logical shape
+    out.append(struct.pack("<iii", _DEV_CPU, 0, flag))
+    for a, ashape in aux:
+        out.append(struct.pack("<i", _FLAG_OF_DTYPE[np.dtype(a.dtype)]))
+        _write_shape(out, ashape)
+    out.append(data.tobytes())
+    for a, _ in aux:
+        out.append(np.ascontiguousarray(a).tobytes())
+
+
+def save(fname: str, data) -> None:
+    """Write NDArrays (NDArray | list | {name: NDArray}) in the reference
+    binary container (MXNDArraySave, src/c_api/c_api.cc:307)."""
+    from .ndarray import NDArray
+    from .sparse import CSRNDArray, RowSparseNDArray
+
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        names = list(data.keys())
+        arrays = [data[k] for k in names]
+    else:
+        names = []
+        arrays = list(data)
+
+    out: List[bytes] = [struct.pack("<QQ", _LIST_MAGIC, 0),
+                        struct.pack("<Q", len(arrays))]
+    for arr in arrays:
+        if isinstance(arr, RowSparseNDArray):
+            idx = np.asarray(arr._indices, "<i8")
+            _write_sparse_record(
+                out, _STYPE_ROW_SPARSE, np.asarray(arr._data), arr.shape,
+                [(idx, idx.shape)])
+        elif isinstance(arr, CSRNDArray):
+            indptr = np.asarray(arr._indptr, "<i8")
+            idx = np.asarray(arr._indices, "<i8")
+            _write_sparse_record(
+                out, _STYPE_CSR, np.asarray(arr._data), arr.shape,
+                [(indptr, indptr.shape), (idx, idx.shape)])
+        else:
+            _write_dense_record(out, arr.asnumpy())
+    out.append(struct.pack("<Q", len(names)))
+    for n in names:
+        b = n.encode("utf-8")
+        out.append(struct.pack("<Q", len(b)))
+        out.append(b)
+    with open(fname, "wb") as f:
+        f.write(b"".join(out))
+
+
+class _Reader:
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+
+    def take(self, n) -> bytes:
+        if self.pos + n > len(self.buf):
+            raise MXNetError("Invalid NDArray file format (truncated)")
+        b = self.buf[self.pos:self.pos + n]
+        self.pos += n
+        return b
+
+    def u32(self):
+        return struct.unpack("<I", self.take(4))[0]
+
+    def i32(self):
+        return struct.unpack("<i", self.take(4))[0]
+
+    def u64(self):
+        return struct.unpack("<Q", self.take(8))[0]
+
+    def shape(self):
+        ndim = self.u32()
+        return tuple(np.frombuffer(self.take(8 * ndim), "<i8").tolist())
+
+    def raw(self, dtype, count):
+        dt = np.dtype(dtype)
+        return np.frombuffer(self.take(dt.itemsize * count), dt).copy()
+
+
+def _read_record(r: _Reader):
+    from .ndarray import array as nd_array
+    from .sparse import CSRNDArray, RowSparseNDArray
+    import jax.numpy as jnp
+
+    magic = r.u32()
+    if magic == _ND_MAGIC_V2:
+        stype = r.i32()
+        sshape = r.shape() if stype != _STYPE_DENSE else None
+        shape = r.shape()
+    elif magic == _ND_MAGIC_V1:
+        stype, sshape = _STYPE_DENSE, None
+        shape = r.shape()
+    else:
+        # pre-V1 legacy: magic is ndim, dims are uint32
+        stype, sshape = _STYPE_DENSE, None
+        shape = tuple(np.frombuffer(r.take(4 * magic), "<u4").tolist())
+    if len(shape) == 0:
+        return nd_array(np.zeros((0,), np.float32))
+    r.i32(); r.i32()  # context (dev_type, dev_id) — always load to host
+    flag = r.i32()
+    if flag not in _DTYPE_OF_FLAG:
+        raise MXNetError("Invalid NDArray file format (type flag %d)" % flag)
+    dt = _DTYPE_OF_FLAG[flag]
+    if stype == _STYPE_DENSE:
+        n = int(np.prod(shape)) if shape else 1
+        return nd_array(r.raw(dt, n).reshape(shape))
+    aux_meta = []
+    nad = 1 if stype == _STYPE_ROW_SPARSE else 2
+    for _ in range(nad):
+        aflag = r.i32()
+        aux_meta.append((_DTYPE_OF_FLAG[aflag], r.shape()))
+    data = r.raw(dt, int(np.prod(sshape)) if sshape else 0)
+    data = data.reshape(sshape)
+    auxes = [r.raw(adt, int(np.prod(ashape)) if ashape else 0)
+             for adt, ashape in aux_meta]
+    if stype == _STYPE_ROW_SPARSE:
+        return RowSparseNDArray(jnp.asarray(data), jnp.asarray(auxes[0]),
+                                shape)
+    return CSRNDArray(jnp.asarray(data), jnp.asarray(auxes[1]),
+                      jnp.asarray(auxes[0]), shape)
+
+
+def load(fname: str) -> Union[List, Dict]:
+    """Load a reference binary NDArray container (MXNDArrayLoad).  Falls
+    back to the npz container this repo wrote before round 2."""
+    with open(fname, "rb") as f:
+        buf = f.read()
+    if buf[:2] == b"PK":  # zip archive: legacy npz checkpoint
+        return _load_npz(buf)
+    r = _Reader(buf)
+    header = r.u64()
+    r.u64()  # reserved
+    if header != _LIST_MAGIC:
+        raise MXNetError("Invalid NDArray file format (bad header)")
+    arrays = [_read_record(r) for _ in range(r.u64())]
+    names = [r.take(r.u64()).decode("utf-8") for _ in range(r.u64())]
+    if names and len(names) != len(arrays):
+        raise MXNetError("Invalid NDArray file format (name count)")
+    if names:
+        return dict(zip(names, arrays))
+    return arrays
+
+
+def _load_npz(buf: bytes):
+    import io
+    from .ndarray import array as nd_array
+    with np.load(io.BytesIO(buf), allow_pickle=False) as f:
+        keys = list(f.keys())
+        if keys and keys[0].startswith("dict:"):
+            return {k[5:]: nd_array(f[k]) for k in keys}
+        pairs = sorted((int(k.split(":")[1]), f[k]) for k in keys)
+        return [nd_array(v) for _, v in pairs]

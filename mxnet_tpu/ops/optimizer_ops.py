@@ -27,6 +27,15 @@ def _prep_grad(attrs, grad):
     return g
 
 
+def _prep_grad_wd(attrs, grad, weight):
+    """For ops that fold wd into the grad (adam/rmsprop families), the
+    reference adds wd*weight BEFORE clipping (optimizer_op-inl.h:773)."""
+    g = grad * attrs.rescale_grad + attrs.wd * weight
+    if attrs.clip_gradient > 0:
+        g = jnp.clip(g, -attrs.clip_gradient, attrs.clip_gradient)
+    return g
+
+
 @register("sgd_update", inputs=("weight", "grad"),
           params=dict(_COMMON, lazy_update=attr_bool(True)),
           writeback={0: 0})
@@ -72,7 +81,7 @@ def _mp_sgd_mom_update(attrs, weight, grad, mom, weight32):
           num_outputs=3, num_visible_outputs=1,
           writeback={0: 0, 2: 1, 3: 2})
 def _adam_update(attrs, weight, grad, mean, var):
-    g = _prep_grad(attrs, grad) + attrs.wd * weight
+    g = _prep_grad_wd(attrs, grad, weight)
     new_mean = attrs.beta1 * mean + (1 - attrs.beta1) * g
     new_var = attrs.beta2 * var + (1 - attrs.beta2) * g * g
     new_w = weight - attrs.lr * new_mean / (jnp.sqrt(new_var) + attrs.epsilon)
@@ -84,7 +93,7 @@ def _adam_update(attrs, weight, grad, mean, var):
                       clip_weights=attr_float(-1.0)),
           num_outputs=2, num_visible_outputs=1, writeback={0: 0, 2: 1})
 def _rmsprop_update(attrs, weight, grad, n):
-    g = _prep_grad(attrs, grad) + attrs.wd * weight
+    g = _prep_grad_wd(attrs, grad, weight)
     new_n = (1 - attrs.gamma1) * g * g + attrs.gamma1 * n
     new_w = weight - attrs.lr * g / jnp.sqrt(new_n + attrs.epsilon)
     if attrs.clip_weights > 0:
@@ -98,7 +107,7 @@ def _rmsprop_update(attrs, weight, grad, n):
           num_outputs=4, num_visible_outputs=1,
           writeback={0: 0, 2: 1, 3: 2, 4: 3})
 def _rmspropalex_update(attrs, weight, grad, n, g_state, delta):
-    g = _prep_grad(attrs, grad) + attrs.wd * weight
+    g = _prep_grad_wd(attrs, grad, weight)
     new_n = (1 - attrs.gamma1) * g * g + attrs.gamma1 * n
     new_g = (1 - attrs.gamma1) * g + attrs.gamma1 * g_state
     new_delta = attrs.gamma2 * delta - attrs.lr * g / jnp.sqrt(

@@ -121,3 +121,22 @@ def test_dist_kv_single_process():
     out = nd.empty(SHAPE)
     kv.pull(3, out=out)
     assert_almost_equal(out.asnumpy(), np.ones(SHAPE))
+
+
+def test_push_no_updater_replaces():
+    """Without an updater, push REPLACES the stored value (reference
+    kvstore_local.h:190 "local = merged") — it must not accumulate."""
+    kv = mx.kv.create("local")
+    kv.init(3, nd.ones(SHAPE))
+    kv.push(3, nd.ones(SHAPE) * 8)
+    out = nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    assert_almost_equal(out.asnumpy(), np.full(SHAPE, 8.0))
+    # a second push replaces again, no accumulation across steps
+    kv.push(3, nd.ones(SHAPE) * 2)
+    kv.pull(3, out=out)
+    assert_almost_equal(out.asnumpy(), np.full(SHAPE, 2.0))
+    # multi-device push still reduces the pushed list, then replaces
+    kv.push(3, [nd.ones(SHAPE), nd.ones(SHAPE) * 3])
+    kv.pull(3, out=out)
+    assert_almost_equal(out.asnumpy(), np.full(SHAPE, 4.0))

@@ -153,3 +153,19 @@ def test_updater_states_roundtrip():
                                  rescale_grad=1.0))
     u2.set_states(blob)
     assert 0 in u2.states
+
+
+def test_adam_clip_after_wd():
+    """adam_update applies clip_gradient AFTER adding wd*weight (reference
+    optimizer_op-inl.h:773: grad = rescale*grad + wd*weight, then clip)."""
+    import numpy as np
+    w = mx.nd.array(np.full((4,), 10.0, np.float32))
+    g = mx.nd.array(np.zeros((4,), np.float32))
+    mean = mx.nd.zeros((4,))
+    var = mx.nd.zeros((4,))
+    # wd*weight = 1.0 exceeds clip 0.5 even though grad itself is 0:
+    # effective g must be clipped to 0.5, not 0 + 1.0
+    mx.nd.adam_update(w, g, mean, var, out=w, lr=1.0, wd=0.1,
+                      clip_gradient=0.5, beta1=0.0, beta2=0.0, epsilon=0.0)
+    # with beta1=beta2=0: mean=g_eff=0.5, var=0.25, step=lr*0.5/0.5=1.0
+    np.testing.assert_allclose(w.asnumpy(), np.full((4,), 9.0), rtol=1e-5)

@@ -138,3 +138,19 @@ def test_variable_init_attr():
     w = sym.Variable("w", lr_mult=2.0, wd_mult=0.5)
     assert w.attr("__lr_mult__") == "2.0"
     assert w.attr("__wd_mult__") == "0.5"
+
+
+def test_backward_reuses_forward_rng():
+    """backward() must reuse the dropout mask drawn by the preceding
+    forward() (reference reuses forward state; ADVICE r1)."""
+    import numpy as np
+    data = mx.sym.Variable("data")
+    out = mx.sym.Dropout(data, p=0.5)
+    x = np.random.uniform(1.0, 2.0, (64, 64)).astype(np.float32)
+    exe = out.simple_bind(mx.cpu(), data=x.shape)
+    exe.arg_dict["data"][:] = x
+    y = exe.forward(is_train=True)[0].asnumpy()
+    exe.backward(mx.nd.ones((64, 64)))
+    g = exe.grad_dict["data"].asnumpy()
+    # for dropout, dy/dx == y/x elementwise iff the same mask was used
+    np.testing.assert_allclose(g, y / x, rtol=1e-5)
