@@ -43,16 +43,36 @@ _FLAG_OF_DTYPE = {
     np.dtype(np.int8): 5,
     np.dtype(np.int64): 6,
 }
+try:  # bfloat16 = flag 7, the convention later upstream adopted (mshadow
+    import ml_dtypes  # kBfloat16); this repo's first-class training dtype
+    _FLAG_OF_DTYPE[np.dtype(ml_dtypes.bfloat16)] = 7
+except ImportError:  # pragma: no cover
+    pass
 _DTYPE_OF_FLAG = {v: k for k, v in _FLAG_OF_DTYPE.items()}
+
+
+def _flag_of(dtype) -> int:
+    flag = _FLAG_OF_DTYPE.get(np.dtype(dtype))
+    if flag is None:
+        raise MXNetError(
+            "dtype %s has no reference binary encoding" % np.dtype(dtype))
+    return flag
+
+
+def _dtype_of(flag: int):
+    dt = _DTYPE_OF_FLAG.get(flag)
+    if dt is None:
+        raise MXNetError("Invalid NDArray file format (type flag %d)" % flag)
+    return dt
 
 _STYPE_DENSE, _STYPE_ROW_SPARSE, _STYPE_CSR = 0, 1, 2
 _DEV_CPU = 1  # Context::kCPU
 
 
 def _write_shape(out, shape):
-    out.append(struct.pack("<I", len(shape)))
+    out.write(struct.pack("<I", len(shape)))
     if shape:
-        out.append(np.asarray(shape, "<i8").tobytes())
+        out.write(np.asarray(shape, "<i8").tobytes())
 
 
 def _write_dense_record(out, arr: np.ndarray):
@@ -62,31 +82,27 @@ def _write_dense_record(out, arr: np.ndarray):
         # stored as shape (1,) — the MXNet-1.x convention for scalars
         arr = arr.reshape(1)
     arr = np.ascontiguousarray(arr)
-    flag = _FLAG_OF_DTYPE.get(arr.dtype)
-    if flag is None:
-        raise MXNetError(
-            "dtype %s has no reference binary encoding (save as float32 or "
-            "use a supported dtype)" % arr.dtype)
-    out.append(struct.pack("<Ii", _ND_MAGIC_V2, _STYPE_DENSE))
+    flag = _flag_of(arr.dtype)
+    out.write(struct.pack("<Ii", _ND_MAGIC_V2, _STYPE_DENSE))
     _write_shape(out, arr.shape)
-    out.append(struct.pack("<iii", _DEV_CPU, 0, flag))
-    out.append(arr.tobytes())
+    out.write(struct.pack("<iii", _DEV_CPU, 0, flag))
+    out.write(arr.tobytes())
 
 
 def _write_sparse_record(out, stype, data, shape, aux):
     """aux: list of (np int64 array, shape tuple)."""
     data = np.ascontiguousarray(data)
-    flag = _FLAG_OF_DTYPE[data.dtype]
-    out.append(struct.pack("<Ii", _ND_MAGIC_V2, stype))
+    flag = _flag_of(data.dtype)
+    out.write(struct.pack("<Ii", _ND_MAGIC_V2, stype))
     _write_shape(out, data.shape)      # storage_shape
     _write_shape(out, shape)           # logical shape
-    out.append(struct.pack("<iii", _DEV_CPU, 0, flag))
+    out.write(struct.pack("<iii", _DEV_CPU, 0, flag))
     for a, ashape in aux:
-        out.append(struct.pack("<i", _FLAG_OF_DTYPE[np.dtype(a.dtype)]))
+        out.write(struct.pack("<i", _flag_of(a.dtype)))
         _write_shape(out, ashape)
-    out.append(data.tobytes())
+    out.write(data.tobytes())
     for a, _ in aux:
-        out.append(np.ascontiguousarray(a).tobytes())
+        out.write(np.ascontiguousarray(a).tobytes())
 
 
 def save(fname: str, data) -> None:
@@ -104,29 +120,27 @@ def save(fname: str, data) -> None:
         names = []
         arrays = list(data)
 
-    out: List[bytes] = [struct.pack("<QQ", _LIST_MAGIC, 0),
-                        struct.pack("<Q", len(arrays))]
-    for arr in arrays:
-        if isinstance(arr, RowSparseNDArray):
-            idx = np.asarray(arr._indices, "<i8")
-            _write_sparse_record(
-                out, _STYPE_ROW_SPARSE, np.asarray(arr._data), arr.shape,
-                [(idx, idx.shape)])
-        elif isinstance(arr, CSRNDArray):
-            indptr = np.asarray(arr._indptr, "<i8")
-            idx = np.asarray(arr._indices, "<i8")
-            _write_sparse_record(
-                out, _STYPE_CSR, np.asarray(arr._data), arr.shape,
-                [(indptr, indptr.shape), (idx, idx.shape)])
-        else:
-            _write_dense_record(out, arr.asnumpy())
-    out.append(struct.pack("<Q", len(names)))
-    for n in names:
-        b = n.encode("utf-8")
-        out.append(struct.pack("<Q", len(b)))
-        out.append(b)
-    with open(fname, "wb") as f:
-        f.write(b"".join(out))
+    with open(fname, "wb") as out:  # streamed: one record in memory at a time
+        out.write(struct.pack("<QQQ", _LIST_MAGIC, 0, len(arrays)))
+        for arr in arrays:
+            if isinstance(arr, RowSparseNDArray):
+                idx = np.asarray(arr._indices, "<i8")
+                _write_sparse_record(
+                    out, _STYPE_ROW_SPARSE, np.asarray(arr._data), arr.shape,
+                    [(idx, idx.shape)])
+            elif isinstance(arr, CSRNDArray):
+                indptr = np.asarray(arr._indptr, "<i8")
+                idx = np.asarray(arr._indices, "<i8")
+                _write_sparse_record(
+                    out, _STYPE_CSR, np.asarray(arr._data), arr.shape,
+                    [(indptr, indptr.shape), (idx, idx.shape)])
+            else:
+                _write_dense_record(out, arr.asnumpy())
+        out.write(struct.pack("<Q", len(names)))
+        for n in names:
+            b = n.encode("utf-8")
+            out.write(struct.pack("<Q", len(b)))
+            out.write(b)
 
 
 class _Reader:
@@ -179,18 +193,14 @@ def _read_record(r: _Reader):
     if len(shape) == 0:
         return nd_array(np.zeros((0,), np.float32))
     r.i32(); r.i32()  # context (dev_type, dev_id) — always load to host
-    flag = r.i32()
-    if flag not in _DTYPE_OF_FLAG:
-        raise MXNetError("Invalid NDArray file format (type flag %d)" % flag)
-    dt = _DTYPE_OF_FLAG[flag]
+    dt = _dtype_of(r.i32())
     if stype == _STYPE_DENSE:
         n = int(np.prod(shape)) if shape else 1
         return nd_array(r.raw(dt, n).reshape(shape))
     aux_meta = []
     nad = 1 if stype == _STYPE_ROW_SPARSE else 2
     for _ in range(nad):
-        aflag = r.i32()
-        aux_meta.append((_DTYPE_OF_FLAG[aflag], r.shape()))
+        aux_meta.append((_dtype_of(r.i32()), r.shape()))
     data = r.raw(dt, int(np.prod(sshape)) if sshape else 0)
     data = data.reshape(sshape)
     auxes = [r.raw(adt, int(np.prod(ashape)) if ashape else 0)

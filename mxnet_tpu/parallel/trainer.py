@@ -173,7 +173,9 @@ class ShardedTrainer:
 
 
 def sgd_step_fn(trainer: ShardedTrainer):
-    """Expose the raw jitted step (for dryrun/compile checks)."""
+    """Expose the raw jitted step (bench/dryrun path).  Buffers are donated
+    — params/mom/aux update in place in HBM; callers must rebind their
+    references to the returned state every call."""
     if trainer._step is None:
-        trainer._step = trainer._build_step(donate=False)
+        trainer._step = trainer._build_step()
     return trainer._step
