@@ -1,0 +1,1406 @@
+/*!
+ * C ABI implementation for mxnet_tpu (reference: src/c_api/c_api*.cc).
+ *
+ * The TPU runtime lives in Python (JAX/XLA); this shim embeds CPython and
+ * dispatches every C call to mxnet_tpu/capi.py, which owns the handle
+ * registry.  Handles crossing the ABI are integer ids cast to void*.
+ *
+ * Thread model: every entry point takes the GIL (PyGILState_Ensure), so
+ * the ABI is safe to call from any thread.  Returned const char* / array
+ * pointers live in thread-local storage and stay valid until the next API
+ * call on the same thread — the reference's MXAPIThreadLocalEntry
+ * convention.
+ */
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "mxnet_tpu_c_api.h"
+
+namespace {
+
+struct TLS {
+  std::string last_error;
+  std::vector<std::string> strs;
+  std::vector<const char *> cstrs;
+  std::vector<void *> handles;
+  std::vector<void *> handles2;
+  std::vector<void *> handles3;
+  std::vector<mx_uint> shape;
+  std::string text;
+  std::vector<char> bytes;
+  // infer_shape outputs: [arg, out, aux]
+  std::vector<mx_uint> ndims[3];
+  std::vector<std::vector<mx_uint>> dims[3];
+  std::vector<const mx_uint *> dim_ptrs[3];
+  std::vector<int> types[3];
+};
+thread_local TLS tls;
+
+PyObject *g_mod = nullptr;       // mxnet_tpu.capi
+std::once_flag g_init_flag;
+bool g_owns_interp = false;
+
+void InitPython() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    g_owns_interp = true;
+  }
+  PyGILState_STATE st = PyGILState_Ensure();
+  // make the package importable: $MXNET_TPU_HOME takes priority
+  const char *home = std::getenv("MXNET_TPU_HOME");
+  if (home != nullptr) {
+    PyObject *sys_path = PySys_GetObject("path");  // borrowed
+    PyObject *p = PyUnicode_FromString(home);
+    PyList_Insert(sys_path, 0, p);
+    Py_DECREF(p);
+  }
+  g_mod = PyImport_ImportModule("mxnet_tpu.capi");
+  if (g_mod == nullptr) {
+    PyObject *ptype, *pvalue, *ptb;
+    PyErr_Fetch(&ptype, &pvalue, &ptb);
+    PyObject *s = pvalue ? PyObject_Str(pvalue) : nullptr;
+    tls.last_error = std::string("cannot import mxnet_tpu.capi: ") +
+                     (s ? PyUnicode_AsUTF8(s) : "unknown error");
+    Py_XDECREF(s);
+    Py_XDECREF(ptype);
+    Py_XDECREF(pvalue);
+    Py_XDECREF(ptb);
+  }
+  if (g_owns_interp) {
+    // release the GIL acquired by Py_Initialize so PyGILState_Ensure
+    // works from any thread (including this one) from now on
+    PyGILState_Release(st);
+    PyEval_SaveThread();
+  } else {
+    PyGILState_Release(st);
+  }
+}
+
+class Gil {
+ public:
+  Gil() {
+    std::call_once(g_init_flag, InitPython);
+    st_ = PyGILState_Ensure();
+  }
+  ~Gil() { PyGILState_Release(st_); }
+
+ private:
+  PyGILState_STATE st_;
+};
+
+int Fail(const std::string &msg) {
+  tls.last_error = msg;
+  return -1;
+}
+
+int FailFromPython() {
+  if (!PyErr_Occurred()) {
+    // e.g. the bridge module failed to import: keep the stored diagnosis
+    if (tls.last_error.empty()) tls.last_error = "python error";
+    return -1;
+  }
+  PyObject *ptype, *pvalue, *ptb;
+  PyErr_Fetch(&ptype, &pvalue, &ptb);
+  PyErr_NormalizeException(&ptype, &pvalue, &ptb);
+  std::string msg = "python error";
+  if (pvalue != nullptr) {
+    PyObject *s = PyObject_Str(pvalue);
+    if (s != nullptr) {
+      const char *c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) msg = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(ptype);
+  Py_XDECREF(pvalue);
+  Py_XDECREF(ptb);
+  return Fail(msg);
+}
+
+// call g_mod.<fn>(*args); steals args reference; returns new ref or null
+PyObject *Call(const char *fn, PyObject *args) {
+  if (g_mod == nullptr) {
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject *f = PyObject_GetAttrString(g_mod, fn);
+  if (f == nullptr) {
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject *r = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  Py_XDECREF(args);
+  return r;
+}
+
+uintptr_t H(const void *h) { return reinterpret_cast<uintptr_t>(h); }
+void *HP(long long id) { return reinterpret_cast<void *>(
+    static_cast<uintptr_t>(id)); }
+
+PyObject *StrList(const char **arr, mx_uint n) {
+  PyObject *l = PyList_New(n);
+  for (mx_uint i = 0; i < n; ++i)
+    PyList_SetItem(l, i, PyUnicode_FromString(arr[i] ? arr[i] : ""));
+  return l;
+}
+
+PyObject *HandleList(void *const *arr, mx_uint n) {
+  PyObject *l = PyList_New(n);
+  for (mx_uint i = 0; i < n; ++i)
+    PyList_SetItem(l, i, PyLong_FromUnsignedLongLong(H(arr[i])));
+  return l;
+}
+
+PyObject *IntList(const int *arr, mx_uint n) {
+  PyObject *l = PyList_New(n);
+  for (mx_uint i = 0; i < n; ++i)
+    PyList_SetItem(l, i, PyLong_FromLong(arr[i]));
+  return l;
+}
+
+// parse a python list of str into tls.strs/cstrs; returns count
+int ParseStrList(PyObject *obj, mx_uint *out_size, const char ***out_array) {
+  Py_ssize_t n = PySequence_Size(obj);
+  tls.strs.clear();
+  tls.strs.reserve(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *it = PySequence_GetItem(obj, i);
+    const char *c = PyUnicode_AsUTF8(it);
+    tls.strs.emplace_back(c ? c : "");
+    Py_DECREF(it);
+  }
+  tls.cstrs.clear();
+  for (auto &s : tls.strs) tls.cstrs.push_back(s.c_str());
+  *out_size = static_cast<mx_uint>(n);
+  *out_array = tls.cstrs.data();
+  return 0;
+}
+
+int ParseHandleList(PyObject *obj, mx_uint *out_size, void ***out_array) {
+  Py_ssize_t n = PySequence_Size(obj);
+  tls.handles.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *it = PySequence_GetItem(obj, i);
+    tls.handles.push_back(HP(PyLong_AsLongLong(it)));
+    Py_DECREF(it);
+  }
+  *out_size = static_cast<mx_uint>(n);
+  *out_array = tls.handles.data();
+  return 0;
+}
+
+// op-name interning for AtomicSymbolCreator handles
+std::vector<std::string> *g_op_names = nullptr;
+std::mutex g_op_mutex;
+
+int EnsureOpNames() {
+  std::lock_guard<std::mutex> lock(g_op_mutex);
+  if (g_op_names != nullptr) return 0;
+  PyObject *r = Call("list_all_op_names", PyTuple_New(0));
+  if (r == nullptr) return FailFromPython();
+  auto *v = new std::vector<std::string>();
+  Py_ssize_t n = PySequence_Size(r);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *it = PySequence_GetItem(r, i);
+    v->emplace_back(PyUnicode_AsUTF8(it));
+    Py_DECREF(it);
+  }
+  Py_DECREF(r);
+  g_op_names = v;
+  return 0;
+}
+
+const char *CreatorName(AtomicSymbolCreator creator) {
+  return reinterpret_cast<const std::string *>(creator)->c_str();
+}
+
+#define API_BEGIN() Gil gil_; try {
+#define API_END()                                    \
+  return 0;                                          \
+  } catch (const std::exception &e) {                \
+    return Fail(e.what());                           \
+  }
+#define CHECK_PY(r) if ((r) == nullptr) return FailFromPython()
+
+}  // namespace
+
+/* ---- part 0 ---- */
+
+const char *MXGetLastError() { return tls.last_error.c_str(); }
+
+int MXGetVersion(int *out) {
+  API_BEGIN();
+  PyObject *r = Call("get_version", PyTuple_New(0));
+  CHECK_PY(r);
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXRandomSeed(int seed) {
+  API_BEGIN();
+  PyObject *r = Call("random_seed", Py_BuildValue("(i)", seed));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNotifyShutdown() {
+  API_BEGIN();
+  PyObject *r = Call("notify_shutdown", PyTuple_New(0));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXSetProfilerConfig(int mode, const char *filename) {
+  API_BEGIN();
+  PyObject *r = Call("profiler_set_config", Py_BuildValue("(is)", mode,
+                                                          filename));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXSetProfilerState(int state) {
+  API_BEGIN();
+  PyObject *r = Call("profiler_set_state", Py_BuildValue("(i)", state));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXDumpProfile() {
+  API_BEGIN();
+  PyObject *r = Call("dump_profile", PyTuple_New(0));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+/* ---- part 1: NDArray ---- */
+
+int MXNDArrayCreateNone(NDArrayHandle *out) {
+  API_BEGIN();
+  PyObject *r = Call("ndarray_create_none", PyTuple_New(0));
+  CHECK_PY(r);
+  *out = HP(PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+static int CreateImpl(const mx_uint *shape, mx_uint ndim, int dev_type,
+                      int dev_id, int delay_alloc, int dtype,
+                      NDArrayHandle *out) {
+  PyObject *shp = PyTuple_New(ndim);
+  for (mx_uint i = 0; i < ndim; ++i)
+    PyTuple_SetItem(shp, i, PyLong_FromUnsignedLong(shape[i]));
+  PyObject *r = Call("ndarray_create",
+                     Py_BuildValue("(Niiii)", shp, dev_type, dev_id,
+                                   delay_alloc, dtype));
+  CHECK_PY(r);
+  *out = HP(PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, int dev_type,
+                    int dev_id, int delay_alloc, NDArrayHandle *out) {
+  API_BEGIN();
+  return CreateImpl(shape, ndim, dev_type, dev_id, delay_alloc, 0, out);
+  API_END();
+}
+
+int MXNDArrayCreateEx(const mx_uint *shape, mx_uint ndim, int dev_type,
+                      int dev_id, int delay_alloc, int dtype,
+                      NDArrayHandle *out) {
+  API_BEGIN();
+  return CreateImpl(shape, ndim, dev_type, dev_id, delay_alloc, dtype, out);
+  API_END();
+}
+
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                             size_t size) {
+  /* size is the ELEMENT count, as in the reference
+     (NDArray::SyncCopyFromCPU, ndarray.cc:1137) */
+  API_BEGIN();
+  PyObject *r = Call("ndarray_copy_from_ptr",
+                     Py_BuildValue("(KKK)", (unsigned long long)H(handle),
+                                   (unsigned long long)H(data),
+                                   (unsigned long long)size));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t size) {
+  API_BEGIN();
+  PyObject *r = Call("ndarray_copy_to_ptr",
+                     Py_BuildValue("(KKK)", (unsigned long long)H(handle),
+                                   (unsigned long long)H(data),
+                                   (unsigned long long)size));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDArrayWaitToRead(NDArrayHandle handle) {
+  API_BEGIN();
+  PyObject *r = Call("ndarray_wait_to_read",
+                     Py_BuildValue("(K)", (unsigned long long)H(handle)));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDArrayWaitToWrite(NDArrayHandle handle) {
+  return MXNDArrayWaitToRead(handle);
+}
+
+int MXNDArrayWaitAll() {
+  API_BEGIN();
+  PyObject *r = Call("ndarray_wait_all", PyTuple_New(0));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDArrayFree(NDArrayHandle handle) {
+  API_BEGIN();
+  PyObject *r = Call("ndarray_free",
+                     Py_BuildValue("(K)", (unsigned long long)H(handle)));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDArraySlice(NDArrayHandle handle, mx_uint begin, mx_uint end,
+                   NDArrayHandle *out) {
+  API_BEGIN();
+  PyObject *r = Call("ndarray_slice",
+                     Py_BuildValue("(KII)", (unsigned long long)H(handle),
+                                   begin, end));
+  CHECK_PY(r);
+  *out = HP(PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDArrayAt(NDArrayHandle handle, mx_uint idx, NDArrayHandle *out) {
+  API_BEGIN();
+  PyObject *r = Call("ndarray_at",
+                     Py_BuildValue("(KI)", (unsigned long long)H(handle),
+                                   idx));
+  CHECK_PY(r);
+  *out = HP(PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDArrayReshape(NDArrayHandle handle, int ndim, int *dims,
+                     NDArrayHandle *out) {
+  API_BEGIN();
+  PyObject *r = Call("ndarray_reshape",
+                     Py_BuildValue("(KN)", (unsigned long long)H(handle),
+                                   IntList(dims, ndim)));
+  CHECK_PY(r);
+  *out = HP(PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_dim,
+                      const mx_uint **out_pdata) {
+  API_BEGIN();
+  PyObject *r = Call("ndarray_shape",
+                     Py_BuildValue("(K)", (unsigned long long)H(handle)));
+  CHECK_PY(r);
+  Py_ssize_t n = PySequence_Size(r);
+  tls.shape.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *it = PySequence_GetItem(r, i);
+    tls.shape.push_back(static_cast<mx_uint>(PyLong_AsUnsignedLong(it)));
+    Py_DECREF(it);
+  }
+  Py_DECREF(r);
+  *out_dim = static_cast<mx_uint>(n);
+  *out_pdata = tls.shape.data();
+  API_END();
+}
+
+int MXNDArrayGetDType(NDArrayHandle handle, int *out) {
+  API_BEGIN();
+  PyObject *r = Call("ndarray_dtype",
+                     Py_BuildValue("(K)", (unsigned long long)H(handle)));
+  CHECK_PY(r);
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDArrayGetStorageType(NDArrayHandle handle, int *out) {
+  API_BEGIN();
+  PyObject *r = Call("ndarray_stype",
+                     Py_BuildValue("(K)", (unsigned long long)H(handle)));
+  CHECK_PY(r);
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
+                        int *out_dev_id) {
+  API_BEGIN();
+  PyObject *r = Call("ndarray_context",
+                     Py_BuildValue("(K)", (unsigned long long)H(handle)));
+  CHECK_PY(r);
+  *out_dev_type = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 0)));
+  *out_dev_id = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 1)));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDArraySave(const char *fname, mx_uint num_args, NDArrayHandle *args,
+                  const char **keys) {
+  API_BEGIN();
+  PyObject *names = keys ? StrList(keys, num_args) : PyList_New(0);
+  PyObject *r = Call("ndarray_save",
+                     Py_BuildValue("(sNN)", fname,
+                                   HandleList(args, num_args), names));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDArrayLoad(const char *fname, mx_uint *out_size,
+                  NDArrayHandle **out_arr, mx_uint *out_name_size,
+                  const char ***out_names) {
+  API_BEGIN();
+  PyObject *r = Call("ndarray_load", Py_BuildValue("(s)", fname));
+  CHECK_PY(r);
+  mx_uint nh;
+  ParseHandleList(PyTuple_GetItem(r, 0), &nh, out_arr);
+  *out_size = nh;
+  ParseStrList(PyTuple_GetItem(r, 1), out_name_size, out_names);
+  Py_DECREF(r);
+  API_END();
+}
+
+/* ---- part 2: ops ---- */
+
+int MXListAllOpNames(mx_uint *out_size, const char ***out_array) {
+  API_BEGIN();
+  PyObject *r = Call("list_all_op_names", PyTuple_New(0));
+  CHECK_PY(r);
+  ParseStrList(r, out_size, out_array);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
+                                     AtomicSymbolCreator **out_array) {
+  API_BEGIN();
+  if (EnsureOpNames() != 0) return -1;
+  tls.handles.clear();
+  for (auto &s : *g_op_names)
+    tls.handles.push_back(const_cast<std::string *>(&s));
+  *out_size = static_cast<mx_uint>(tls.handles.size());
+  *out_array = tls.handles.data();
+  API_END();
+}
+
+int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                const char **name) {
+  API_BEGIN();
+  *name = CreatorName(creator);
+  API_END();
+}
+
+int MXSymbolGetAtomicSymbolInfo(AtomicSymbolCreator creator,
+                                const char **name, const char **description,
+                                mx_uint *num_args, const char ***arg_names,
+                                const char ***arg_type_infos,
+                                const char ***arg_descriptions,
+                                const char **key_var_num_args) {
+  API_BEGIN();
+  PyObject *r = Call("op_info", Py_BuildValue("(s)", CreatorName(creator)));
+  CHECK_PY(r);
+  static thread_local std::string t_name, t_desc;
+  static thread_local std::vector<std::string> t_args, t_types, t_descs;
+  static thread_local std::vector<const char *> t_argp, t_typep, t_descp;
+  t_name = PyUnicode_AsUTF8(PyTuple_GetItem(r, 0));
+  t_desc = PyUnicode_AsUTF8(PyTuple_GetItem(r, 1));
+  auto fill = [](PyObject *lst, std::vector<std::string> &store,
+                 std::vector<const char *> &ptrs) {
+    store.clear();
+    ptrs.clear();
+    Py_ssize_t n = PySequence_Size(lst);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *it = PySequence_GetItem(lst, i);
+      store.emplace_back(PyUnicode_AsUTF8(it));
+      Py_DECREF(it);
+    }
+    for (auto &s : store) ptrs.push_back(s.c_str());
+  };
+  fill(PyTuple_GetItem(r, 2), t_args, t_argp);
+  fill(PyTuple_GetItem(r, 3), t_types, t_typep);
+  fill(PyTuple_GetItem(r, 4), t_descs, t_descp);
+  Py_DECREF(r);
+  *name = t_name.c_str();
+  *description = t_desc.c_str();
+  *num_args = static_cast<mx_uint>(t_args.size());
+  *arg_names = t_argp.data();
+  *arg_type_infos = t_typep.data();
+  *arg_descriptions = t_descp.data();
+  if (key_var_num_args) *key_var_num_args = "";
+  API_END();
+}
+
+int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
+                       NDArrayHandle *inputs, int *num_outputs,
+                       NDArrayHandle **outputs, int num_params,
+                       const char **param_keys, const char **param_vals) {
+  API_BEGIN();
+  PyObject *outs = (*num_outputs > 0)
+                       ? HandleList(*outputs, *num_outputs)
+                       : PyList_New(0);
+  PyObject *r = Call(
+      "imperative_invoke",
+      Py_BuildValue("(sNNNN)", CreatorName(creator),
+                    HandleList(inputs, num_inputs), outs,
+                    StrList(param_keys, num_params),
+                    StrList(param_vals, num_params)));
+  CHECK_PY(r);
+  if (*num_outputs > 0) {
+    // caller-provided outputs were filled in place: leave the caller's
+    // array pointer untouched (reference convention)
+    Py_DECREF(r);
+  } else {
+    mx_uint n;
+    void **arr;
+    ParseHandleList(r, &n, &arr);
+    Py_DECREF(r);
+    *num_outputs = static_cast<int>(n);
+    *outputs = arr;
+  }
+  API_END();
+}
+
+/* ---- part 3: Symbol ---- */
+
+int MXSymbolCreateAtomicSymbol(AtomicSymbolCreator creator, mx_uint num_param,
+                               const char **keys, const char **vals,
+                               SymbolHandle *out) {
+  API_BEGIN();
+  PyObject *r = Call("symbol_create_atomic",
+                     Py_BuildValue("(sNN)", CreatorName(creator),
+                                   StrList(keys, num_param),
+                                   StrList(vals, num_param)));
+  CHECK_PY(r);
+  *out = HP(PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXSymbolCreateVariable(const char *name, SymbolHandle *out) {
+  API_BEGIN();
+  PyObject *r = Call("symbol_create_variable", Py_BuildValue("(s)", name));
+  CHECK_PY(r);
+  *out = HP(PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXSymbolCreateGroup(mx_uint num_symbols, SymbolHandle *symbols,
+                        SymbolHandle *out) {
+  API_BEGIN();
+  PyObject *r = Call("symbol_create_group",
+                     Py_BuildValue("(N)", HandleList(symbols, num_symbols)));
+  CHECK_PY(r);
+  *out = HP(PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out) {
+  API_BEGIN();
+  PyObject *r = Call("symbol_from_json", Py_BuildValue("(s)", json));
+  CHECK_PY(r);
+  *out = HP(PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out) {
+  API_BEGIN();
+  PyObject *r = Call("symbol_from_file", Py_BuildValue("(s)", fname));
+  CHECK_PY(r);
+  *out = HP(PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXSymbolSaveToJSON(SymbolHandle symbol, const char **out_json) {
+  API_BEGIN();
+  PyObject *r = Call("symbol_tojson",
+                     Py_BuildValue("(K)", (unsigned long long)H(symbol)));
+  CHECK_PY(r);
+  tls.text = PyUnicode_AsUTF8(r);
+  Py_DECREF(r);
+  *out_json = tls.text.c_str();
+  API_END();
+}
+
+int MXSymbolSaveToFile(SymbolHandle symbol, const char *fname) {
+  API_BEGIN();
+  PyObject *r = Call("symbol_save_file",
+                     Py_BuildValue("(Ks)", (unsigned long long)H(symbol),
+                                   fname));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXSymbolFree(SymbolHandle symbol) {
+  API_BEGIN();
+  PyObject *r = Call("free_handle",
+                     Py_BuildValue("(K)", (unsigned long long)H(symbol)));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXSymbolCopy(SymbolHandle symbol, SymbolHandle *out) {
+  API_BEGIN();
+  PyObject *r = Call("symbol_copy",
+                     Py_BuildValue("(K)", (unsigned long long)H(symbol)));
+  CHECK_PY(r);
+  *out = HP(PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXSymbolPrint(SymbolHandle symbol, const char **out_str) {
+  API_BEGIN();
+  PyObject *r = Call("symbol_print",
+                     Py_BuildValue("(K)", (unsigned long long)H(symbol)));
+  CHECK_PY(r);
+  tls.text = PyUnicode_AsUTF8(r);
+  Py_DECREF(r);
+  *out_str = tls.text.c_str();
+  API_END();
+}
+
+int MXSymbolGetName(SymbolHandle symbol, const char **out, int *success) {
+  API_BEGIN();
+  PyObject *r = Call("symbol_get_name",
+                     Py_BuildValue("(K)", (unsigned long long)H(symbol)));
+  CHECK_PY(r);
+  if (r == Py_None) {
+    *success = 0;
+    *out = nullptr;
+  } else {
+    tls.text = PyUnicode_AsUTF8(r);
+    *out = tls.text.c_str();
+    *success = 1;
+  }
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXSymbolGetAttr(SymbolHandle symbol, const char *key, const char **out,
+                    int *success) {
+  API_BEGIN();
+  PyObject *r = Call("symbol_get_attr",
+                     Py_BuildValue("(Ks)", (unsigned long long)H(symbol),
+                                   key));
+  CHECK_PY(r);
+  if (r == Py_None) {
+    *success = 0;
+    *out = nullptr;
+  } else {
+    tls.text = PyUnicode_AsUTF8(r);
+    *out = tls.text.c_str();
+    *success = 1;
+  }
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXSymbolSetAttr(SymbolHandle symbol, const char *key, const char *value) {
+  API_BEGIN();
+  PyObject *r = Call("symbol_set_attr",
+                     Py_BuildValue("(Kss)", (unsigned long long)H(symbol),
+                                   key, value));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXSymbolCompose(SymbolHandle sym, const char *name, mx_uint num_args,
+                    const char **keys, SymbolHandle *args) {
+  API_BEGIN();
+  PyObject *pkeys;
+  if (keys != nullptr) {
+    pkeys = StrList(keys, num_args);
+  } else {
+    pkeys = Py_None;
+    Py_INCREF(Py_None);
+  }
+  PyObject *pname;
+  if (name != nullptr) {
+    pname = PyUnicode_FromString(name);
+  } else {
+    pname = Py_None;
+    Py_INCREF(Py_None);
+  }
+  PyObject *r = Call("symbol_compose",
+                     Py_BuildValue("(KNNN)", (unsigned long long)H(sym),
+                                   pname, pkeys,
+                                   HandleList(args, num_args)));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+static int ListStrImpl(const char *fn, SymbolHandle symbol, mx_uint *out_size,
+                       const char ***out_str_array) {
+  PyObject *r = Call(fn, Py_BuildValue("(K)", (unsigned long long)H(symbol)));
+  CHECK_PY(r);
+  ParseStrList(r, out_size, out_str_array);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXSymbolListArguments(SymbolHandle symbol, mx_uint *out_size,
+                          const char ***out_str_array) {
+  API_BEGIN();
+  return ListStrImpl("symbol_list_arguments", symbol, out_size,
+                     out_str_array);
+  API_END();
+}
+
+int MXSymbolListOutputs(SymbolHandle symbol, mx_uint *out_size,
+                        const char ***out_str_array) {
+  API_BEGIN();
+  return ListStrImpl("symbol_list_outputs", symbol, out_size, out_str_array);
+  API_END();
+}
+
+int MXSymbolListAuxiliaryStates(SymbolHandle symbol, mx_uint *out_size,
+                                const char ***out_str_array) {
+  API_BEGIN();
+  return ListStrImpl("symbol_list_aux", symbol, out_size, out_str_array);
+  API_END();
+}
+
+int MXSymbolGetNumOutputs(SymbolHandle symbol, mx_uint *output_count) {
+  API_BEGIN();
+  PyObject *r = Call("symbol_num_outputs",
+                     Py_BuildValue("(K)", (unsigned long long)H(symbol)));
+  CHECK_PY(r);
+  *output_count = static_cast<mx_uint>(PyLong_AsUnsignedLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXSymbolGetOutput(SymbolHandle symbol, mx_uint index, SymbolHandle *out) {
+  API_BEGIN();
+  PyObject *r = Call("symbol_get_output",
+                     Py_BuildValue("(KI)", (unsigned long long)H(symbol),
+                                   index));
+  CHECK_PY(r);
+  *out = HP(PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXSymbolGetInternals(SymbolHandle symbol, SymbolHandle *out) {
+  API_BEGIN();
+  PyObject *r = Call("symbol_get_internals",
+                     Py_BuildValue("(K)", (unsigned long long)H(symbol)));
+  CHECK_PY(r);
+  *out = HP(PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+static void FillShapeTriple(PyObject *lst, int slot, mx_uint *size,
+                            const mx_uint **ndim_out,
+                            const mx_uint ***data_out) {
+  auto &nd = tls.ndims[slot];
+  auto &dd = tls.dims[slot];
+  auto &pp = tls.dim_ptrs[slot];
+  nd.clear();
+  dd.clear();
+  pp.clear();
+  Py_ssize_t n = PySequence_Size(lst);
+  dd.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *s = PySequence_GetItem(lst, i);
+    Py_ssize_t m = PySequence_Size(s);
+    nd.push_back(static_cast<mx_uint>(m));
+    for (Py_ssize_t j = 0; j < m; ++j) {
+      PyObject *d = PySequence_GetItem(s, j);
+      dd[i].push_back(static_cast<mx_uint>(PyLong_AsUnsignedLong(d)));
+      Py_DECREF(d);
+    }
+    Py_DECREF(s);
+  }
+  for (auto &v : dd) pp.push_back(v.data());
+  *size = static_cast<mx_uint>(n);
+  *ndim_out = nd.data();
+  *data_out = pp.data();
+}
+
+int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args, const char **keys,
+                       const mx_uint *arg_ind_ptr,
+                       const mx_uint *arg_shape_data, mx_uint *in_shape_size,
+                       const mx_uint **in_shape_ndim,
+                       const mx_uint ***in_shape_data,
+                       mx_uint *out_shape_size,
+                       const mx_uint **out_shape_ndim,
+                       const mx_uint ***out_shape_data,
+                       mx_uint *aux_shape_size,
+                       const mx_uint **aux_shape_ndim,
+                       const mx_uint ***aux_shape_data, int *complete) {
+  API_BEGIN();
+  PyObject *shapes = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    mx_uint a = arg_ind_ptr[i], b = arg_ind_ptr[i + 1];
+    PyObject *t = PyTuple_New(b - a);
+    for (mx_uint j = a; j < b; ++j)
+      PyTuple_SetItem(t, j - a, PyLong_FromUnsignedLong(arg_shape_data[j]));
+    PyList_SetItem(shapes, i, t);
+  }
+  PyObject *r = Call("symbol_infer_shape",
+                     Py_BuildValue("(KNNi)", (unsigned long long)H(sym),
+                                   StrList(keys, num_args), shapes, 0));
+  CHECK_PY(r);
+  FillShapeTriple(PyTuple_GetItem(r, 0), 0, in_shape_size, in_shape_ndim,
+                  in_shape_data);
+  FillShapeTriple(PyTuple_GetItem(r, 1), 1, out_shape_size, out_shape_ndim,
+                  out_shape_data);
+  FillShapeTriple(PyTuple_GetItem(r, 2), 2, aux_shape_size, aux_shape_ndim,
+                  aux_shape_data);
+  *complete = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 3)));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXSymbolInferType(SymbolHandle sym, mx_uint num_args, const char **keys,
+                      const int *arg_type_data, mx_uint *in_type_size,
+                      const int **in_type_data, mx_uint *out_type_size,
+                      const int **out_type_data, mx_uint *aux_type_size,
+                      const int **aux_type_data, int *complete) {
+  API_BEGIN();
+  PyObject *r = Call("symbol_infer_type",
+                     Py_BuildValue("(KNN)", (unsigned long long)H(sym),
+                                   StrList(keys, num_args),
+                                   IntList(arg_type_data, num_args)));
+  CHECK_PY(r);
+  auto fill = [](PyObject *lst, int slot, mx_uint *size, const int **out) {
+    auto &v = tls.types[slot];
+    v.clear();
+    Py_ssize_t n = PySequence_Size(lst);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *it = PySequence_GetItem(lst, i);
+      v.push_back(static_cast<int>(PyLong_AsLong(it)));
+      Py_DECREF(it);
+    }
+    *size = static_cast<mx_uint>(n);
+    *out = v.data();
+  };
+  fill(PyTuple_GetItem(r, 0), 0, in_type_size, in_type_data);
+  fill(PyTuple_GetItem(r, 1), 1, out_type_size, out_type_data);
+  fill(PyTuple_GetItem(r, 2), 2, aux_type_size, aux_type_data);
+  *complete = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 3)));
+  Py_DECREF(r);
+  API_END();
+}
+
+/* ---- part 4: Executor ---- */
+
+int MXExecutorFree(ExecutorHandle handle) {
+  API_BEGIN();
+  PyObject *r = Call("executor_free",
+                     Py_BuildValue("(K)", (unsigned long long)H(handle)));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXExecutorForward(ExecutorHandle handle, int is_train) {
+  API_BEGIN();
+  PyObject *r = Call("executor_forward",
+                     Py_BuildValue("(Ki)", (unsigned long long)H(handle),
+                                   is_train));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXExecutorBackward(ExecutorHandle handle, mx_uint len,
+                       NDArrayHandle *head_grads) {
+  API_BEGIN();
+  PyObject *r = Call("executor_backward",
+                     Py_BuildValue("(KN)", (unsigned long long)H(handle),
+                                   HandleList(head_grads, len)));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXExecutorOutputs(ExecutorHandle handle, mx_uint *out_size,
+                      NDArrayHandle **out) {
+  API_BEGIN();
+  PyObject *r = Call("executor_outputs",
+                     Py_BuildValue("(K)", (unsigned long long)H(handle)));
+  CHECK_PY(r);
+  ParseHandleList(r, out_size, out);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXExecutorBind(SymbolHandle symbol_handle, int dev_type, int dev_id,
+                   mx_uint len, NDArrayHandle *in_args,
+                   NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                   mx_uint aux_states_len, NDArrayHandle *aux_states,
+                   ExecutorHandle *out) {
+  API_BEGIN();
+  PyObject *reqs = PyList_New(len);
+  for (mx_uint i = 0; i < len; ++i)
+    PyList_SetItem(reqs, i, PyLong_FromUnsignedLong(grad_req_type[i]));
+  PyObject *r = Call(
+      "executor_bind",
+      Py_BuildValue("(KiiNNNN)", (unsigned long long)H(symbol_handle),
+                    dev_type, dev_id, HandleList(in_args, len),
+                    HandleList(arg_grad_store, len), reqs,
+                    HandleList(aux_states, aux_states_len)));
+  CHECK_PY(r);
+  *out = HP(PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXExecutorSimpleBind(
+    SymbolHandle symbol_handle, int dev_type, int dev_id,
+    const mx_uint num_g2c_keys, const char **g2c_keys,
+    const int *g2c_dev_types, const int *g2c_dev_ids,
+    const mx_uint provided_grad_req_list_len,
+    const char **provided_grad_req_names,
+    const char **provided_grad_req_types,
+    const mx_uint num_provided_arg_shapes,
+    const char **provided_arg_shape_names,
+    const mx_uint *provided_arg_shape_data,
+    const mx_uint *provided_arg_shape_idx,
+    const mx_uint num_provided_arg_dtypes,
+    const char **provided_arg_dtype_names, const int *provided_arg_dtypes,
+    const mx_uint num_provided_arg_stypes,
+    const char **provided_arg_stype_names, const int *provided_arg_stypes,
+    const mx_uint num_shared_arg_names, const char **shared_arg_name_list,
+    int *shared_buffer_len, const char **shared_buffer_name_list,
+    NDArrayHandle *shared_buffer_handle_list,
+    const char ***updated_shared_buffer_name_list,
+    NDArrayHandle **updated_shared_buffer_handle_list, mx_uint *num_in_args,
+    NDArrayHandle **in_args, NDArrayHandle **arg_grads,
+    mx_uint *num_aux_states, NDArrayHandle **aux_states,
+    ExecutorHandle shared_exec_handle, ExecutorHandle *out) {
+  API_BEGIN();
+  (void)num_g2c_keys; (void)g2c_keys; (void)g2c_dev_types; (void)g2c_dev_ids;
+  (void)num_provided_arg_stypes; (void)provided_arg_stype_names;
+  (void)provided_arg_stypes; (void)num_shared_arg_names;
+  (void)shared_arg_name_list; (void)shared_buffer_name_list;
+  (void)shared_buffer_handle_list; (void)shared_exec_handle;
+  PyObject *shapes = PyList_New(num_provided_arg_shapes);
+  for (mx_uint i = 0; i < num_provided_arg_shapes; ++i) {
+    mx_uint a = provided_arg_shape_idx[i], b = provided_arg_shape_idx[i + 1];
+    PyObject *t = PyTuple_New(b - a);
+    for (mx_uint j = a; j < b; ++j)
+      PyTuple_SetItem(t, j - a,
+                      PyLong_FromUnsignedLong(provided_arg_shape_data[j]));
+    PyList_SetItem(shapes, i, t);
+  }
+  PyObject *r = Call(
+      "executor_simple_bind",
+      Py_BuildValue("(KiiNNNNNN)", (unsigned long long)H(symbol_handle),
+                    dev_type, dev_id,
+                    StrList(provided_arg_shape_names,
+                            num_provided_arg_shapes),
+                    shapes,
+                    StrList(provided_arg_dtype_names,
+                            num_provided_arg_dtypes),
+                    IntList(provided_arg_dtypes, num_provided_arg_dtypes),
+                    StrList(provided_grad_req_names,
+                            provided_grad_req_list_len),
+                    StrList(provided_grad_req_types,
+                            provided_grad_req_list_len)));
+  CHECK_PY(r);
+  long long exec_id = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  r = Call("executor_arg_arrays", Py_BuildValue("(L)", exec_id));
+  CHECK_PY(r);
+  auto fill = [](PyObject *lst, std::vector<void *> &store) {
+    store.clear();
+    Py_ssize_t n = PySequence_Size(lst);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *it = PySequence_GetItem(lst, i);
+      store.push_back(HP(PyLong_AsLongLong(it)));
+      Py_DECREF(it);
+    }
+  };
+  fill(PyTuple_GetItem(r, 0), tls.handles);
+  fill(PyTuple_GetItem(r, 1), tls.handles2);
+  fill(PyTuple_GetItem(r, 2), tls.handles3);
+  Py_DECREF(r);
+  *num_in_args = static_cast<mx_uint>(tls.handles.size());
+  *in_args = tls.handles.data();
+  *arg_grads = tls.handles2.data();
+  *num_aux_states = static_cast<mx_uint>(tls.handles3.size());
+  *aux_states = tls.handles3.data();
+  if (shared_buffer_len) *shared_buffer_len = -1;
+  if (updated_shared_buffer_name_list) *updated_shared_buffer_name_list = nullptr;
+  if (updated_shared_buffer_handle_list)
+    *updated_shared_buffer_handle_list = nullptr;
+  *out = HP(exec_id);
+  API_END();
+}
+
+/* ---- part 5: Data IO ---- */
+
+int MXListDataIters(mx_uint *out_size, DataIterCreator **out_array) {
+  API_BEGIN();
+  PyObject *r = Call("list_data_iters", PyTuple_New(0));
+  CHECK_PY(r);
+  static std::vector<std::string> *iters = nullptr;
+  static std::mutex m;
+  {
+    std::lock_guard<std::mutex> lock(m);
+    if (iters == nullptr) {
+      auto *v = new std::vector<std::string>();
+      Py_ssize_t n = PySequence_Size(r);
+      for (Py_ssize_t i = 0; i < n; ++i) {
+        PyObject *it = PySequence_GetItem(r, i);
+        v->emplace_back(PyUnicode_AsUTF8(it));
+        Py_DECREF(it);
+      }
+      iters = v;
+    }
+  }
+  Py_DECREF(r);
+  tls.handles.clear();
+  for (auto &s : *iters)
+    tls.handles.push_back(const_cast<std::string *>(&s));
+  *out_size = static_cast<mx_uint>(tls.handles.size());
+  *out_array = tls.handles.data();
+  API_END();
+}
+
+int MXDataIterGetIterInfo(DataIterCreator creator, const char **name,
+                          const char **description, mx_uint *num_args,
+                          const char ***arg_names,
+                          const char ***arg_type_infos,
+                          const char ***arg_descriptions) {
+  API_BEGIN();
+  *name = reinterpret_cast<const std::string *>(creator)->c_str();
+  *description = "";
+  *num_args = 0;
+  *arg_names = nullptr;
+  *arg_type_infos = nullptr;
+  *arg_descriptions = nullptr;
+  API_END();
+}
+
+int MXDataIterCreateIter(DataIterCreator handle, mx_uint num_param,
+                         const char **keys, const char **vals,
+                         DataIterHandle *out) {
+  API_BEGIN();
+  PyObject *r = Call(
+      "data_iter_create",
+      Py_BuildValue("(sNN)",
+                    reinterpret_cast<const std::string *>(handle)->c_str(),
+                    StrList(keys, num_param), StrList(vals, num_param)));
+  CHECK_PY(r);
+  *out = HP(PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXDataIterFree(DataIterHandle handle) {
+  API_BEGIN();
+  PyObject *r = Call("data_iter_free",
+                     Py_BuildValue("(K)", (unsigned long long)H(handle)));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXDataIterNext(DataIterHandle handle, int *out) {
+  API_BEGIN();
+  PyObject *r = Call("data_iter_next",
+                     Py_BuildValue("(K)", (unsigned long long)H(handle)));
+  CHECK_PY(r);
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXDataIterBeforeFirst(DataIterHandle handle) {
+  API_BEGIN();
+  PyObject *r = Call("data_iter_before_first",
+                     Py_BuildValue("(K)", (unsigned long long)H(handle)));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXDataIterGetData(DataIterHandle handle, NDArrayHandle *out) {
+  API_BEGIN();
+  PyObject *r = Call("data_iter_get_data",
+                     Py_BuildValue("(K)", (unsigned long long)H(handle)));
+  CHECK_PY(r);
+  *out = HP(PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle *out) {
+  API_BEGIN();
+  PyObject *r = Call("data_iter_get_label",
+                     Py_BuildValue("(K)", (unsigned long long)H(handle)));
+  CHECK_PY(r);
+  *out = HP(PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXDataIterGetPadNum(DataIterHandle handle, int *pad) {
+  API_BEGIN();
+  PyObject *r = Call("data_iter_get_pad",
+                     Py_BuildValue("(K)", (unsigned long long)H(handle)));
+  CHECK_PY(r);
+  *pad = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+/* ---- part 6: KVStore ---- */
+
+int MXKVStoreCreate(const char *type, KVStoreHandle *out) {
+  API_BEGIN();
+  PyObject *r = Call("kvstore_create", Py_BuildValue("(s)", type));
+  CHECK_PY(r);
+  *out = HP(PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXKVStoreFree(KVStoreHandle handle) {
+  API_BEGIN();
+  PyObject *r = Call("kvstore_free",
+                     Py_BuildValue("(K)", (unsigned long long)H(handle)));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+static PyObject *KeyList(const int *keys, mx_uint num) {
+  PyObject *l = PyList_New(num);
+  for (mx_uint i = 0; i < num; ++i)
+    PyList_SetItem(l, i, PyLong_FromLong(keys[i]));
+  return l;
+}
+
+int MXKVStoreInit(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals) {
+  API_BEGIN();
+  PyObject *r = Call("kvstore_init",
+                     Py_BuildValue("(KNN)", (unsigned long long)H(handle),
+                                   KeyList(keys, num),
+                                   HandleList(vals, num)));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXKVStorePush(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority) {
+  API_BEGIN();
+  PyObject *r = Call("kvstore_push",
+                     Py_BuildValue("(KNNi)", (unsigned long long)H(handle),
+                                   KeyList(keys, num), HandleList(vals, num),
+                                   priority));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXKVStorePull(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority) {
+  API_BEGIN();
+  PyObject *r = Call("kvstore_pull",
+                     Py_BuildValue("(KNNi)", (unsigned long long)H(handle),
+                                   KeyList(keys, num), HandleList(vals, num),
+                                   priority));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+namespace {
+// C-callback trampoline for MXKVStoreSetUpdater: wrap the C fn pointer in a
+// python callable via a capsule-captured closure
+struct UpdaterCtx {
+  MXKVStoreUpdater *fn;
+  void *handle;
+};
+
+PyObject *UpdaterTrampoline(PyObject *self, PyObject *args) {
+  auto *ctx = static_cast<UpdaterCtx *>(PyCapsule_GetPointer(self, nullptr));
+  long long key, recv, local;
+  if (!PyArg_ParseTuple(args, "LLL", &key, &recv, &local)) return nullptr;
+  // release the GIL while user C code runs (it may call back into the API)
+  Py_BEGIN_ALLOW_THREADS
+  ctx->fn(static_cast<int>(key), HP(recv), HP(local), ctx->handle);
+  Py_END_ALLOW_THREADS
+  Py_RETURN_NONE;
+}
+
+PyMethodDef g_updater_def = {"_kv_updater", UpdaterTrampoline, METH_VARARGS,
+                             nullptr};
+
+void FreeUpdaterCtx(PyObject *capsule) {
+  delete static_cast<UpdaterCtx *>(PyCapsule_GetPointer(capsule, nullptr));
+}
+}  // namespace
+
+int MXKVStoreSetUpdater(KVStoreHandle handle, MXKVStoreUpdater updater,
+                        void *updater_handle) {
+  API_BEGIN();
+  auto *ctx = new UpdaterCtx{updater, updater_handle};
+  PyObject *capsule = PyCapsule_New(ctx, nullptr, FreeUpdaterCtx);
+  PyObject *cb = PyCFunction_New(&g_updater_def, capsule);
+  Py_DECREF(capsule);
+  PyObject *r = Call("kvstore_set_updater",
+                     Py_BuildValue("(KN)", (unsigned long long)H(handle),
+                                   cb));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXKVStoreGetType(KVStoreHandle handle, const char **type) {
+  API_BEGIN();
+  PyObject *r = Call("kvstore_get_type",
+                     Py_BuildValue("(K)", (unsigned long long)H(handle)));
+  CHECK_PY(r);
+  tls.text = PyUnicode_AsUTF8(r);
+  Py_DECREF(r);
+  *type = tls.text.c_str();
+  API_END();
+}
+
+int MXKVStoreGetRank(KVStoreHandle handle, int *ret) {
+  API_BEGIN();
+  PyObject *r = Call("kvstore_get_rank",
+                     Py_BuildValue("(K)", (unsigned long long)H(handle)));
+  CHECK_PY(r);
+  *ret = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXKVStoreGetGroupSize(KVStoreHandle handle, int *ret) {
+  API_BEGIN();
+  PyObject *r = Call("kvstore_get_group_size",
+                     Py_BuildValue("(K)", (unsigned long long)H(handle)));
+  CHECK_PY(r);
+  *ret = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXKVStoreBarrier(KVStoreHandle handle) {
+  API_BEGIN();
+  PyObject *r = Call("kvstore_barrier",
+                     Py_BuildValue("(K)", (unsigned long long)H(handle)));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXKVStoreIsWorkerNode(int *ret) {
+  *ret = 1;
+  return 0;
+}
+
+/* ---- RecordIO ---- */
+
+int MXRecordIOWriterCreate(const char *uri, RecordIOHandle *out) {
+  API_BEGIN();
+  PyObject *r = Call("recordio_writer_create", Py_BuildValue("(s)", uri));
+  CHECK_PY(r);
+  *out = HP(PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXRecordIOWriterFree(RecordIOHandle handle) {
+  API_BEGIN();
+  PyObject *r = Call("recordio_close",
+                     Py_BuildValue("(K)", (unsigned long long)H(handle)));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXRecordIOWriterWriteRecord(RecordIOHandle handle, const char *buf,
+                                size_t size) {
+  API_BEGIN();
+  PyObject *b = PyBytes_FromStringAndSize(buf, size);
+  PyObject *r = Call("recordio_writer_write",
+                     Py_BuildValue("(KN)", (unsigned long long)H(handle), b));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXRecordIOReaderCreate(const char *uri, RecordIOHandle *out) {
+  API_BEGIN();
+  PyObject *r = Call("recordio_reader_create", Py_BuildValue("(s)", uri));
+  CHECK_PY(r);
+  *out = HP(PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXRecordIOReaderFree(RecordIOHandle handle) {
+  return MXRecordIOWriterFree(handle);
+}
+
+int MXRecordIOReaderReadRecord(RecordIOHandle handle, char const **buf,
+                               size_t *size) {
+  API_BEGIN();
+  PyObject *r = Call("recordio_reader_read",
+                     Py_BuildValue("(K)", (unsigned long long)H(handle)));
+  CHECK_PY(r);
+  if (r == Py_None) {
+    *buf = nullptr;
+    *size = 0;
+  } else {
+    char *data;
+    Py_ssize_t n;
+    PyBytes_AsStringAndSize(r, &data, &n);
+    tls.bytes.assign(data, data + n);
+    *buf = tls.bytes.data();
+    *size = static_cast<size_t>(n);
+  }
+  Py_DECREF(r);
+  API_END();
+}

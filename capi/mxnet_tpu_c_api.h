@@ -1,0 +1,236 @@
+/*!
+ * mxnet_tpu C ABI — mirrors the reference include/mxnet/c_api.h
+ * (parts 0-6; 2067-line original, 165 MXNET_DLL functions) for the
+ * TPU-native stack.  Implemented by capi/c_api.cc, which embeds CPython
+ * and dispatches to mxnet_tpu/capi.py (the src/c_api/c_api.cc analog).
+ *
+ * Conventions (identical to the reference):
+ *  - every function returns 0 on success, -1 on failure;
+ *    MXGetLastError() returns the message of the last failure.
+ *  - handles are opaque pointers owned by the library; free with the
+ *    matching MX*Free call.
+ *  - returned const char* / array pointers are owned by the library and
+ *    valid until the next API call on the same thread.
+ */
+#ifndef MXNET_TPU_C_API_H_
+#define MXNET_TPU_C_API_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#include <stdint.h>
+#include <stddef.h>
+
+#define MXNET_DLL __attribute__((visibility("default")))
+
+typedef uint32_t mx_uint;
+typedef float mx_float;
+typedef void *NDArrayHandle;
+typedef const void *FunctionHandle;
+typedef void *AtomicSymbolCreator;
+typedef void *SymbolHandle;
+typedef void *ExecutorHandle;
+typedef void *DataIterCreator;
+typedef void *DataIterHandle;
+typedef void *KVStoreHandle;
+typedef void *RecordIOHandle;
+
+/* ---- part 0: global state ---- */
+MXNET_DLL const char *MXGetLastError();
+MXNET_DLL int MXGetVersion(int *out);
+MXNET_DLL int MXRandomSeed(int seed);
+MXNET_DLL int MXNotifyShutdown();
+MXNET_DLL int MXSetProfilerConfig(int mode, const char *filename);
+MXNET_DLL int MXSetProfilerState(int state);
+MXNET_DLL int MXDumpProfile();
+
+/* ---- part 1: NDArray ---- */
+MXNET_DLL int MXNDArrayCreateNone(NDArrayHandle *out);
+MXNET_DLL int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim,
+                              int dev_type, int dev_id, int delay_alloc,
+                              NDArrayHandle *out);
+MXNET_DLL int MXNDArrayCreateEx(const mx_uint *shape, mx_uint ndim,
+                                int dev_type, int dev_id, int delay_alloc,
+                                int dtype, NDArrayHandle *out);
+MXNET_DLL int MXNDArraySyncCopyFromCPU(NDArrayHandle handle,
+                                       const void *data, size_t size);
+MXNET_DLL int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data,
+                                     size_t size);
+MXNET_DLL int MXNDArrayWaitToRead(NDArrayHandle handle);
+MXNET_DLL int MXNDArrayWaitToWrite(NDArrayHandle handle);
+MXNET_DLL int MXNDArrayWaitAll();
+MXNET_DLL int MXNDArrayFree(NDArrayHandle handle);
+MXNET_DLL int MXNDArraySlice(NDArrayHandle handle, mx_uint slice_begin,
+                             mx_uint slice_end, NDArrayHandle *out);
+MXNET_DLL int MXNDArrayAt(NDArrayHandle handle, mx_uint idx,
+                          NDArrayHandle *out);
+MXNET_DLL int MXNDArrayReshape(NDArrayHandle handle, int ndim, int *dims,
+                               NDArrayHandle *out);
+MXNET_DLL int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_dim,
+                                const mx_uint **out_pdata);
+MXNET_DLL int MXNDArrayGetDType(NDArrayHandle handle, int *out);
+MXNET_DLL int MXNDArrayGetStorageType(NDArrayHandle handle, int *out);
+MXNET_DLL int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
+                                  int *out_dev_id);
+MXNET_DLL int MXNDArraySave(const char *fname, mx_uint num_args,
+                            NDArrayHandle *args, const char **keys);
+MXNET_DLL int MXNDArrayLoad(const char *fname, mx_uint *out_size,
+                            NDArrayHandle **out_arr, mx_uint *out_name_size,
+                            const char ***out_names);
+
+/* ---- part 2: op invoke ---- */
+MXNET_DLL int MXListAllOpNames(mx_uint *out_size, const char ***out_array);
+MXNET_DLL int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
+                                               AtomicSymbolCreator **out_array);
+MXNET_DLL int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                          const char **name);
+MXNET_DLL int MXSymbolGetAtomicSymbolInfo(
+    AtomicSymbolCreator creator, const char **name, const char **description,
+    mx_uint *num_args, const char ***arg_names, const char ***arg_type_infos,
+    const char ***arg_descriptions, const char **key_var_num_args);
+MXNET_DLL int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
+                                 NDArrayHandle *inputs, int *num_outputs,
+                                 NDArrayHandle **outputs, int num_params,
+                                 const char **param_keys,
+                                 const char **param_vals);
+
+/* ---- part 3: Symbol ---- */
+MXNET_DLL int MXSymbolCreateAtomicSymbol(AtomicSymbolCreator creator,
+                                         mx_uint num_param, const char **keys,
+                                         const char **vals, SymbolHandle *out);
+MXNET_DLL int MXSymbolCreateVariable(const char *name, SymbolHandle *out);
+MXNET_DLL int MXSymbolCreateGroup(mx_uint num_symbols, SymbolHandle *symbols,
+                                  SymbolHandle *out);
+MXNET_DLL int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out);
+MXNET_DLL int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out);
+MXNET_DLL int MXSymbolSaveToJSON(SymbolHandle symbol, const char **out_json);
+MXNET_DLL int MXSymbolSaveToFile(SymbolHandle symbol, const char *fname);
+MXNET_DLL int MXSymbolFree(SymbolHandle symbol);
+MXNET_DLL int MXSymbolCopy(SymbolHandle symbol, SymbolHandle *out);
+MXNET_DLL int MXSymbolPrint(SymbolHandle symbol, const char **out_str);
+MXNET_DLL int MXSymbolGetName(SymbolHandle symbol, const char **out,
+                              int *success);
+MXNET_DLL int MXSymbolGetAttr(SymbolHandle symbol, const char *key,
+                              const char **out, int *success);
+MXNET_DLL int MXSymbolSetAttr(SymbolHandle symbol, const char *key,
+                              const char *value);
+MXNET_DLL int MXSymbolCompose(SymbolHandle sym, const char *name,
+                              mx_uint num_args, const char **keys,
+                              SymbolHandle *args);
+MXNET_DLL int MXSymbolListArguments(SymbolHandle symbol, mx_uint *out_size,
+                                    const char ***out_str_array);
+MXNET_DLL int MXSymbolListOutputs(SymbolHandle symbol, mx_uint *out_size,
+                                  const char ***out_str_array);
+MXNET_DLL int MXSymbolListAuxiliaryStates(SymbolHandle symbol,
+                                          mx_uint *out_size,
+                                          const char ***out_str_array);
+MXNET_DLL int MXSymbolGetNumOutputs(SymbolHandle symbol, mx_uint *output_count);
+MXNET_DLL int MXSymbolGetOutput(SymbolHandle symbol, mx_uint index,
+                                SymbolHandle *out);
+MXNET_DLL int MXSymbolGetInternals(SymbolHandle symbol, SymbolHandle *out);
+MXNET_DLL int MXSymbolInferShape(
+    SymbolHandle sym, mx_uint num_args, const char **keys,
+    const mx_uint *arg_ind_ptr, const mx_uint *arg_shape_data,
+    mx_uint *in_shape_size, const mx_uint **in_shape_ndim,
+    const mx_uint ***in_shape_data, mx_uint *out_shape_size,
+    const mx_uint **out_shape_ndim, const mx_uint ***out_shape_data,
+    mx_uint *aux_shape_size, const mx_uint **aux_shape_ndim,
+    const mx_uint ***aux_shape_data, int *complete);
+MXNET_DLL int MXSymbolInferType(SymbolHandle sym, mx_uint num_args,
+                                const char **keys, const int *arg_type_data,
+                                mx_uint *in_type_size, const int **in_type_data,
+                                mx_uint *out_type_size,
+                                const int **out_type_data,
+                                mx_uint *aux_type_size,
+                                const int **aux_type_data, int *complete);
+
+/* ---- part 4: Executor ---- */
+MXNET_DLL int MXExecutorFree(ExecutorHandle handle);
+MXNET_DLL int MXExecutorForward(ExecutorHandle handle, int is_train);
+MXNET_DLL int MXExecutorBackward(ExecutorHandle handle, mx_uint len,
+                                 NDArrayHandle *head_grads);
+MXNET_DLL int MXExecutorOutputs(ExecutorHandle handle, mx_uint *out_size,
+                                NDArrayHandle **out);
+MXNET_DLL int MXExecutorBind(SymbolHandle symbol_handle, int dev_type,
+                             int dev_id, mx_uint len, NDArrayHandle *in_args,
+                             NDArrayHandle *arg_grad_store,
+                             mx_uint *grad_req_type, mx_uint aux_states_len,
+                             NDArrayHandle *aux_states, ExecutorHandle *out);
+MXNET_DLL int MXExecutorSimpleBind(
+    SymbolHandle symbol_handle, int dev_type, int dev_id,
+    const mx_uint num_g2c_keys, const char **g2c_keys,
+    const int *g2c_dev_types, const int *g2c_dev_ids,
+    const mx_uint provided_grad_req_list_len,
+    const char **provided_grad_req_names,
+    const char **provided_grad_req_types,
+    const mx_uint num_provided_arg_shapes,
+    const char **provided_arg_shape_names,
+    const mx_uint *provided_arg_shape_data,
+    const mx_uint *provided_arg_shape_idx,
+    const mx_uint num_provided_arg_dtypes,
+    const char **provided_arg_dtype_names, const int *provided_arg_dtypes,
+    const mx_uint num_provided_arg_stypes,
+    const char **provided_arg_stype_names, const int *provided_arg_stypes,
+    const mx_uint num_shared_arg_names, const char **shared_arg_name_list,
+    int *shared_buffer_len, const char **shared_buffer_name_list,
+    NDArrayHandle *shared_buffer_handle_list,
+    const char ***updated_shared_buffer_name_list,
+    NDArrayHandle **updated_shared_buffer_handle_list, mx_uint *num_in_args,
+    NDArrayHandle **in_args, NDArrayHandle **arg_grads,
+    mx_uint *num_aux_states, NDArrayHandle **aux_states,
+    ExecutorHandle shared_exec_handle, ExecutorHandle *out);
+
+/* ---- part 5: Data IO ---- */
+MXNET_DLL int MXListDataIters(mx_uint *out_size, DataIterCreator **out_array);
+MXNET_DLL int MXDataIterGetIterInfo(DataIterCreator creator, const char **name,
+                                    const char **description, mx_uint *num_args,
+                                    const char ***arg_names,
+                                    const char ***arg_type_infos,
+                                    const char ***arg_descriptions);
+MXNET_DLL int MXDataIterCreateIter(DataIterCreator handle, mx_uint num_param,
+                                   const char **keys, const char **vals,
+                                   DataIterHandle *out);
+MXNET_DLL int MXDataIterFree(DataIterHandle handle);
+MXNET_DLL int MXDataIterNext(DataIterHandle handle, int *out);
+MXNET_DLL int MXDataIterBeforeFirst(DataIterHandle handle);
+MXNET_DLL int MXDataIterGetData(DataIterHandle handle, NDArrayHandle *out);
+MXNET_DLL int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle *out);
+MXNET_DLL int MXDataIterGetPadNum(DataIterHandle handle, int *pad);
+
+/* ---- part 6: KVStore ---- */
+MXNET_DLL int MXKVStoreCreate(const char *type, KVStoreHandle *out);
+MXNET_DLL int MXKVStoreFree(KVStoreHandle handle);
+MXNET_DLL int MXKVStoreInit(KVStoreHandle handle, mx_uint num,
+                            const int *keys, NDArrayHandle *vals);
+MXNET_DLL int MXKVStorePush(KVStoreHandle handle, mx_uint num,
+                            const int *keys, NDArrayHandle *vals,
+                            int priority);
+MXNET_DLL int MXKVStorePull(KVStoreHandle handle, mx_uint num,
+                            const int *keys, NDArrayHandle *vals,
+                            int priority);
+typedef void(MXKVStoreUpdater)(int key, NDArrayHandle recv,
+                               NDArrayHandle local, void *handle);
+MXNET_DLL int MXKVStoreSetUpdater(KVStoreHandle handle,
+                                  MXKVStoreUpdater updater,
+                                  void *updater_handle);
+MXNET_DLL int MXKVStoreGetType(KVStoreHandle handle, const char **type);
+MXNET_DLL int MXKVStoreGetRank(KVStoreHandle handle, int *ret);
+MXNET_DLL int MXKVStoreGetGroupSize(KVStoreHandle handle, int *ret);
+MXNET_DLL int MXKVStoreBarrier(KVStoreHandle handle);
+MXNET_DLL int MXKVStoreIsWorkerNode(int *ret);
+
+/* ---- RecordIO ---- */
+MXNET_DLL int MXRecordIOWriterCreate(const char *uri, RecordIOHandle *out);
+MXNET_DLL int MXRecordIOWriterFree(RecordIOHandle handle);
+MXNET_DLL int MXRecordIOWriterWriteRecord(RecordIOHandle handle,
+                                          const char *buf, size_t size);
+MXNET_DLL int MXRecordIOReaderCreate(const char *uri, RecordIOHandle *out);
+MXNET_DLL int MXRecordIOReaderFree(RecordIOHandle handle);
+MXNET_DLL int MXRecordIOReaderReadRecord(RecordIOHandle handle,
+                                         char const **buf, size_t *size);
+
+#ifdef __cplusplus
+}
+#endif
+#endif  /* MXNET_TPU_C_API_H_ */

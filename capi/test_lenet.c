@@ -1,0 +1,230 @@
+/*
+ * C ABI end-to-end test: build LeNet through the symbol API, bind an
+ * executor, run forward + backward, apply one SGD step via
+ * MXImperativeInvoke, and verify the loss drops over a few steps.
+ *
+ * Mirrors what cpp-package/example/lenet.cpp does against the reference's
+ * C ABI (via the C++ wrappers); here raw C, same call sequence:
+ *   CreateAtomicSymbol -> Compose -> ExecutorBind -> Forward/Backward ->
+ *   sgd_update -> Forward ...
+ */
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "mxnet_tpu_c_api.h"
+
+#define CHECK(x)                                                        \
+  do {                                                                  \
+    if ((x) != 0) {                                                     \
+      fprintf(stderr, "FAILED %s:%d: %s\n", __FILE__, __LINE__,         \
+              MXGetLastError());                                        \
+      exit(1);                                                          \
+    }                                                                   \
+  } while (0)
+
+static AtomicSymbolCreator find_op(const char *name) {
+  mx_uint n;
+  AtomicSymbolCreator *creators;
+  CHECK(MXSymbolListAtomicSymbolCreators(&n, &creators));
+  for (mx_uint i = 0; i < n; ++i) {
+    const char *cname;
+    CHECK(MXSymbolGetAtomicSymbolName(creators[i], &cname));
+    if (strcmp(cname, name) == 0) return creators[i];
+  }
+  fprintf(stderr, "op %s not found\n", name);
+  exit(1);
+}
+
+/* compose op(inputs...) with kwargs */
+static SymbolHandle apply_op(const char *op, const char *name, mx_uint nkw,
+                             const char **kw_keys, const char **kw_vals,
+                             mx_uint nin, SymbolHandle *inputs) {
+  SymbolHandle s;
+  CHECK(MXSymbolCreateAtomicSymbol(find_op(op), nkw, kw_keys, kw_vals, &s));
+  CHECK(MXSymbolCompose(s, name, nin, NULL, inputs));
+  return s;
+}
+
+static SymbolHandle variable(const char *name) {
+  SymbolHandle s;
+  CHECK(MXSymbolCreateVariable(name, &s));
+  return s;
+}
+
+int main(void) {
+  int version;
+  CHECK(MXGetVersion(&version));
+  printf("mxnet_tpu C ABI version %d\n", version);
+  CHECK(MXRandomSeed(42));
+
+  /* ---- LeNet symbol ---- */
+  SymbolHandle data = variable("data");
+  SymbolHandle label = variable("softmax_label");
+
+  const char *conv1_k[] = {"kernel", "num_filter"};
+  const char *conv1_v[] = {"(5,5)", "8"};
+  SymbolHandle conv1 = apply_op("Convolution", "conv1", 2, conv1_k, conv1_v,
+                                1, &data);
+  const char *act_k[] = {"act_type"};
+  const char *act_v[] = {"tanh"};
+  SymbolHandle act1 = apply_op("Activation", "act1", 1, act_k, act_v, 1,
+                               &conv1);
+  const char *pool_k[] = {"pool_type", "kernel", "stride"};
+  const char *pool_v[] = {"max", "(2,2)", "(2,2)"};
+  SymbolHandle pool1 = apply_op("Pooling", "pool1", 3, pool_k, pool_v, 1,
+                                &act1);
+  SymbolHandle flat = apply_op("Flatten", "flatten", 0, NULL, NULL, 1,
+                               &pool1);
+  const char *fc1_k[] = {"num_hidden"};
+  const char *fc1_v[] = {"32"};
+  SymbolHandle fc1 = apply_op("FullyConnected", "fc1", 1, fc1_k, fc1_v, 1,
+                              &flat);
+  SymbolHandle act2 = apply_op("Activation", "act2", 1, act_k, act_v, 1,
+                               &fc1);
+  const char *fc2_k[] = {"num_hidden"};
+  const char *fc2_v[] = {"10"};
+  SymbolHandle fc2 = apply_op("FullyConnected", "fc2", 1, fc2_k, fc2_v, 1,
+                              &act2);
+  SymbolHandle sm_in[2];
+  sm_in[0] = fc2;
+  sm_in[1] = label;
+  SymbolHandle net = apply_op("SoftmaxOutput", "softmax", 0, NULL, NULL, 2,
+                              sm_in);
+
+  /* round-trip through JSON (MXSymbolSaveToJSON / CreateFromJSON) */
+  const char *json;
+  CHECK(MXSymbolSaveToJSON(net, &json));
+  SymbolHandle net2;
+  CHECK(MXSymbolCreateFromJSON(json, &net2));
+  net = net2;
+
+  mx_uint nargs;
+  const char **arg_names;
+  CHECK(MXSymbolListArguments(net, &nargs, &arg_names));
+  printf("arguments: %u\n", nargs);
+
+  /* ---- infer shapes for batch 16 of 1x16x16 images ---- */
+  const mx_uint batch = 16;
+  const char *skeys[2] = {"data", "softmax_label"};
+  mx_uint ind_ptr[3] = {0, 4, 5};
+  mx_uint shape_data[5] = {batch, 1, 16, 16, batch};
+  mx_uint in_size, out_size, aux_size;
+  const mx_uint *in_ndim, *out_ndim, *aux_ndim;
+  const mx_uint **in_shapes, **out_shapes, **aux_shapes;
+  int complete;
+  CHECK(MXSymbolInferShape(net, 2, skeys, ind_ptr, shape_data, &in_size,
+                           &in_ndim, &in_shapes, &out_size, &out_ndim,
+                           &out_shapes, &aux_size, &aux_ndim, &aux_shapes,
+                           &complete));
+  if (!complete || in_size != nargs) {
+    fprintf(stderr, "infer_shape incomplete\n");
+    return 1;
+  }
+
+  /* ---- allocate args + grads, random init ---- */
+  NDArrayHandle args[32], grads[32];
+  mx_uint reqs[32];
+  unsigned seed = 7;
+  /* copy shapes out: the TLS arrays are invalidated by the next API call */
+  mx_uint arg_ndims[32];
+  mx_uint arg_dims[32][8];
+  for (mx_uint i = 0; i < in_size; ++i) {
+    arg_ndims[i] = in_ndim[i];
+    for (mx_uint d = 0; d < in_ndim[i]; ++d) arg_dims[i][d] = in_shapes[i][d];
+  }
+  for (mx_uint i = 0; i < in_size; ++i) {
+    CHECK(MXNDArrayCreate(arg_dims[i], arg_ndims[i], 1, 0, 0, &args[i]));
+    CHECK(MXNDArrayCreate(arg_dims[i], arg_ndims[i], 1, 0, 0, &grads[i]));
+    size_t total = 1;
+    for (mx_uint d = 0; d < arg_ndims[i]; ++d) total *= arg_dims[i][d];
+    float *buf = (float *)malloc(total * sizeof(float));
+    int is_input = (strcmp(arg_names[i], "data") == 0 ||
+                    strcmp(arg_names[i], "softmax_label") == 0);
+    for (size_t j = 0; j < total; ++j) {
+      seed = seed * 1103515245u + 12345u;
+      float r = ((seed >> 16) & 0x7fff) / 32768.0f;
+      buf[j] = is_input ? 0.0f : (r - 0.5f) * 0.2f;
+    }
+    CHECK(MXNDArraySyncCopyFromCPU(args[i], buf, total));
+    free(buf);
+    reqs[i] = is_input ? 0 : 1; /* null for inputs, write for params */
+  }
+
+  /* fixed input batch + labels */
+  {
+    float *x = (float *)malloc(batch * 256 * sizeof(float));
+    float y[16];
+    for (int j = 0; j < (int)(batch * 256); ++j) {
+      seed = seed * 1103515245u + 12345u;
+      x[j] = ((seed >> 16) & 0x7fff) / 32768.0f;
+    }
+    for (int j = 0; j < 16; ++j) y[j] = (float)(j % 10);
+    for (mx_uint i = 0; i < in_size; ++i) {
+      if (strcmp(arg_names[i], "data") == 0)
+        CHECK(MXNDArraySyncCopyFromCPU(args[i], x, batch * 256));
+      if (strcmp(arg_names[i], "softmax_label") == 0)
+        CHECK(MXNDArraySyncCopyFromCPU(args[i], y, batch));
+    }
+    free(x);
+  }
+
+  /* ---- bind ---- */
+  ExecutorHandle exec;
+  CHECK(MXExecutorBind(net, 1, 0, in_size, args, grads, reqs, 0, NULL,
+                       &exec));
+
+  AtomicSymbolCreator sgd = find_op("sgd_update");
+  const char *sgd_keys[] = {"lr"};
+  const char *sgd_vals[] = {"0.05"};
+
+  float first_loss = 0, last_loss = 0;
+  for (int step = 0; step < 10; ++step) {
+    CHECK(MXExecutorForward(exec, 1));
+    CHECK(MXExecutorBackward(exec, 0, NULL));
+
+    /* cross-entropy from the softmax outputs */
+    mx_uint n_out;
+    NDArrayHandle *outs;
+    CHECK(MXExecutorOutputs(exec, &n_out, &outs));
+    float probs[16 * 10];
+    CHECK(MXNDArraySyncCopyToCPU(outs[0], probs, 16 * 10));
+    float loss = 0;
+    for (int j = 0; j < 16; ++j) {
+      float p = probs[j * 10 + (j % 10)];
+      loss += -logf(p > 1e-8f ? p : 1e-8f);
+    }
+    loss /= 16.0f;
+    if (step == 0) first_loss = loss;
+    last_loss = loss;
+
+    /* SGD: weight -= lr * grad through the imperative ABI */
+    for (mx_uint i = 0; i < in_size; ++i) {
+      if (reqs[i] != 1) continue;
+      NDArrayHandle io[2];
+      io[0] = args[i];
+      io[1] = grads[i];
+      int n_sgd_out = 1;
+      NDArrayHandle out_arr[1];
+      NDArrayHandle *outp = out_arr;
+      out_arr[0] = args[i];
+      CHECK(MXImperativeInvoke(sgd, 2, io, &n_sgd_out, &outp, 1, sgd_keys,
+                               sgd_vals));
+    }
+  }
+  printf("loss: %.4f -> %.4f\n", first_loss, last_loss);
+  if (!(last_loss < first_loss) || !isfinite(last_loss)) {
+    fprintf(stderr, "FAILED: loss did not decrease\n");
+    return 1;
+  }
+
+  CHECK(MXExecutorFree(exec));
+  for (mx_uint i = 0; i < in_size; ++i) {
+    CHECK(MXNDArrayFree(args[i]));
+    CHECK(MXNDArrayFree(grads[i]));
+  }
+  CHECK(MXNotifyShutdown());
+  printf("C ABI LeNet training: OK\n");
+  return 0;
+}

@@ -1,0 +1,597 @@
+"""Python side of the C ABI.
+
+The C shim (capi/c_api.cc) embeds CPython and dispatches every
+``MXNET_DLL``-style call here; this module owns the handle registry and
+translates between plain C-friendly types (ints, strings, buffers) and the
+framework's objects.  Mirrors the surface of the reference's
+include/mxnet/c_api.h parts 0-6 as implemented by src/c_api/c_api*.cc.
+
+Handles are small ints (never 0); the registry maps them to live Python
+objects, and free() drops the reference.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .base import MXNetError
+from .ndarray.serialization import _DTYPE_OF_FLAG, _FLAG_OF_DTYPE
+
+VERSION = 10100  # mirrors reference MXNET_VERSION (base.h:112-118)
+
+_handles: Dict[int, Any] = {}
+_next_id = 1
+
+_GRAD_REQ = {0: "null", 1: "write", 2: "write", 3: "add"}  # OpReqType codes
+_STYPE_NAME = {0: "default", 1: "row_sparse", 2: "csr"}
+
+
+def _put(obj) -> int:
+    global _next_id
+    h = _next_id
+    _next_id += 1
+    _handles[h] = obj
+    return h
+
+
+def _get(h: int):
+    try:
+        return _handles[h]
+    except KeyError:
+        raise MXNetError("invalid handle %d" % h)
+
+
+def free_handle(h: int):
+    _handles.pop(int(h), None)
+
+
+def _flag_to_dtype(flag: int):
+    if flag not in _DTYPE_OF_FLAG:
+        raise MXNetError("unknown dtype flag %d" % flag)
+    return _DTYPE_OF_FLAG[flag]
+
+
+def _dtype_to_flag(dtype) -> int:
+    return _FLAG_OF_DTYPE.get(np.dtype(dtype), 0)
+
+
+# -- part 0: global state ---------------------------------------------------
+
+def get_version() -> int:
+    return VERSION
+
+
+def random_seed(seed: int):
+    from . import random as _random
+    _random.seed(int(seed))
+
+
+def notify_shutdown():
+    _handles.clear()
+
+
+def profiler_set_config(mode: int, filename: str):
+    from . import profiler
+    profiler.profiler_set_config(
+        mode="all" if mode else "symbolic", filename=filename)
+
+
+def profiler_set_state(state: int):
+    from . import profiler
+    profiler.profiler_set_state("run" if state else "stop")
+
+
+def dump_profile():
+    from . import profiler
+    profiler.dump_profile()
+
+
+# -- part 1: NDArray --------------------------------------------------------
+
+def ndarray_create_none() -> int:
+    from .ndarray.ndarray import NDArray
+    return _put(NDArray(None))
+
+
+def ndarray_create(shape, dev_type: int, dev_id: int, delay_alloc: int,
+                   dtype_flag: int) -> int:
+    from .context import Context
+    from .ndarray.ndarray import zeros
+    ctx = Context(dev_type, dev_id) if dev_type in Context.devid2type else None
+    arr = zeros(tuple(int(d) for d in shape),
+                dtype=_flag_to_dtype(dtype_flag), ctx=ctx)
+    return _put(arr)
+
+
+def ndarray_free(h: int):
+    free_handle(h)
+
+
+def ndarray_copy_from_ptr(h: int, addr: int, size: int):
+    """size is the ELEMENT count (reference NDArray::SyncCopyFromCPU,
+    ndarray.cc:1137-1140: CHECK_EQ(shape.Size(), size))."""
+    import ctypes
+    arr = _get(h)
+    n = int(np.prod(arr.shape)) if arr.shape else 1
+    if n != int(size):
+        raise MXNetError("Memory size do not match")
+    nbytes = n * np.dtype(arr.dtype).itemsize
+    buf = (ctypes.c_char * nbytes).from_address(int(addr))
+    host = np.frombuffer(buf, dtype=arr.dtype).reshape(arr.shape)
+    arr[:] = host.copy()
+
+
+def ndarray_copy_to_ptr(h: int, addr: int, size: int):
+    import ctypes
+    arr = _get(h)
+    n = int(np.prod(arr.shape)) if arr.shape else 1
+    if n != int(size):
+        raise MXNetError("Memory size do not match")
+    data = np.ascontiguousarray(arr.asnumpy())
+    ctypes.memmove(int(addr), data.ctypes.data, data.nbytes)
+
+
+def ndarray_shape(h: int):
+    return tuple(int(d) for d in _get(h).shape)
+
+
+def ndarray_dtype(h: int) -> int:
+    return _dtype_to_flag(_get(h).dtype)
+
+
+def ndarray_stype(h: int) -> int:
+    st = getattr(_get(h), "stype", "default")
+    return {"default": 0, "row_sparse": 1, "csr": 2}[st]
+
+
+def ndarray_context(h: int):
+    ctx = _get(h).context
+    return (ctx.device_typeid, ctx.device_id)
+
+
+def ndarray_slice(h: int, start: int, stop: int) -> int:
+    return _put(_get(h)[int(start):int(stop)])
+
+
+def ndarray_at(h: int, idx: int) -> int:
+    return _put(_get(h)[int(idx)])
+
+
+def ndarray_reshape(h: int, dims) -> int:
+    return _put(_get(h).reshape(tuple(int(d) for d in dims)))
+
+
+def ndarray_save(fname: str, handles, names):
+    from .ndarray.ndarray import save as nd_save
+    arrays = [_get(h) for h in handles]
+    if names:
+        nd_save(fname, dict(zip(list(names), arrays)))
+    else:
+        nd_save(fname, arrays)
+
+
+def ndarray_load(fname: str):
+    from .ndarray.ndarray import load as nd_load
+    data = nd_load(fname)
+    if isinstance(data, dict):
+        names = list(data.keys())
+        return [_put(data[n]) for n in names], names
+    return [_put(a) for a in data], []
+
+
+def ndarray_wait_to_read(h: int):
+    arr = _get(h)
+    if arr._handle is not None:
+        try:
+            arr._handle.block_until_ready()
+        except Exception:
+            pass
+
+
+def ndarray_wait_all():
+    from .ndarray.ndarray import waitall
+    waitall()
+
+
+# -- part 2: op invoke ------------------------------------------------------
+
+def list_all_op_names() -> List[str]:
+    from .ops.registry import list_ops
+    return list_ops()
+
+
+def op_info(name: str):
+    from .ops.registry import get_op
+    op = get_op(name)
+    keys, types, descs = [], [], []
+    for pname, p in (op.params or {}).items():
+        keys.append(pname)
+        t = getattr(p, "type", None)
+        types.append(getattr(t, "__name__", str(t)))
+        descs.append("")
+    doc = (op.fn.__doc__ or "") if getattr(op, "fn", None) else ""
+    return (op.name, doc, keys, types, descs)
+
+
+def imperative_invoke(op_name: str, in_handles, out_handles, keys, vals):
+    """Returns the list of output handles (new ones when out_handles is
+    empty) — reference MXImperativeInvoke (c_api_ndarray.cc)."""
+    from .ndarray.ndarray import invoke_with_arrays
+    inputs = [_get(h) for h in in_handles]
+    kwargs = dict(zip(list(keys), [_parse_scalar(v) for v in vals]))
+    outs = [_get(h) for h in out_handles] if out_handles else None
+    result = invoke_with_arrays(op_name, inputs, kwargs,
+                                out=outs[0] if outs and len(outs) == 1
+                                else outs)
+    if not isinstance(result, (list, tuple)):
+        result = [result]
+    if out_handles:
+        return list(out_handles)
+    return [_put(r) for r in result]
+
+
+def _parse_scalar(v: str):
+    """Attribute strings from C: keep them as strings — the op schemas
+    parse them (dmlc::Parameter semantics)."""
+    return v
+
+
+# -- part 3: Symbol ---------------------------------------------------------
+
+class _PendingAtomic:
+    """An uncomposed op node (reference MXSymbolCreateAtomicSymbol makes a
+    one-node symbol whose inputs are filled in by MXSymbolCompose)."""
+
+    def __init__(self, op_name, attrs):
+        self.op_name = op_name
+        self.attrs = attrs
+
+
+def symbol_create_atomic(op_name: str, keys, vals) -> int:
+    attrs = dict(zip(list(keys), list(vals)))
+    return _put(_PendingAtomic(op_name, attrs))
+
+
+def symbol_create_variable(name: str) -> int:
+    from .symbol.symbol import Variable
+    return _put(Variable(name))
+
+
+def symbol_compose(h: int, name: Optional[str], keys, arg_handles):
+    """In-place compose (reference MXSymbolCompose)."""
+    from .symbol.symbol import Symbol, create
+    obj = _get(h)
+    args = [_get(a) for a in arg_handles]
+    if isinstance(obj, _PendingAtomic):
+        kwargs = dict(obj.attrs)
+        if keys:
+            for k, a in zip(list(keys), args):
+                kwargs[k] = a
+            sym = create(obj.op_name, [], kwargs, name=name)
+        else:
+            sym = create(obj.op_name, args, kwargs, name=name)
+        _handles[h] = sym
+    else:
+        raise MXNetError("symbol is already composed")
+
+
+def symbol_create_group(handles) -> int:
+    from .symbol.symbol import Group
+    return _put(Group([_get(h) for h in handles]))
+
+
+def symbol_from_json(json_str: str) -> int:
+    from .symbol.symbol import load_json
+    return _put(load_json(json_str))
+
+
+def symbol_from_file(fname: str) -> int:
+    from .symbol.symbol import load
+    return _put(load(fname))
+
+
+def symbol_tojson(h: int) -> str:
+    return _get(h).tojson()
+
+
+def symbol_save_file(h: int, fname: str):
+    _get(h).save(fname)
+
+
+def symbol_copy(h: int) -> int:
+    import copy
+    return _put(copy.deepcopy(_get(h)))
+
+
+def symbol_print(h: int) -> str:
+    return _get(h).debug_str()
+
+
+def symbol_get_name(h: int):
+    return _get(h).name
+
+
+def symbol_get_attr(h: int, key: str):
+    return _get(h).attr(key)
+
+
+def symbol_set_attr(h: int, key: str, value: str):
+    _get(h)._set_attr(**{key: value})
+
+
+def symbol_list_arguments(h: int):
+    return _get(h).list_arguments()
+
+
+def symbol_list_outputs(h: int):
+    return _get(h).list_outputs()
+
+
+def symbol_list_aux(h: int):
+    return _get(h).list_auxiliary_states()
+
+
+def symbol_num_outputs(h: int) -> int:
+    return len(_get(h))
+
+
+def symbol_get_output(h: int, index: int) -> int:
+    return _put(_get(h)[int(index)])
+
+
+def symbol_get_internals(h: int) -> int:
+    return _put(_get(h).get_internals())
+
+
+def symbol_infer_shape(h: int, names, shapes, partial: int):
+    sym = _get(h)
+    kwargs = {n: tuple(s) for n, s in zip(list(names), shapes)}
+    if partial:
+        arg, out, aux = sym.infer_shape_partial(**kwargs)
+    else:
+        arg, out, aux = sym.infer_shape(**kwargs)
+    complete = arg is not None and all(s is not None for s in arg)
+    none_to_empty = lambda lst: [tuple(s) if s else () for s in (lst or [])]
+    return (none_to_empty(arg), none_to_empty(out), none_to_empty(aux),
+            1 if complete else 0)
+
+
+def symbol_infer_type(h: int, names, flags):
+    sym = _get(h)
+    kwargs = {n: _flag_to_dtype(f) for n, f in zip(list(names), flags)}
+    arg, out, aux = sym.infer_type(**kwargs)
+    to_flags = lambda lst: [_dtype_to_flag(t) for t in (lst or [])]
+    return (to_flags(arg), to_flags(out), to_flags(aux),
+            1 if arg is not None else 0)
+
+
+# -- part 4: Executor -------------------------------------------------------
+
+def _context_of(dev_type: int, dev_id: int):
+    from .context import Context, cpu
+    if dev_type in Context.devid2type:
+        return Context(dev_type, dev_id)
+    return cpu(dev_id)
+
+
+def executor_bind(sym_h: int, dev_type: int, dev_id: int, arg_handles,
+                  grad_handles, req_codes, aux_handles) -> int:
+    from .executor import Executor
+    sym = _get(sym_h)
+    args = [_get(h) for h in arg_handles]
+    grads = [(None if h == 0 else _get(h)) for h in grad_handles]
+    reqs = [_GRAD_REQ.get(int(c), "null") for c in req_codes]
+    aux = [_get(h) for h in aux_handles]
+    exe = Executor(sym, _context_of(dev_type, dev_id), args,
+                   args_grad=grads, grad_req=reqs, aux_states=aux)
+    return _put(exe)
+
+
+def executor_simple_bind(sym_h: int, dev_type: int, dev_id: int,
+                         shape_names, shapes, dtype_names, dtype_flags,
+                         req_names, req_types) -> int:
+    from .executor import Executor
+    sym = _get(sym_h)
+    kwargs = {n: tuple(s) for n, s in zip(list(shape_names), shapes)}
+    type_dict = {n: _flag_to_dtype(f)
+                 for n, f in zip(list(dtype_names), dtype_flags)} or None
+    grad_req = dict(zip(list(req_names), list(req_types))) if req_names \
+        else "write"
+    exe = Executor.simple_bind(sym, _context_of(dev_type, dev_id),
+                               grad_req=grad_req, type_dict=type_dict,
+                               **kwargs)
+    return _put(exe)
+
+
+def executor_arg_arrays(h: int):
+    """Handles of the bound arg/grad/aux arrays (for simple_bind)."""
+    exe = _get(h)
+    args = [_put(a) for a in exe.arg_arrays]
+    grads = [(0 if g is None else _put(g)) for g in exe.grad_arrays]
+    aux = [_put(a) for a in exe.aux_arrays]
+    return args, grads, aux
+
+
+def executor_forward(h: int, is_train: int):
+    _get(h).forward(is_train=bool(is_train))
+
+
+def executor_backward(h: int, grad_handles):
+    exe = _get(h)
+    if grad_handles:
+        exe.backward([_get(g) for g in grad_handles])
+    else:
+        exe.backward()
+
+
+def executor_outputs(h: int):
+    return [_put(o) for o in _get(h).outputs]
+
+
+def executor_free(h: int):
+    free_handle(h)
+
+
+# -- part 5: Data IO --------------------------------------------------------
+
+_ITER_REGISTRY = None
+
+
+def _iter_registry():
+    global _ITER_REGISTRY
+    if _ITER_REGISTRY is None:
+        from .io import io as _io
+        reg = {}
+        for name in ("MNISTIter", "CSVIter", "LibSVMIter", "NDArrayIter"):
+            cls = getattr(_io, name, None)
+            if cls is not None:
+                reg[name] = cls
+        from .image.record_iter import ImageRecordIter
+        reg["ImageRecordIter"] = ImageRecordIter
+        _ITER_REGISTRY = reg
+    return _ITER_REGISTRY
+
+
+def list_data_iters():
+    return sorted(_iter_registry().keys())
+
+
+def data_iter_create(name: str, keys, vals) -> int:
+    cls = _iter_registry().get(name)
+    if cls is None:
+        raise MXNetError("unknown data iter %s" % name)
+    kwargs = {}
+    for k, v in zip(list(keys), list(vals)):
+        try:
+            kwargs[k] = ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            kwargs[k] = v
+    return _put(cls(**kwargs))
+
+
+def data_iter_next(h: int) -> int:
+    it = _get(h)
+    try:
+        batch = it.next()
+    except StopIteration:
+        return 0
+    it._capi_batch = batch
+    return 1
+
+
+def data_iter_before_first(h: int):
+    _get(h).reset()
+
+
+def data_iter_get_data(h: int) -> int:
+    return _put(_get(h)._capi_batch.data[0])
+
+
+def data_iter_get_label(h: int) -> int:
+    return _put(_get(h)._capi_batch.label[0])
+
+
+def data_iter_get_pad(h: int) -> int:
+    return int(getattr(_get(h)._capi_batch, "pad", 0) or 0)
+
+
+def data_iter_free(h: int):
+    free_handle(h)
+
+
+# -- part 6: KVStore --------------------------------------------------------
+
+def kvstore_create(kv_type: str) -> int:
+    from .kvstore import create
+    return _put(create(kv_type))
+
+
+def kvstore_init(h: int, keys, value_handles):
+    kv = _get(h)
+    kv.init(list(keys), [_get(v) for v in value_handles])
+
+
+def kvstore_push(h: int, keys, value_handles, priority: int):
+    kv = _get(h)
+    ks = list(keys)
+    vals = [_get(v) for v in value_handles]
+    if len(vals) > len(ks):  # multiple devices per key
+        per = len(vals) // len(ks)
+        vals = [vals[i * per:(i + 1) * per] for i in range(len(ks))]
+    kv.push(ks, vals, priority=priority)
+
+
+def kvstore_pull(h: int, keys, out_handles, priority: int):
+    kv = _get(h)
+    ks = list(keys)
+    outs = [_get(v) for v in out_handles]
+    if len(outs) > len(ks):
+        per = len(outs) // len(ks)
+        outs = [outs[i * per:(i + 1) * per] for i in range(len(ks))]
+    kv.pull(ks, out=outs, priority=priority)
+
+
+def kvstore_set_updater(h: int, cb):
+    """cb: python callable (key:int, recv_id:int, local_id:int) from the C
+    trampoline.  The handles are valid for the duration of the callback
+    only (the reference passes borrowed NDArray* the same way)."""
+    kv = _get(h)
+
+    def updater(key, recv, local):
+        rh, lh = _put(recv), _put(local)
+        try:
+            cb(int(key), rh, lh)
+        finally:
+            free_handle(rh)
+            free_handle(lh)
+
+    kv.set_updater(updater)
+
+
+def kvstore_get_type(h: int) -> str:
+    return _get(h).type
+
+
+def kvstore_get_rank(h: int) -> int:
+    return _get(h).rank
+
+
+def kvstore_get_group_size(h: int) -> int:
+    return _get(h).num_workers
+
+
+def kvstore_barrier(h: int):
+    _get(h).barrier()
+
+
+def kvstore_free(h: int):
+    free_handle(h)
+
+
+# -- RecordIO ---------------------------------------------------------------
+
+def recordio_writer_create(uri: str) -> int:
+    from .recordio import MXRecordIO
+    rec = MXRecordIO(uri, "w")
+    return _put(rec)
+
+
+def recordio_writer_write(h: int, buf):
+    _get(h).write(bytes(buf))
+
+
+def recordio_reader_create(uri: str) -> int:
+    from .recordio import MXRecordIO
+    return _put(MXRecordIO(uri, "r"))
+
+
+def recordio_reader_read(h: int):
+    return _get(h).read()  # bytes or None
+
+
+def recordio_close(h: int):
+    obj = _handles.pop(int(h), None)
+    if obj is not None:
+        obj.close()

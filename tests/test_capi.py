@@ -1,0 +1,273 @@
+"""C ABI tests (reference: src/c_api/ + cpp-package usage patterns).
+
+Two tiers:
+ - an embedded-interpreter tier: compile and run capi/test_lenet.c, a real
+   C program that builds LeNet through the symbol ABI, binds an executor,
+   and trains until the loss drops (the cpp-package lenet example's call
+   sequence).
+ - an in-process tier: load libmxnet_tpu.so with ctypes (the hosted-
+   interpreter path) and exercise NDArray/op/symbol/kvstore/recordio calls.
+"""
+import ctypes
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CAPI = os.path.join(REPO, "capi")
+LIB = os.path.join(CAPI, "build", "libmxnet_tpu.so")
+
+
+@pytest.fixture(scope="module")
+def capi_lib():
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain")
+    r = subprocess.run(["make", "-C", CAPI], capture_output=True, text=True)
+    if r.returncode != 0:
+        pytest.skip("capi build failed: " + r.stderr[-500:])
+    lib = ctypes.CDLL(LIB)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    return lib
+
+
+def _check(lib, rc):
+    assert rc == 0, lib.MXGetLastError().decode()
+
+
+def test_c_lenet_trains(capi_lib):
+    """The compiled C program trains LeNet one+ steps through the ABI."""
+    env = dict(os.environ, MXNET_TPU_HOME=REPO, JAX_PLATFORMS="cpu")
+    r = subprocess.run([os.path.join(CAPI, "build", "test_lenet")],
+                      capture_output=True, text=True, env=env, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "C ABI LeNet training: OK" in r.stdout
+
+
+def test_ndarray_roundtrip(capi_lib):
+    lib = capi_lib
+    ver = ctypes.c_int()
+    _check(lib, lib.MXGetVersion(ctypes.byref(ver)))
+    assert ver.value == 10100
+
+    shape = (ctypes.c_uint * 2)(3, 4)
+    h = ctypes.c_void_p()
+    _check(lib, lib.MXNDArrayCreate(shape, 2, 1, 0, 0, ctypes.byref(h)))
+    src = np.arange(12, dtype=np.float32)
+    _check(lib, lib.MXNDArraySyncCopyFromCPU(
+        h, src.ctypes.data_as(ctypes.c_void_p), src.size))
+
+    ndim = ctypes.c_uint()
+    pdata = ctypes.POINTER(ctypes.c_uint)()
+    _check(lib, lib.MXNDArrayGetShape(h, ctypes.byref(ndim),
+                                      ctypes.byref(pdata)))
+    assert [pdata[i] for i in range(ndim.value)] == [3, 4]
+
+    back = np.zeros(12, np.float32)
+    _check(lib, lib.MXNDArraySyncCopyToCPU(
+        h, back.ctypes.data_as(ctypes.c_void_p), back.size))
+    np.testing.assert_array_equal(back, src)
+    _check(lib, lib.MXNDArrayFree(h))
+
+
+def test_imperative_invoke_and_ops(capi_lib):
+    lib = capi_lib
+    n = ctypes.c_uint()
+    names = ctypes.POINTER(ctypes.c_char_p)()
+    _check(lib, lib.MXListAllOpNames(ctypes.byref(n), ctypes.byref(names)))
+    all_ops = {names[i].decode() for i in range(n.value)}
+    assert {"Convolution", "FullyConnected", "dot", "sgd_update"} <= all_ops
+
+    creators = ctypes.POINTER(ctypes.c_void_p)()
+    _check(lib, lib.MXSymbolListAtomicSymbolCreators(ctypes.byref(n),
+                                                     ctypes.byref(creators)))
+    dot = None
+    for i in range(n.value):
+        cname = ctypes.c_char_p()
+        _check(lib, lib.MXSymbolGetAtomicSymbolName(
+            ctypes.c_void_p(creators[i]), ctypes.byref(cname)))
+        if cname.value == b"dot":
+            dot = ctypes.c_void_p(creators[i])
+            break
+    assert dot is not None
+
+    def make_nd(arr):
+        shp = (ctypes.c_uint * arr.ndim)(*arr.shape)
+        h = ctypes.c_void_p()
+        _check(lib, lib.MXNDArrayCreate(shp, arr.ndim, 1, 0, 0,
+                                        ctypes.byref(h)))
+        _check(lib, lib.MXNDArraySyncCopyFromCPU(
+            h, arr.ctypes.data_as(ctypes.c_void_p), arr.size))
+        return h
+
+    a = np.random.rand(2, 3).astype(np.float32)
+    b = np.random.rand(3, 4).astype(np.float32)
+    ins = (ctypes.c_void_p * 2)(make_nd(a), make_nd(b))
+    n_out = ctypes.c_int(0)
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    _check(lib, lib.MXImperativeInvoke(dot, 2, ins, ctypes.byref(n_out),
+                                       ctypes.byref(outs), 0, None, None))
+    assert n_out.value == 1
+    res = np.zeros((2, 4), np.float32)
+    _check(lib, lib.MXNDArraySyncCopyToCPU(
+        ctypes.c_void_p(outs[0]), res.ctypes.data_as(ctypes.c_void_p),
+        res.size))
+    np.testing.assert_allclose(res, a @ b, rtol=1e-5)
+
+
+def test_symbol_json_and_save(capi_lib, tmp_path):
+    lib = capi_lib
+    h = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolCreateVariable(b"x", ctypes.byref(h)))
+    json_str = ctypes.c_char_p()
+    _check(lib, lib.MXSymbolSaveToJSON(h, ctypes.byref(json_str)))
+    assert b"x" in json_str.value
+
+    # nd save/load through the ABI, read back in python
+    shape = (ctypes.c_uint * 1)(4,)
+    nd = ctypes.c_void_p()
+    _check(lib, lib.MXNDArrayCreate(shape, 1, 1, 0, 0, ctypes.byref(nd)))
+    v = np.array([1, 2, 3, 4], np.float32)
+    _check(lib, lib.MXNDArraySyncCopyFromCPU(
+        nd, v.ctypes.data_as(ctypes.c_void_p), v.size))
+    fname = str(tmp_path / "c.params").encode()
+    keys = (ctypes.c_char_p * 1)(b"w")
+    arr = (ctypes.c_void_p * 1)(nd)
+    _check(lib, lib.MXNDArraySave(fname, 1, arr, keys))
+
+    import mxnet_tpu as mx
+    loaded = mx.nd.load(fname.decode())
+    np.testing.assert_array_equal(loaded["w"].asnumpy(), v)
+
+
+def test_kvstore_over_abi(capi_lib):
+    lib = capi_lib
+    kv = ctypes.c_void_p()
+    _check(lib, lib.MXKVStoreCreate(b"local", ctypes.byref(kv)))
+    rank, size = ctypes.c_int(), ctypes.c_int()
+    _check(lib, lib.MXKVStoreGetRank(kv, ctypes.byref(rank)))
+    _check(lib, lib.MXKVStoreGetGroupSize(kv, ctypes.byref(size)))
+    assert (rank.value, size.value) == (0, 1)
+
+    shape = (ctypes.c_uint * 2)(2, 2)
+
+    def make(val):
+        h = ctypes.c_void_p()
+        _check(lib, lib.MXNDArrayCreate(shape, 2, 1, 0, 0, ctypes.byref(h)))
+        a = np.full((2, 2), val, np.float32)
+        _check(lib, lib.MXNDArraySyncCopyFromCPU(
+            h, a.ctypes.data_as(ctypes.c_void_p), a.size))
+        return h
+
+    keys = (ctypes.c_int * 1)(3)
+    vals = (ctypes.c_void_p * 1)(make(1.0))
+    _check(lib, lib.MXKVStoreInit(kv, 1, keys, vals))
+    push_vals = (ctypes.c_void_p * 1)(make(8.0))
+    _check(lib, lib.MXKVStorePush(kv, 1, keys, push_vals, 0))
+    out = (ctypes.c_void_p * 1)(make(0.0))
+    _check(lib, lib.MXKVStorePull(kv, 1, keys, out, 0))
+    res = np.zeros((2, 2), np.float32)
+    _check(lib, lib.MXNDArraySyncCopyToCPU(
+        ctypes.c_void_p(out[0]), res.ctypes.data_as(ctypes.c_void_p),
+        res.size))
+    np.testing.assert_array_equal(res, np.full((2, 2), 8.0))
+    _check(lib, lib.MXKVStoreBarrier(kv))
+    _check(lib, lib.MXKVStoreFree(kv))
+
+
+def test_recordio_over_abi(capi_lib, tmp_path):
+    lib = capi_lib
+    uri = str(tmp_path / "t.rec").encode()
+    w = ctypes.c_void_p()
+    _check(lib, lib.MXRecordIOWriterCreate(uri, ctypes.byref(w)))
+    payload = b"hello mxnet_tpu recordio"
+    _check(lib, lib.MXRecordIOWriterWriteRecord(w, payload, len(payload)))
+    _check(lib, lib.MXRecordIOWriterFree(w))
+
+    r = ctypes.c_void_p()
+    _check(lib, lib.MXRecordIOReaderCreate(uri, ctypes.byref(r)))
+    buf = ctypes.c_char_p()
+    size = ctypes.c_size_t()
+    _check(lib, lib.MXRecordIOReaderReadRecord(r, ctypes.byref(buf),
+                                               ctypes.byref(size)))
+    assert ctypes.string_at(buf, size.value) == payload
+    _check(lib, lib.MXRecordIOReaderReadRecord(r, ctypes.byref(buf),
+                                               ctypes.byref(size)))
+    assert size.value == 0  # EOF
+    _check(lib, lib.MXRecordIOReaderFree(r))
+
+
+def test_error_reporting(capi_lib):
+    lib = capi_lib
+    bad = ctypes.c_void_p(999999)
+    ndim = ctypes.c_uint()
+    pdata = ctypes.POINTER(ctypes.c_uint)()
+    rc = lib.MXNDArrayGetShape(bad, ctypes.byref(ndim), ctypes.byref(pdata))
+    assert rc == -1
+    assert b"invalid handle" in lib.MXGetLastError()
+
+
+def test_simple_bind_over_abi(capi_lib):
+    """MXExecutorSimpleBind: shapes in, allocated args/grads/aux out."""
+    lib = capi_lib
+    # mlp: fc(10->4) -> SoftmaxOutput
+    data = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolCreateVariable(b"data", ctypes.byref(data)))
+    fc = ctypes.c_void_p()
+    kk = (ctypes.c_char_p * 1)(b"num_hidden")
+    vv = (ctypes.c_char_p * 1)(b"4")
+    creators = ctypes.POINTER(ctypes.c_void_p)()
+    n = ctypes.c_uint()
+    _check(lib, lib.MXSymbolListAtomicSymbolCreators(ctypes.byref(n),
+                                                     ctypes.byref(creators)))
+    fc_creator = None
+    for i in range(n.value):
+        cname = ctypes.c_char_p()
+        _check(lib, lib.MXSymbolGetAtomicSymbolName(
+            ctypes.c_void_p(creators[i]), ctypes.byref(cname)))
+        if cname.value == b"FullyConnected":
+            fc_creator = ctypes.c_void_p(creators[i])
+            break
+    _check(lib, lib.MXSymbolCreateAtomicSymbol(fc_creator, 1, kk, vv,
+                                               ctypes.byref(fc)))
+    args_in = (ctypes.c_void_p * 1)(data)
+    _check(lib, lib.MXSymbolCompose(fc, b"fc", 1, None, args_in))
+
+    shape_names = (ctypes.c_char_p * 1)(b"data")
+    shape_data = (ctypes.c_uint * 2)(8, 10)
+    shape_idx = (ctypes.c_uint * 2)(0, 2)
+    num_in = ctypes.c_uint()
+    in_args = ctypes.POINTER(ctypes.c_void_p)()
+    arg_grads = ctypes.POINTER(ctypes.c_void_p)()
+    num_aux = ctypes.c_uint()
+    aux = ctypes.POINTER(ctypes.c_void_p)()
+    exe = ctypes.c_void_p()
+    shared_len = ctypes.c_int(-1)
+    _check(lib, lib.MXExecutorSimpleBind(
+        fc, 1, 0,
+        0, None, None, None,            # g2c
+        0, None, None,                  # grad_req overrides
+        1, shape_names, shape_data, shape_idx,
+        0, None, None,                  # dtypes
+        0, None, None,                  # stypes
+        0, None,                        # shared arg names
+        ctypes.byref(shared_len), None, None, None, None,
+        ctypes.byref(num_in), ctypes.byref(in_args), ctypes.byref(arg_grads),
+        ctypes.byref(num_aux), ctypes.byref(aux),
+        None, ctypes.byref(exe)))
+    assert num_in.value == 3  # data, fc_weight, fc_bias
+    # weight shape got inferred: (4, 10)
+    ndim = ctypes.c_uint()
+    pdata = ctypes.POINTER(ctypes.c_uint)()
+    _check(lib, lib.MXNDArrayGetShape(ctypes.c_void_p(in_args[1]),
+                                      ctypes.byref(ndim), ctypes.byref(pdata)))
+    assert [pdata[i] for i in range(ndim.value)] == [4, 10]
+    _check(lib, lib.MXExecutorForward(exe, 0))
+    n_out = ctypes.c_uint()
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    _check(lib, lib.MXExecutorOutputs(exe, ctypes.byref(n_out),
+                                      ctypes.byref(outs)))
+    assert n_out.value == 1
+    _check(lib, lib.MXExecutorFree(exe))
