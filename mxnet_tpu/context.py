@@ -19,7 +19,9 @@ __all__ = ["Context", "cpu", "gpu", "tpu", "cpu_pinned", "current_context",
 
 
 def _accelerators():
-    devs = jax.devices()
+    # local_devices: in a multi-process run only this rank's devices are
+    # addressable (jax.devices() lists the whole job's)
+    devs = jax.local_devices()
     acc = [d for d in devs if d.platform != "cpu"]
     return acc if acc else devs
 
@@ -50,11 +52,12 @@ class Context:
         """Resolve to a concrete jax device."""
         if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
             try:
-                cpus = jax.devices("cpu")
+                cpus = [d for d in jax.local_devices()
+                        if d.platform == "cpu"] or jax.devices("cpu")
                 return cpus[self.device_id % len(cpus)]
             except RuntimeError:
                 # cpu platform absent under some runtimes: fall back to default
-                return jax.devices()[0]
+                return jax.local_devices()[0]
         acc = _accelerators()
         return acc[self.device_id % len(acc)]
 
@@ -116,7 +119,9 @@ def gpu(device_id: int = 0) -> Context:
 
 
 def num_gpus() -> int:
-    return len([d for d in jax.devices() if d.platform != "cpu"])
+    # local count: in a multi-process job only this rank's chips are
+    # addressable, and contexts enumerate local devices (_accelerators)
+    return len([d for d in jax.local_devices() if d.platform != "cpu"])
 
 
 def num_tpus() -> int:

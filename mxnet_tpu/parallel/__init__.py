@@ -35,9 +35,40 @@ def init_distributed(coordinator_address=None, num_processes=None,
                      process_id=None):
     """Multi-host bootstrap — the tracker/Postoffice analog (reference
     tools/launch.py + ps::Postoffice).  On TPU pods the env provides the
-    coordination, so arguments are optional."""
-    if jax.process_count() > 1:
-        return  # already initialised by the runtime
+    coordination, so arguments are optional.
+
+    Under tools/launch.py (local multi-process testing, the dmlc-tracker
+    local-mode analog) the DMLC_*/MXNET_TPU_* env protocol supplies the
+    coordinator and rank, and a cpu backend with gloo collectives is
+    configured so DCN logic runs without a pod."""
+    import os
+    try:  # NOTE: jax.process_count() would itself initialise the backend
+        from jax._src import xla_bridge as _xb
+        if _xb.backends_are_initialized():
+            return  # too late to (re)initialise; runtime already decided
+    except Exception:
+        pass
+    coordinator_address = coordinator_address or \
+        os.environ.get("MXNET_TPU_COORDINATOR")
+    if num_processes is None and "DMLC_NUM_WORKER" in os.environ:
+        num_processes = int(os.environ["DMLC_NUM_WORKER"])
+    if process_id is None and "DMLC_WORKER_ID" in os.environ:
+        process_id = int(os.environ["DMLC_WORKER_ID"])
+    if coordinator_address and (num_processes or 0) > 1:
+        if os.environ.get("MXNET_TPU_DIST_DEVICE", "cpu") == "cpu":
+            jax.config.update("jax_platforms", "cpu")
+            try:
+                jax.config.update("jax_cpu_collectives_implementation",
+                                  "gloo")
+            except Exception:
+                pass
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes, process_id=process_id)
+        except RuntimeError:
+            pass  # repeat call: the service is already up
+        return
     try:
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,

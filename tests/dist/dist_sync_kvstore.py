@@ -1,0 +1,83 @@
+"""Multi-process dist_sync kvstore invariants — the reference
+tests/nightly/dist_sync_kvstore.py:29-90 rewritten for the TPU stack.
+
+Run under the local launcher (the dmlc-tracker local-mode analog):
+
+    python tools/launch.py -n 4 python tests/dist/dist_sync_kvstore.py
+
+Every rank pushes rank-dependent values; sync semantics require each pull
+to observe the SAME globally-reduced value on every rank.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+# parallel.init_distributed() (called first thing in main, before any
+# device is touched) configures the cpu+gloo backend from the launcher's
+# env protocol — no manual jax config here.
+import jax  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import parallel  # noqa: E402
+
+RATE = 2
+SHAPE = (2, 3)
+
+
+def check_equal_scalar(arr, x, rank):
+    a = arr.asnumpy()
+    assert np.sum(np.abs(a - x)) == 0, (rank, a, x)
+
+
+def main():
+    parallel.init_distributed()
+    kv = mx.kv.create("dist_sync")
+    nworker = int(os.environ["DMLC_NUM_WORKER"])
+    rank = kv.rank
+    assert kv.num_workers == nworker, (kv.num_workers, nworker)
+    assert jax.process_count() == nworker
+
+    keys = ["3", "5", "7"]
+    kv.init(keys, [mx.nd.ones(SHAPE)] * len(keys))
+
+    # server-side optimizer analog: every rank applies the same update to
+    # the same globally-reduced gradient (reference 'test' optimizer with
+    # rescale_grad=RATE: weight += grad * rate)
+    def updater(key, recv, stored):
+        stored[:] = stored + recv * RATE
+
+    kv.set_updater(updater)
+
+    # sync push/pull: pull after each push must see the global sum
+    # (reference check_default_keys: num = (n+1)*n*rate/2*(i+1) + 1)
+    for i in range(3):
+        kv.push("3", mx.nd.ones(SHAPE) * (rank + 1))
+        kv.barrier()
+        val = mx.nd.zeros(SHAPE)
+        kv.pull("3", out=val)
+        num = (nworker + 1) * nworker * RATE / 2 * (i + 1) + 1
+        check_equal_scalar(val, num, rank)
+
+    # rank-dependent single-key push: only one worker pushes nonzero
+    v = mx.nd.ones(SHAPE) if rank == 0 else mx.nd.zeros(SHAPE)
+    kv.push("5", v)
+    kv.barrier()
+    val = mx.nd.zeros(SHAPE)
+    kv.pull("5", out=val)
+    check_equal_scalar(val, 1 + RATE, rank)  # init 1 + 1*rate
+
+    # raw DCN allreduce + barrier primitives
+    import jax.numpy as jnp
+    total = parallel.allreduce_array(jnp.full((4,), float(rank + 1)))
+    assert float(total[0]) == nworker * (nworker + 1) / 2, total
+    kv.barrier()
+
+    print("dist_sync_kvstore rank %d/%d OK" % (rank, nworker), flush=True)
+
+
+if __name__ == "__main__":
+    main()

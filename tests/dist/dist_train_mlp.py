@@ -1,0 +1,74 @@
+"""Multi-process synchronous data-parallel training through Module.fit
+with kvstore('dist_sync') — the reference tests/nightly/dist_lenet.py
+analog, asserting the invariants that matter for sync SGD:
+
+ 1. training converges (loss drops, accuracy rises) on rank-sharded data;
+ 2. after every epoch all ranks hold IDENTICAL parameters (the defining
+    property of synchronous data parallelism).
+
+Run:  python tools/launch.py -n 4 python tests/dist/dist_train_mlp.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+# parallel.init_distributed() (called first thing in main, before any
+# device is touched) configures the cpu+gloo backend from the launcher's
+# env protocol — no manual jax config here.
+import jax  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import parallel  # noqa: E402
+
+
+def main():
+    parallel.init_distributed()
+    kv = mx.kv.create("dist_sync")
+    rank, nworker = kv.rank, kv.num_workers
+
+    # synthetic separable problem; every rank gets a distinct shard
+    rs = np.random.RandomState(0)
+    X = rs.randn(512, 16).astype(np.float32)
+    w_true = rs.randn(16).astype(np.float32)
+    y = (X @ w_true > 0).astype(np.float32)
+    shard = slice(rank * 128, (rank + 1) * 128)
+    it = mx.io.NDArrayIter(X[shard], y[shard], batch_size=32, shuffle=False)
+
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=2, name="fc2")
+    net = mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+    mod = mx.mod.Module(net, context=mx.cpu())
+    metric = mx.metric.Accuracy()
+    mod.fit(it, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            initializer=mx.init.Xavier(),
+            eval_metric=metric, num_epoch=10, kvstore=kv)
+
+    # every rank must hold identical parameters
+    args, _ = mod.get_params()
+    for name, arr in sorted(args.items()):
+        mine = arr.asnumpy().astype(np.float64)
+        global_sum = np.asarray(
+            parallel.allreduce_array(jax.numpy.asarray(mine)))
+        np.testing.assert_allclose(global_sum, mine * nworker, rtol=1e-5,
+                                   err_msg="param %s diverged on rank %d"
+                                           % (name, rank))
+
+    it.reset()
+    metric.reset()
+    mod.score(it, metric)
+    acc = dict(metric.get_name_value())["accuracy"]
+    assert acc > 0.9, "rank %d accuracy %.3f" % (rank, acc)
+    print("dist_train_mlp rank %d/%d OK acc=%.3f" % (rank, nworker, acc),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

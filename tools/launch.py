@@ -1,0 +1,92 @@
+#!/usr/bin/env python3
+"""Local multi-process launcher — the reference tools/launch.py analog.
+
+Reference (tools/launch.py:29-50) delegates to the dmlc tracker, whose
+*local* mode forks N worker + N server processes with DMLC_* role env vars
+so parameter-server code can be tested on one machine
+(tests/nightly/test_all.sh:55).
+
+TPU-native collapse: there are no server processes — the "server" is the
+collective itself (every rank enters the same psum over the mesh; see
+SURVEY.md §5.8).  So the launcher forks N *worker* ranks, points them at a
+jax coordination service (the Postoffice/tracker analog), and the workers
+initialise jax.distributed.  Env protocol (read by
+mxnet_tpu.parallel.init_distributed):
+
+  DMLC_ROLE=worker            kept for reference-script compatibility
+  DMLC_NUM_WORKER=<n>
+  DMLC_WORKER_ID=<rank>
+  MXNET_TPU_COORDINATOR=<host:port>
+  MXNET_TPU_DIST_DEVICE=cpu|tpu   (cpu => gloo collectives, for testing
+                                   multi-host logic without a pod)
+
+Usage:  python tools/launch.py -n 4 [--dist-device cpu] python script.py
+"""
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("--dist-device", default="cpu",
+                    help="device backend for workers (cpu uses gloo "
+                         "collectives; tpu expects a pod runtime)")
+    ap.add_argument("--env", action="append", default=[],
+                    help="extra KEY=VALUE env for workers")
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+    if not args.command:
+        ap.error("no command given")
+
+    coordinator = "127.0.0.1:%d" % free_port()
+    procs = []
+    for rank in range(args.num_workers):
+        env = dict(os.environ)
+        env.update(dict(e.split("=", 1) for e in args.env))
+        env.update({
+            "DMLC_ROLE": "worker",
+            "DMLC_NUM_WORKER": str(args.num_workers),
+            "DMLC_WORKER_ID": str(rank),
+            "MXNET_TPU_COORDINATOR": coordinator,
+            "MXNET_TPU_DIST_DEVICE": args.dist_device,
+        })
+        procs.append(subprocess.Popen(args.command, env=env))
+
+    # poll all ranks: the first failure kills the rest (a crashed rank
+    # leaves peers blocked inside a collective forever otherwise)
+    import time
+    rc = 0
+    alive = list(procs)
+    try:
+        while alive:
+            for p in list(alive):
+                r = p.poll()
+                if r is None:
+                    continue
+                alive.remove(p)
+                if r != 0 and rc == 0:
+                    rc = r
+                    for q in alive:
+                        q.kill()
+            time.sleep(0.2)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()
