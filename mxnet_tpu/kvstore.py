@@ -127,26 +127,46 @@ class KVStore:
                     o._handle = src._handle
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        """Pull only the requested rows (reference PullRowSparseImpl
-        kvstore_dist.h:267)."""
+        """Pull ONLY the requested rows (reference PullRowSparseImpl
+        kvstore_dist.h:267).  With a row_sparse store the pull moves
+        O(|row_ids|) data; the dense form is never materialised."""
         assert out is not None and row_ids is not None
         keys, outs = self._normalize_push(key, out)
         rids = row_ids if isinstance(row_ids, list) else [row_ids]
-        for k, olist in zip(keys, outs):
+        # align row_ids with the flattened (key, out) pairs: one per out,
+        # one per key (broadcast over that key's device outs), or one for
+        # everything — the reference's c_api contract
+        flat = [(k, o) for k, olist in zip(keys, outs) for o in olist]
+        if len(rids) == len(flat):
+            pair_rids = rids
+        elif len(rids) == len(keys):
+            pair_rids = [rids[i] for i, (k, olist) in
+                         enumerate(zip(keys, outs)) for _ in olist]
+        elif len(rids) == 1:
+            pair_rids = rids * len(flat)
+        else:
+            raise MXNetError("row_sparse_pull: %d row_ids for %d outs"
+                             % (len(rids), len(flat)))
+        for (k, o), rid in zip(flat, pair_rids):
             src = self._store[k]
-            for o, rid in zip(olist, rids * len(olist)):
-                idx = rid._handle.astype(jnp.int32)
-                if isinstance(src, RowSparseNDArray):
-                    dense = src._to_dense_handle()
-                else:
-                    dense = src._handle
-                data = jnp.take(dense, idx, axis=0)
-                if isinstance(o, RowSparseNDArray):
-                    o._data = data
-                    o._indices = idx.astype(jnp.int64)
-                    o._dense_cache = None
-                else:
-                    o._handle = dense
+            ids = rid.asnumpy().astype(np.int64) \
+                if isinstance(rid, NDArray) else np.asarray(rid, np.int64)
+            if isinstance(src, RowSparseNDArray):
+                pulled = src.gather_rows(ids)
+            else:
+                uniq = np.unique(ids)
+                data = jnp.take(src._handle,
+                                jnp.asarray(uniq, jnp.int32), axis=0)
+                pulled = RowSparseNDArray(data, jnp.asarray(uniq), src.shape)
+            if isinstance(o, RowSparseNDArray):
+                o._data = pulled._data
+                o._indices = pulled._indices
+                o._dense_cache = None
+            else:
+                # dense out: only the requested rows are filled
+                idx = jnp.asarray(np.asarray(pulled._indices), jnp.int32)
+                o._handle = jnp.zeros(
+                    src.shape, pulled._data.dtype).at[idx].set(pulled._data)
         return
 
     # -- updater/optimizer -----------------------------------------------
@@ -203,8 +223,10 @@ class KVStore:
                 return merged
             merged = NDArray(merged._handle)
         elif isinstance(vlist[0], RowSparseNDArray):
-            dense = _sum_arrays([v._handle for v in vlist])
-            merged = NDArray(dense)
+            # sparse reduce stays sparse: union of row ids, duplicates
+            # summed (reference Comm row_sparse reduce) — never densified
+            from .ndarray.sparse import merge_row_sparse
+            return merge_row_sparse(vlist)
         else:
             lead = vlist[0]._handle
             handles = [lead] + [jax.device_put(v._handle, lead.devices().pop())
@@ -268,9 +290,13 @@ class KVStoreTPUDist(KVStore):
 
     def _reduce(self, k, vlist):
         merged = super()._reduce(k, vlist)
-        if self.num_workers > 1 and not isinstance(merged, RowSparseNDArray):
-            from .parallel import allreduce_array
-            merged._handle = allreduce_array(merged._handle)
+        if self.num_workers > 1:
+            if isinstance(merged, RowSparseNDArray):
+                from .parallel import allreduce_row_sparse
+                merged = allreduce_row_sparse(merged)
+            else:
+                from .parallel import allreduce_array
+                merged._handle = allreduce_array(merged._handle)
         return merged
 
 

@@ -84,6 +84,15 @@ class RowSparseNDArray(BaseSparseNDArray):
 
     def __init__(self, data, indices, shape):
         super().__init__(shape, data)
+        # format invariant (as in the reference): indices sorted ascending —
+        # every sparse kernel here (searchsorted-based retain/gather/merge/
+        # lazy updates) depends on it, so enforce at construction
+        idx_np = np.asarray(indices)
+        if idx_np.size > 1 and not np.all(idx_np[1:] >= idx_np[:-1]):
+            order = np.argsort(idx_np, kind="stable")
+            indices = jnp.asarray(idx_np[order])
+            self._data = jnp.take(self._data, jnp.asarray(order, jnp.int32),
+                                  axis=0)
         self._indices = indices
         self._stype = "row_sparse"
 
@@ -100,14 +109,41 @@ class RowSparseNDArray(BaseSparseNDArray):
         return out.at[self._indices.astype(jnp.int32)].set(self._data)
 
     def retain(self, indices) -> "RowSparseNDArray":
-        """Keep only the given rows (reference sparse_retain op)."""
-        idx = indices._handle.astype(jnp.int32) if isinstance(indices, NDArray) \
-            else jnp.asarray(indices, jnp.int32)
-        # gather rows present in both: implemented as dense row gather of
-        # the dense form restricted to requested indices
-        dense = self._to_dense_handle()
-        data = jnp.take(dense, idx, axis=0)
-        return RowSparseNDArray(data, idx.astype(jnp.int64), self._shape)
+        """Keep only the given rows (reference sparse_retain op).
+
+        Pure (data, indices) formulation — O(nnz + |indices|), never
+        materialises the dense (num_rows, ...) array."""
+        req = indices.asnumpy() if isinstance(indices, NDArray) \
+            else np.asarray(indices)
+        req = np.unique(req.astype(np.int64))
+        stored = np.asarray(self._indices)
+        pos = np.searchsorted(stored, req)
+        pos_c = np.clip(pos, 0, max(len(stored) - 1, 0))
+        present = np.zeros(len(req), bool) if len(stored) == 0 else \
+            (stored[pos_c] == req)
+        keep_req = req[present]
+        keep_pos = pos_c[present]
+        data = jnp.take(self._data, jnp.asarray(keep_pos, jnp.int32), axis=0)
+        return RowSparseNDArray(data, jnp.asarray(keep_req, jnp.int64),
+                                self._shape)
+
+    def gather_rows(self, row_ids) -> "RowSparseNDArray":
+        """Rows for every requested id (zeros where absent) — the pull-side
+        kernel of PullRowSparse (reference kvstore_dist.h:267)."""
+        req = np.unique(np.asarray(row_ids).astype(np.int64))
+        stored = np.asarray(self._indices)
+        if len(stored) == 0:
+            data = jnp.zeros((len(req),) + tuple(self._shape[1:]),
+                             self._data.dtype)
+            return RowSparseNDArray(data, jnp.asarray(req), self._shape)
+        pos = np.searchsorted(stored, req)
+        pos_c = np.clip(pos, 0, len(stored) - 1)
+        present = stored[pos_c] == req
+        data = jnp.take(self._data, jnp.asarray(pos_c, jnp.int32), axis=0)
+        mask = jnp.asarray(present).reshape(
+            (-1,) + (1,) * (self._data.ndim - 1))
+        return RowSparseNDArray(data * mask.astype(data.dtype),
+                                jnp.asarray(req), self._shape)
 
     def copyto(self, other):
         if isinstance(other, RowSparseNDArray):
@@ -202,6 +238,103 @@ def _dense_to_csr(dense) -> CSRNDArray:
                       jnp.asarray(indptr), (m, n))
 
 
+def merge_row_sparse(arrays) -> RowSparseNDArray:
+    """Sum RowSparseNDArrays keeping (data, indices) — the kvstore reduce
+    for sparse gradients (reference Comm::Reduce row_sparse path).  Result
+    nnz = |union of row ids|; the dense shape is never materialised."""
+    arrays = list(arrays)
+    if not arrays:
+        raise MXNetError("merge_row_sparse: no inputs")
+    shape = arrays[0].shape
+    arrays = [a for a in arrays if a._data.shape[0] > 0]
+    if not arrays:  # all inputs empty: the merged gradient is empty too
+        return zeros_sparse("row_sparse", shape)
+    all_idx = np.concatenate([np.asarray(a._indices) for a in arrays])
+    uniq, inv = np.unique(all_idx, return_inverse=True)
+    data = jnp.concatenate([a._data for a in arrays], axis=0)
+    summed = jax.ops.segment_sum(data, jnp.asarray(inv, jnp.int32),
+                                 num_segments=len(uniq))
+    return RowSparseNDArray(summed, jnp.asarray(uniq, jnp.int64), shape)
+
+
+def _weight_rows(weight, grad_ids):
+    """(gather_fn, scatter_fn) touching only grad_ids rows of weight,
+    for dense or row_sparse weights."""
+    if isinstance(weight, RowSparseNDArray):
+        stored = np.asarray(weight._indices)
+        pos = np.searchsorted(stored, grad_ids)
+        pos_c = np.clip(pos, 0, max(len(stored) - 1, 0))
+        if len(stored) == 0 or not np.all(stored[pos_c] == grad_ids):
+            raise MXNetError(
+                "row_sparse weight is missing rows present in the "
+                "gradient; initialise the weight with those rows first")
+        pidx = jnp.asarray(pos_c, jnp.int32)
+
+        def gather():
+            return jnp.take(weight._data, pidx, axis=0)
+
+        def scatter(new_rows):
+            weight._data = weight._data.at[pidx].set(
+                new_rows.astype(weight._data.dtype))
+            weight._dense_cache = None
+        return gather, scatter
+    idx = jnp.asarray(grad_ids, jnp.int32)
+
+    def gather():
+        return jnp.take(weight._handle, idx, axis=0)
+
+    def scatter(new_rows):
+        weight._handle = weight._handle.at[idx].set(
+            new_rows.astype(weight._handle.dtype))
+    return gather, scatter
+
+
+def sgd_row_sparse_update(weight, grad: RowSparseNDArray, mom,
+                          lr, wd=0.0, momentum=0.0, rescale_grad=1.0,
+                          clip_gradient=None):
+    """Lazy SGD: touch ONLY the grad's active rows of weight (and
+    momentum), like the reference's row_sparse sgd(_mom)_update
+    (optimizer_op.cc:208): O(nnz) compute + one scatter.  Works for dense
+    and row_sparse weights."""
+    ids = np.asarray(grad._indices)
+    idx = jnp.asarray(ids, jnp.int32)
+    gather, scatter = _weight_rows(weight, ids)
+    g = grad._data.astype(jnp.float32) * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    rows = gather().astype(jnp.float32)
+    g = g + wd * rows
+    if mom is not None:
+        m_rows = jnp.take(mom._handle, idx, axis=0)
+        new_m = momentum * m_rows - lr * g
+        mom._handle = mom._handle.at[idx].set(new_m.astype(mom.dtype))
+        new_rows = rows + new_m
+    else:
+        new_rows = rows - lr * g
+    scatter(new_rows)
+
+
+def adam_row_sparse_update(weight, grad: RowSparseNDArray, mean, var,
+                           lr, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                           wd=0.0, rescale_grad=1.0, clip_gradient=None):
+    """Lazy Adam over active rows only (reference adam_update row_sparse
+    variant, optimizer_op.cc:354)."""
+    ids = np.asarray(grad._indices)
+    idx = jnp.asarray(ids, jnp.int32)
+    gather, scatter = _weight_rows(weight, ids)
+    rows = gather().astype(jnp.float32)
+    g = grad._data.astype(jnp.float32) * rescale_grad + wd * rows
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    m_rows = beta1 * jnp.take(mean._handle, idx, axis=0) + (1 - beta1) * g
+    v_rows = beta2 * jnp.take(var._handle, idx, axis=0) + \
+        (1 - beta2) * g * g
+    mean._handle = mean._handle.at[idx].set(m_rows)
+    var._handle = var._handle.at[idx].set(v_rows)
+    new_rows = rows - lr * m_rows / (jnp.sqrt(v_rows) + epsilon)
+    scatter(new_rows)
+
+
 def cast_storage(arr, stype: str):
     """reference: src/operator/tensor/cast_storage-inl.h"""
     if stype == "default":
@@ -216,12 +349,48 @@ def cast_storage(arr, stype: str):
 
 
 def sparse_dot(lhs, rhs, transpose_a=False):
-    """dot(csr, dense) / dot(csr.T, dense) (reference dot-inl.h sparse paths)."""
+    """dot(csr, dense) / dot(csr.T, dense) (reference dot-inl.h sparse
+    paths) in O(nnz * k): segment-sum over the nonzeros — the dense
+    (m, n) matrix is never built."""
     if isinstance(lhs, CSRNDArray):
-        dense = lhs._to_dense_handle()
-        out = (dense.T if transpose_a else dense) @ rhs._handle
+        m, n = lhs.shape
+        indptr = np.asarray(lhs._indptr)
+        rows = jnp.asarray(np.repeat(np.arange(m), np.diff(indptr)),
+                           jnp.int32)
+        cols = jnp.asarray(np.asarray(lhs._indices), jnp.int32)
+        vals = lhs._data
+        if vals.shape[0] == 0:
+            out_rows = n if transpose_a else m
+            return NDArray(jnp.zeros((out_rows, rhs.shape[1]),
+                                     rhs._handle.dtype))
+        if transpose_a:
+            # out[c, :] += val * rhs[r, :]
+            contrib = vals[:, None] * jnp.take(rhs._handle, rows, axis=0)
+            out = jax.ops.segment_sum(contrib, cols, num_segments=n)
+        else:
+            # out[r, :] += val * rhs[c, :]
+            contrib = vals[:, None] * jnp.take(rhs._handle, cols, axis=0)
+            out = jax.ops.segment_sum(contrib, rows, num_segments=m)
         return NDArray(out)
     return invoke_with_arrays("dot", [lhs, rhs], dict(transpose_a=transpose_a))
+
+
+def embedding_grad(row_ids, grad_rows, vocab_size) -> RowSparseNDArray:
+    """IndexedSlices-style embedding gradient: (grad rows, ids) -> a
+    row_sparse grad with duplicate ids summed, never densified (reference
+    Embedding sparse_grad / indexing_op.h backward).  The natural partner
+    of kvstore.row_sparse_pull in the wide-embedding training loop
+    (reference example/sparse/)."""
+    ids = row_ids.asnumpy() if isinstance(row_ids, NDArray) \
+        else np.asarray(row_ids)
+    rows = grad_rows._handle if isinstance(grad_rows, NDArray) \
+        else jnp.asarray(grad_rows)
+    uniq, inv = np.unique(ids.astype(np.int64).ravel(), return_inverse=True)
+    summed = jax.ops.segment_sum(
+        rows.reshape((-1,) + rows.shape[ids.ndim:]),
+        jnp.asarray(inv, jnp.int32), num_segments=len(uniq))
+    shape = (int(vocab_size),) + tuple(rows.shape[ids.ndim:])
+    return RowSparseNDArray(summed, jnp.asarray(uniq), shape)
 
 
 def zeros_sparse(stype, shape, ctx=None, dtype="float32"):

@@ -170,7 +170,16 @@ class SGD(Optimizer):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         kw = self._common_kwargs(index)
-        if state is not None:
+        from .ndarray.sparse import RowSparseNDArray, sgd_row_sparse_update
+        if isinstance(grad, RowSparseNDArray) and self.lazy_update:
+            # lazy update: only the grad's active rows of weight/momentum
+            # are touched (reference row_sparse sgd kernels,
+            # optimizer_op.cc:208)
+            sgd_row_sparse_update(
+                weight, grad, state, lr=kw["lr"], wd=kw["wd"],
+                momentum=self.momentum, rescale_grad=kw["rescale_grad"],
+                clip_gradient=kw.get("clip_gradient"))
+        elif state is not None:
             invoke_with_arrays("sgd_mom_update", [weight, grad, state],
                                dict(momentum=self.momentum, **kw))
         else:
@@ -357,6 +366,14 @@ class Adam(Optimizer):
         coef2 = 1. - self.beta2 ** t
         lr *= math.sqrt(coef2) / coef1
         mean, var = state
+        from .ndarray.sparse import RowSparseNDArray, adam_row_sparse_update
+        if isinstance(grad, RowSparseNDArray) and self.lazy_update:
+            adam_row_sparse_update(
+                weight, grad, mean, var, lr=lr, beta1=self.beta1,
+                beta2=self.beta2, epsilon=self.epsilon, wd=wd,
+                rescale_grad=self.rescale_grad,
+                clip_gradient=self.clip_gradient)
+            return
         kw = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
                   beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon)
         if self.clip_gradient is not None:

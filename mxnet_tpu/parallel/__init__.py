@@ -93,3 +93,31 @@ def allreduce_array(x):
     from jax.experimental import multihost_utils
     gathered = multihost_utils.process_allgather(x)
     return jnp.sum(gathered, axis=0)
+
+
+def allreduce_row_sparse(rs):
+    """Union-sum a RowSparseNDArray across processes without densifying
+    (the reference's sparse push aggregation, kvstore_dist_server.h:223).
+    nnz differs per rank, so rows are padded to the global max (padding
+    ids = -1), allgathered, and merged."""
+    if jax.process_count() == 1:
+        return rs
+    from jax.experimental import multihost_utils
+    from ..ndarray.sparse import RowSparseNDArray, merge_row_sparse
+    nnz = rs._data.shape[0]
+    max_nnz = int(np.max(multihost_utils.process_allgather(
+        jnp.asarray([nnz]))))
+    pad = max_nnz - nnz
+    data = jnp.pad(rs._data, [(0, pad)] + [(0, 0)] * (rs._data.ndim - 1))
+    idx = jnp.pad(rs._indices, (0, pad), constant_values=-1)
+    all_data = multihost_utils.process_allgather(data)
+    all_idx = np.asarray(multihost_utils.process_allgather(idx))
+    parts = []
+    for p in range(all_idx.shape[0]):
+        keep = all_idx[p] >= 0
+        if not np.any(keep):
+            continue
+        parts.append(RowSparseNDArray(
+            jnp.asarray(np.asarray(all_data[p])[keep]),
+            jnp.asarray(all_idx[p][keep]), rs.shape))
+    return merge_row_sparse(parts) if parts else rs

@@ -70,6 +70,26 @@ def main():
     kv.pull("5", out=val)
     check_equal_scalar(val, 1 + RATE, rank)  # init 1 + 1*rate
 
+    # row_sparse push/pull across ranks (reference check_row_sparse_keys):
+    # each rank pushes one rank-dependent row; the union-sum must be
+    # observed by every rank, moving only the requested rows
+    from mxnet_tpu.ndarray import sparse as sp
+    kv.init("9", mx.nd.ones(SHAPE))
+    my_row = rank % SHAPE[0]
+    grad = sp.RowSparseNDArray(
+        (mx.nd.ones((1, SHAPE[1])) * (rank + 1))._handle,
+        mx.nd.array([my_row]).astype("int64")._handle, SHAPE)
+    kv.push("9", grad)
+    kv.barrier()
+    expected = np.ones(SHAPE)
+    for r in range(nworker):
+        expected[r % SHAPE[0]] += (r + 1) * RATE
+    val = sp.zeros_sparse("row_sparse", SHAPE)
+    kv.row_sparse_pull("9", out=val,
+                       row_ids=mx.nd.array(np.arange(SHAPE[0])))
+    np.testing.assert_allclose(np.asarray(val._handle), expected, rtol=1e-6,
+                               err_msg="rank %d" % rank)
+
     # raw DCN allreduce + barrier primitives
     import jax.numpy as jnp
     total = parallel.allreduce_array(jnp.full((4,), float(rank + 1)))
