@@ -97,7 +97,12 @@ class NDArray:
 
     # -- sync / host transfer -------------------------------------------
     def asnumpy(self) -> np.ndarray:
-        return np.asarray(self._handle)
+        # fresh writable buffer, matching the reference's copy-out semantics
+        # (python/mxnet/ndarray/ndarray.py asnumpy → MXNDArraySyncCopyToCPU)
+        out = np.asarray(self._handle)
+        if not out.flags.writeable:
+            out = out.copy()
+        return out
 
     def asscalar(self):
         if self.size != 1:

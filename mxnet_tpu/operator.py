@@ -1,0 +1,313 @@
+"""CustomOp — frontend-defined operators usable from NDArray, Symbol, Module
+and Gluon graphs.
+
+Reference surface: python/mxnet/operator.py (CustomOp :422, CustomOpProp
+:468, register :602) over src/operator/custom/custom.cc.
+
+TPU-native design: the reference marshals the python body through a C
+callback table (MXCustomOpInfo) and runs it on a special "custom" engine
+thread.  Here the python body is embedded into the traced XLA program via
+``jax.pure_callback`` — XLA calls back onto the host at exactly the point
+the op appears in the fused program, which is the same execution contract
+(host-side python, device-side neighbours) without any FFI plumbing.
+Gradients are wired with ``jax.custom_vjp``: the user's ``backward`` *is*
+the vjp rule, so a Custom node composes with whole-graph ``jax.vjp``
+exactly like a native op.
+
+The op instance lifecycle follows the reference: ``register`` stores the
+prop class; each distinct (attrs) creates one ``CustomOpProp``; each
+distinct input signature asks it for one ``CustomOp`` via
+``create_operator`` (custom.cc CreateState analog), which then serves every
+forward/backward at that signature — so user ops may cache state on
+``self`` between forward and backward.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import MXNetError
+from .ops.registry import AttrDict, Operator, _REGISTRY
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered_operators"]
+
+
+class CustomOp(object):
+    """Base class for user-defined operators (reference operator.py:422).
+
+    Subclass and override ``forward``/``backward``.  Data arrives as
+    framework NDArrays; write results with ``self.assign``.
+    """
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        """Compute outputs.  ``req`` is one of 'null'/'write'/'add' per
+        output; ``in_data``/``out_data``/``aux`` are lists of NDArrays."""
+        raise NotImplementedError()
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        """Compute input gradients into ``in_grad`` (honouring ``req``)."""
+        raise NotImplementedError()
+
+    def assign(self, dst, req, src):
+        """Helper honouring the write request, like the reference's."""
+        if req == "null":
+            return
+        if req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] = dst + src
+        else:
+            raise MXNetError("invalid req %r" % (req,))
+
+
+class CustomOpProp(object):
+    """Operator metadata provider (reference operator.py:468).
+
+    ``register`` instantiates this once per attrs set; it answers
+    shape/type/name queries and manufactures the stateful ``CustomOp``.
+    """
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def infer_shape(self, in_shape):
+        """Default: all outputs shaped like the first input; aux empty."""
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), \
+            [in_shape[0]] * len(self.list_auxiliary_states())
+
+    def infer_type(self, in_type):
+        """Default: everything adopts the first input's dtype."""
+        return in_type, [in_type[0]] * len(self.list_outputs()), \
+            [in_type[0]] * len(self.list_auxiliary_states())
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        """Kept for API parity.  The functional formulation always threads
+        (in_data, out_data, out_grad) to backward, which is a superset of
+        any dependency the reference lets you declare."""
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+# ---------------------------------------------------------------------------
+# registry of prop classes (reference _Registry :585 + MXCustomOpRegister)
+# ---------------------------------------------------------------------------
+
+_PROP_CLASSES: Dict[str, type] = {}
+
+# reserved attr keys that are plumbing, not user kwargs for the prop
+_RESERVED = ("op_type", "num_args", "_train")
+
+
+def register(reg_name):
+    """Decorator registering a ``CustomOpProp`` subclass under ``reg_name``
+    (reference operator.py:602).  After registration the op is reachable as
+    ``mx.nd.Custom(..., op_type=reg_name)`` and
+    ``mx.sym.Custom(..., op_type=reg_name)``."""
+
+    def do_register(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError(
+                "register('%s') expects a CustomOpProp subclass" % reg_name)
+        _PROP_CLASSES[reg_name] = prop_cls
+        return prop_cls
+
+    return do_register
+
+
+def get_all_registered_operators() -> List[str]:
+    return sorted(_PROP_CLASSES)
+
+
+# ---------------------------------------------------------------------------
+# per-(attrs) state: one prop, one CustomOp per input signature
+# ---------------------------------------------------------------------------
+
+class _CustomState(object):
+    __slots__ = ("prop", "ops", "arg_names", "aux_names", "out_names")
+
+    def __init__(self, attrs: AttrDict):
+        op_type = attrs.get("op_type")
+        if op_type is None:
+            raise MXNetError("Custom op requires an op_type= attribute")
+        try:
+            prop_cls = _PROP_CLASSES[op_type]
+        except KeyError:
+            raise MXNetError(
+                "Custom op type %r is not registered (known: %s)"
+                % (op_type, get_all_registered_operators())) from None
+        user_kwargs = {k: v for k, v in attrs.items()
+                       if k not in _RESERVED and not k.startswith("__")}
+        self.prop = prop_cls(**user_kwargs)
+        self.ops: Dict[Tuple, CustomOp] = {}
+        self.arg_names = list(self.prop.list_arguments())
+        self.aux_names = list(self.prop.list_auxiliary_states())
+        self.out_names = list(self.prop.list_outputs())
+
+    def operator_for(self, in_shapes, in_dtypes) -> CustomOp:
+        key = (tuple(map(tuple, in_shapes)), tuple(map(str, in_dtypes)))
+        if key not in self.ops:
+            from .context import current_context
+            self.ops[key] = self.prop.create_operator(
+                current_context(), [list(s) for s in in_shapes],
+                list(in_dtypes))
+        return self.ops[key]
+
+
+_STATE_CACHE: Dict[Tuple, _CustomState] = {}
+
+
+def _state_for(attrs: AttrDict) -> _CustomState:
+    key = attrs.key()
+    if key not in _STATE_CACHE:
+        _STATE_CACHE[key] = _CustomState(attrs)
+    return _STATE_CACHE[key]
+
+
+def _wrap_nd(np_arrays):
+    from .ndarray import NDArray
+    return [NDArray(jnp.asarray(a)) for a in np_arrays]
+
+
+def _np_of(nd_list):
+    return tuple(np.asarray(x.asnumpy()) for x in nd_list)
+
+
+# ---------------------------------------------------------------------------
+# the Custom operator itself, registered into the op registry
+# ---------------------------------------------------------------------------
+
+def _custom_fn(attrs: AttrDict, *arrays):
+    state = _state_for(attrs)
+    n_args = len(state.arg_names)
+    n_aux = len(state.aux_names)
+    n_out = len(state.out_names)
+    if len(arrays) != n_args + n_aux:
+        raise MXNetError(
+            "Custom op %s expects %d inputs (%s) + %d aux (%s), got %d"
+            % (attrs.get("op_type"), n_args, state.arg_names, n_aux,
+               state.aux_names, len(arrays)))
+    is_train = bool(attrs.get("_train", False))
+
+    in_shapes = [tuple(a.shape) for a in arrays]
+    in_dtypes = [np.dtype(a.dtype) for a in arrays]
+    _, out_shapes, _ = state.prop.infer_shape(
+        [list(s) for s in in_shapes[:n_args]])
+    _, out_dtypes, _ = state.prop.infer_type(list(in_dtypes[:n_args]))
+    out_structs = [jax.ShapeDtypeStruct(tuple(s), np.dtype(d))
+                   for s, d in zip(out_shapes, out_dtypes)]
+    in_structs = [jax.ShapeDtypeStruct(s, d)
+                  for s, d in zip(in_shapes, in_dtypes)]
+    cop = state.operator_for(in_shapes, in_dtypes)
+
+    def _forward_host(*vals):
+        in_data = _wrap_nd(vals[:n_args])
+        aux = _wrap_nd(vals[n_args:])
+        out_data = _wrap_nd([np.zeros(s.shape, s.dtype) for s in out_structs])
+        cop.forward(is_train, ["write"] * n_out, in_data, out_data, aux)
+        return _np_of(out_data)
+
+    def _backward_host(*vals):
+        in_np = vals[:n_args + n_aux]
+        out_np = vals[n_args + n_aux:n_args + n_aux + n_out]
+        g_np = vals[n_args + n_aux + n_out:]
+        in_data = _wrap_nd(in_np[:n_args])
+        aux = _wrap_nd(in_np[n_args:])
+        out_data = _wrap_nd(out_np)
+        out_grad = _wrap_nd(g_np) if state.prop.need_top_grad() else []
+        in_grad = _wrap_nd([np.zeros(s.shape, s.dtype)
+                            for s in in_structs[:n_args]])
+        cop.backward(["write"] * n_args, out_grad, in_data, out_data,
+                     in_grad, aux)
+        grads = _np_of(in_grad)
+        # aux states are not differentiated (reference: aux excluded from
+        # DeclareBackwardDependency grads)
+        grads += tuple(np.zeros(s.shape, s.dtype)
+                       for s in in_structs[n_args:])
+        return grads
+
+    @jax.custom_vjp
+    def run(*vals):
+        return tuple(jax.pure_callback(_forward_host, out_structs, *vals))
+
+    def run_fwd(*vals):
+        outs = tuple(jax.pure_callback(_forward_host, out_structs, *vals))
+        return outs, (vals, outs)
+
+    def run_bwd(res, gouts):
+        vals, outs = res
+        grads = jax.pure_callback(_backward_host, in_structs,
+                                  *vals, *outs, *gouts)
+        return tuple(grads)
+
+    run.defvjp(run_fwd, run_bwd)
+    outs = run(*arrays)
+    return outs if len(outs) > 1 else outs[0]
+
+
+class _CustomOperator(Operator):
+    """Registry operator with an open attribute schema: every kwarg flows
+    through to the user's CustomOpProp constructor as a string, matching the
+    reference's key/value string marshalling (custom.cc CustomOpParam)."""
+
+    def aux_input_indices(self, attrs: Optional[AttrDict] = None):
+        if attrs is None or "op_type" not in attrs:
+            return ()
+        st = _state_for(attrs)
+        n = len(st.arg_names)
+        return tuple(range(n, n + len(st.aux_names)))
+
+    def parse_attrs(self, kwargs: Dict[str, Any]) -> AttrDict:
+        out = AttrDict()
+        for k, v in kwargs.items():
+            if k in ("name", "ctx", "dtype_out") or k.startswith("__"):
+                continue
+            if k in ("num_args", "_train"):
+                out[k] = v
+            else:
+                out[k] = v if isinstance(v, str) else str(v)
+        if "op_type" not in out:
+            raise MXNetError("Custom op requires op_type=")
+        return out
+
+
+def _custom_inputs(attrs: Optional[AttrDict], num_args=None) -> List[str]:
+    if attrs is None or "op_type" not in attrs:
+        return ["data"]
+    st = _state_for(attrs)
+    return st.arg_names + st.aux_names
+
+
+def _custom_num_outputs(attrs: Optional[AttrDict]) -> int:
+    if attrs is None or "op_type" not in attrs:
+        return 1
+    return len(_state_for(attrs).out_names)
+
+
+_REGISTRY["Custom"] = _CustomOperator(
+    "Custom", _custom_fn, params={}, inputs=_custom_inputs,
+    num_outputs=_custom_num_outputs, mode_dependent=True,
+    aux_inputs=(),
+    doc="Apply a registered CustomOp (reference src/operator/custom/).")
+_REGISTRY["_Custom"] = _REGISTRY["Custom"]

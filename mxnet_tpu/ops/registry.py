@@ -127,6 +127,11 @@ class Operator:
             return self._num_outputs(attrs)
         return self._num_outputs
 
+    def aux_input_indices(self, attrs: Optional[AttrDict] = None):
+        """Aux-state input positions; attrs-dependent for open-schema ops
+        (Custom) which override this."""
+        return self.aux_inputs
+
     def num_visible_outputs(self, attrs: Optional[AttrDict] = None) -> int:
         if self._num_visible_outputs is None:
             return self.num_outputs(attrs)

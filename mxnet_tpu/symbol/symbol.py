@@ -138,9 +138,12 @@ class Symbol:
     def _aux_var_ids(self) -> set:
         aux = set()
         for node in _topo_order(self._entries):
-            if node.is_var or not node.op.aux_inputs:
+            if node.is_var:
                 continue
-            for i in node.op.aux_inputs:
+            aux_idx = node.op.aux_input_indices(node.parsed_attrs())
+            if not aux_idx:
+                continue
+            for i in aux_idx:
                 if i < len(node.inputs) and node.inputs[i].node.is_var:
                     aux.add(id(node.inputs[i].node))
         return aux
