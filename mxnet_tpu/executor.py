@@ -30,6 +30,34 @@ from . import rng as _rng
 __all__ = ["Executor", "GraphProgram", "infer_shapes", "infer_types"]
 
 
+def batch_hint_from(arg_map: Dict[str, Any], arg_names: Sequence[str]):
+    """Leading-dim hint used to resolve 0-dims in creation-op shapes (the
+    reference begin_state convention): the 'data' arg if present, else the
+    first argument that has a shape."""
+    if "data" in arg_map and hasattr(arg_map["data"], "shape"):
+        return arg_map["data"].shape[0]
+    for n in arg_names:
+        v = arg_map.get(n)
+        if hasattr(v, "shape") and v.shape:
+            return v.shape[0]
+    return None
+
+
+def node_attrs(node, train: bool, batch_hint):
+    """Attrs for evaluating one graph node: 0-dims resolved against the
+    batch hint, _train injected for mode-dependent ops.  Single source of
+    truth for GraphProgram.evaluate and placement.SegmentedProgram."""
+    attrs = node.parsed_attrs()
+    if not node.inputs and 0 in (attrs.get("shape") or ()) and batch_hint:
+        attrs = type(attrs)(attrs)
+        attrs["shape"] = tuple(batch_hint if d == 0 else d
+                               for d in attrs["shape"])
+    if node.op.mode_dependent:
+        attrs = type(attrs)(attrs)
+        attrs["_train"] = train
+    return attrs
+
+
 class GraphProgram:
     """A Symbol compiled into a pure function.
 
@@ -67,12 +95,7 @@ class GraphProgram:
         """Pure evaluation. Returns (outputs, new_aux)."""
         arg_map = dict(zip(self.arg_names, arg_arrays))
         aux_map = dict(zip(self.aux_names, aux_arrays))
-        batch_hint = None
-        if "data" in arg_map and hasattr(arg_map["data"], "shape"):
-            batch_hint = arg_map["data"].shape[0]
-        elif arg_arrays and hasattr(arg_arrays[0], "shape") \
-                and arg_arrays[0].shape:
-            batch_hint = arg_arrays[0].shape[0]
+        batch_hint = batch_hint_from(arg_map, self.arg_names)
         key_idx = 0
         raw: Dict[int, tuple] = {}
         for node in self.nodes:
@@ -81,16 +104,7 @@ class GraphProgram:
                 val = arg_map[node.name] if kind == "arg" else aux_map[node.name]
                 raw[id(node)] = (val,)
                 continue
-            attrs = node.parsed_attrs()
-            # creation ops with 0-dims: 0 means "infer at bind" (reference
-            # begin_state convention) — resolved against the batch size
-            if not node.inputs and 0 in (attrs.get("shape") or ()):
-                attrs = type(attrs)(attrs)
-                attrs["shape"] = tuple(batch_hint if d == 0 else d
-                                       for d in attrs["shape"])
-            if node.op.mode_dependent:
-                attrs = type(attrs)(attrs)
-                attrs["_train"] = train
+            attrs = node_attrs(node, train, batch_hint)
             ins = [raw[id(e.node)][e.index] for e in node.inputs]
             if node.op.needs_rng:
                 ins = [keys[key_idx]] + ins
@@ -262,7 +276,8 @@ class Executor:
 
     def __init__(self, symbol: Symbol, ctx: Context,
                  args, args_grad=None, grad_req="write", aux_states=None,
-                 shared_exec: Optional["Executor"] = None, program=None):
+                 shared_exec: Optional["Executor"] = None, program=None,
+                 group2ctx=None):
         self._symbol = symbol
         self._ctx = ctx if isinstance(ctx, Context) else cpu()
         if program is not None:
@@ -310,10 +325,20 @@ class Executor:
         self._monitor_callback = None
         self._last_keys = None  # RNG keys of the last forward, for backward
 
+        # ctx_group model parallelism: if the symbol carries grouped nodes
+        # that map to a device other than the bind device, execute via the
+        # segmented per-device program (placement.py) instead of one jit.
+        self._seg = None
+        if group2ctx:
+            from .placement import SegmentedProgram, group_devices
+            devs = group_devices(symbol, group2ctx)
+            if devs and devs != {self._ctx.jax_device}:
+                self._seg = SegmentedProgram(self._prog, group2ctx, self._ctx)
+
     # -- binding helpers -------------------------------------------------
     @staticmethod
     def simple_bind(symbol: Symbol, ctx, grad_req="write", type_dict=None,
-                    shared_exec=None, **kwargs):
+                    shared_exec=None, group2ctx=None, **kwargs):
         prog, known, shapes = _resolve_structs(symbol, kwargs, type_dict)
         missing = [n for n in prog.arg_names if n not in known]
         if missing:
@@ -331,7 +356,7 @@ class Executor:
                              dtype=np.dtype(known[n].dtype), ctx=ctx)
                  for n in prog.arg_names if greq.get(n, "null") != "null"}
         return Executor(symbol, ctx, args, args_grad=grads, grad_req=greq,
-                        aux_states=aux, program=prog)
+                        aux_states=aux, program=prog, group2ctx=group2ctx)
 
     # -- execution -------------------------------------------------------
     def _keys(self):
@@ -343,13 +368,21 @@ class Executor:
         """Place an incoming array on this executor's device."""
         return jax.device_put(h, self._ctx.jax_device)
 
+    def _seg_grads(self, gmap, mask):
+        """Order the segmented-path grad dict per arg_names and narrow the
+        mask to names that actually received a cotangent."""
+        grads = tuple(gmap[n] for n, m in zip(self._prog.arg_names, mask)
+                      if m and n in gmap)
+        mask = tuple(m and n in gmap
+                     for n, m in zip(self._prog.arg_names, mask))
+        return grads, mask
+
     def forward(self, is_train=False, **kwargs):
         for k, v in kwargs.items():
             if k in self.arg_dict:
                 tgt = self.arg_dict[k]
                 tgt._handle = self._commit(
                     v._handle if isinstance(v, NDArray) else jnp.asarray(v))
-        fn = self._prog._jit_forward(bool(is_train))
         args = tuple(a._handle for a in self.arg_arrays)
         aux = tuple(a._handle for a in self.aux_arrays)
         keys = self._keys()
@@ -357,7 +390,15 @@ class Executor:
             # only a train forward defines the mask backward must reuse; an
             # interleaved eval forward (monitor/validation) must not clobber it
             self._last_keys = keys
-        outs, new_aux = fn(args, aux, keys)
+        if self._seg is not None:
+            arg_map = dict(zip(self._prog.arg_names, args))
+            aux_map = dict(zip(self._prog.aux_names, aux))
+            outs, new_aux_map, _ = self._seg.run(arg_map, aux_map, keys,
+                                                 bool(is_train))
+            new_aux = tuple(new_aux_map[n] for n in self._prog.aux_names)
+        else:
+            fn = self._prog._jit_forward(bool(is_train))
+            outs, new_aux = fn(args, aux, keys)
         if is_train:
             for nd_, na in zip(self.aux_arrays, new_aux):
                 nd_._handle = na
@@ -377,6 +418,10 @@ class Executor:
             tgt = self.grad_dict.get(name)
             if tgt is None:
                 continue
+            if self._seg is not None:
+                # grads come back on their segment's device; the grad buffer
+                # (and the optimizer update) live on the bind device
+                g = self._commit(g)
             if self.grad_req[name] == "add":
                 tgt._handle = tgt._handle + g.astype(tgt._handle.dtype)
             else:
@@ -387,7 +432,6 @@ class Executor:
                      for n in self._prog.arg_names)
         if not any(mask):
             return
-        fn = self._prog._jit_fwd_bwd(bool(is_train), mask)
         args = tuple(a._handle for a in self.arg_arrays)
         aux = tuple(a._handle for a in self.aux_arrays)
         # Reuse the RNG keys of the preceding forward so dropout masks etc.
@@ -406,7 +450,16 @@ class Executor:
                 out_grads = [out_grads]
             cots = tuple(g._handle if isinstance(g, NDArray) else jnp.asarray(g)
                          for g in out_grads)
-        _, _, grads = fn(args, aux, keys, cots)
+        if self._seg is not None:
+            gm = dict(zip(self._prog.arg_names, mask))
+            _, _, gmap = self._seg.run(dict(zip(self._prog.arg_names, args)),
+                                       dict(zip(self._prog.aux_names, aux)),
+                                       keys, bool(is_train),
+                                       grad_mask=gm, out_cots=cots)
+            grads, mask = self._seg_grads(gmap, mask)
+        else:
+            fn = self._prog._jit_fwd_bwd(bool(is_train), mask)
+            _, _, grads = fn(args, aux, keys, cots)
         self._write_grads(grads, mask)
 
     def run_fwd_bwd(self, out_cots=None, is_train=True):
@@ -423,6 +476,16 @@ class Executor:
             outs, new_aux = self._prog._jit_forward(bool(is_train))(
                 args, aux, keys)
             grads = ()
+        elif self._seg is not None:
+            gm = dict(zip(self._prog.arg_names, mask))
+            cots = None if out_cots is None else tuple(
+                c._handle if isinstance(c, NDArray) else c for c in out_cots)
+            outs, new_aux_map, gmap = self._seg.run(
+                dict(zip(self._prog.arg_names, args)),
+                dict(zip(self._prog.aux_names, aux)),
+                keys, bool(is_train), grad_mask=gm, out_cots=cots)
+            new_aux = tuple(new_aux_map[n] for n in self._prog.aux_names)
+            grads, mask = self._seg_grads(gmap, mask)
         else:
             fn = self._prog._jit_fwd_bwd(bool(is_train), mask)
             if out_cots is None:

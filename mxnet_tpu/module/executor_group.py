@@ -45,7 +45,14 @@ class DataParallelExecutorGroup:
     def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
                  param_names, for_training, inputs_need_grad,
                  shared_group=None, logger=logging, fixed_param_names=None,
-                 grad_req="write", state_names=None):
+                 grad_req="write", state_names=None, group2ctxs=None):
+        # reference executor_group.py:58 _prepare_group2ctxs: a dict applies
+        # to every data-parallel replica; a list gives one dict per replica
+        if group2ctxs is None:
+            group2ctxs = [None] * len(contexts)
+        elif isinstance(group2ctxs, dict):
+            group2ctxs = [group2ctxs] * len(contexts)
+        self.group2ctxs = group2ctxs
         self.param_names = param_names
         self.arg_names = symbol.list_arguments()
         self.aux_names = symbol.list_auxiliary_states()
@@ -112,7 +119,8 @@ class DataParallelExecutorGroup:
             for l in self.label_shapes:
                 kwargs[l.name] = (n_i,) + tuple(l.shape[1:])
             ex = Executor.simple_bind(self.symbol, ctx,
-                                      grad_req=self.grad_req, **kwargs)
+                                      grad_req=self.grad_req,
+                                      group2ctx=self.group2ctxs[i], **kwargs)
             if shared_group is not None and i < len(shared_group.execs):
                 # share parameter arrays with the shared group (bucketing)
                 src = shared_group.execs[i]

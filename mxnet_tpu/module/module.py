@@ -56,6 +56,7 @@ class Module(BaseModule):
         self._state_names = list(state_names or [])
         self._output_names = symbol.list_outputs()
         self._compression_params = compression_params
+        self._group2ctxs = group2ctxs
 
         _check_input_names(symbol, data_names, "data", True)
         _check_input_names(symbol, label_names, "label", False)
@@ -246,7 +247,8 @@ class Module(BaseModule):
             self._data_shapes, self._label_shapes, self._param_names,
             for_training, inputs_need_grad, shared_group,
             logger=self.logger, fixed_param_names=self._fixed_param_names,
-            grad_req=grad_req, state_names=self._state_names)
+            grad_req=grad_req, state_names=self._state_names,
+            group2ctxs=self._group2ctxs)
         self.binded = True
 
         if shared_module is not None and shared_module.params_initialized:

@@ -259,7 +259,12 @@ def _custom_fn(attrs: AttrDict, *arrays):
         vals, outs = res
         grads = jax.pure_callback(_backward_host, in_structs,
                                   *vals, *outs, *gouts)
-        return tuple(grads)
+        # custom_vjp demands float0 cotangents for integer primals
+        # (e.g. label/index inputs); the host callback returns int zeros
+        return tuple(
+            np.zeros(v.shape, jax.dtypes.float0)
+            if not jnp.issubdtype(v.dtype, jnp.inexact) else g
+            for g, v in zip(grads, vals))
 
     run.defvjp(run_fwd, run_bwd)
     outs = run(*arrays)
@@ -281,7 +286,8 @@ class _CustomOperator(Operator):
     def parse_attrs(self, kwargs: Dict[str, Any]) -> AttrDict:
         out = AttrDict()
         for k, v in kwargs.items():
-            if k in ("name", "ctx", "dtype_out") or k.startswith("__"):
+            if k in ("name", "ctx", "dtype_out", "ctx_group") \
+                    or k.startswith("__"):
                 continue
             if k in ("num_args", "_train"):
                 out[k] = v

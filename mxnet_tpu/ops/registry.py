@@ -109,7 +109,8 @@ class Operator:
         for k in kwargs:
             if k in self.params:
                 continue
-            if k in ("name", "dtype_out", "ctx") or k.startswith("__"):
+            if k in ("name", "dtype_out", "ctx", "ctx_group") \
+                    or k.startswith("__"):
                 continue
             raise MXNetError("Unknown argument %r for operator %s" % (k, self.name))
         return out

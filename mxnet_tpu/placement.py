@@ -1,0 +1,258 @@
+"""ctx_group model parallelism — device placement by graph segmentation.
+
+Reference: src/executor/graph_executor.cc:313-436 (AssignContext →
+nnvm PlaceDevice pass → `_CrossDeviceCopy` insertion) and the
+``group2ctx`` argument of Symbol.bind (python/mxnet/symbol.py).
+
+TPU-native stance: one XLA program is SPMD — it cannot pin individual
+ops to different devices (that is MPMD).  So the `ctx_group` attribute
+is honoured the way the reference's executor honours it structurally:
+the graph is *partitioned* at group boundaries into segments, each
+segment is jitted and committed to its group's device, and boundary
+values are `jax.device_put` across devices — the exact analog of the
+reference inserting `_CrossDeviceCopy` nodes between subgraphs.
+Backward chains per-segment `jax.vjp` in reverse order, transferring
+cotangents across the same boundaries.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["SegmentedProgram", "group_devices"]
+
+_GROUP_KEYS = ("ctx_group", "__ctx_group__")
+
+
+def _node_group(node) -> Optional[str]:
+    for k in _GROUP_KEYS:
+        g = node.attrs.get(k)
+        if g is not None:
+            return str(g)
+    return None
+
+
+def group_devices(symbol, group2ctx) -> set:
+    """Distinct jax devices the symbol's groups map to (empty if no
+    grouped node)."""
+    from .symbol.symbol import _topo_order
+    devs = set()
+    for n in _topo_order(symbol._entries):
+        g = _node_group(n)
+        if g is not None and g in group2ctx:
+            devs.add(group2ctx[g].jax_device)
+    return devs
+
+
+class _Segment:
+    __slots__ = ("device", "nodes", "in_entries", "out_entries",
+                 "key_off", "num_rng")
+
+    def __init__(self, device):
+        self.device = device
+        self.nodes = []
+        self.in_entries: List[Tuple[int, int]] = []
+        self.out_entries: List[Tuple[int, int]] = []
+        self.key_off = 0
+        self.num_rng = 0
+
+
+class SegmentedProgram:
+    """A GraphProgram partitioned into per-device jitted segments."""
+
+    def __init__(self, prog, group2ctx: Dict[str, "Context"], default_ctx):
+        self.prog = prog
+        self.default_dev = default_ctx.jax_device
+        g2d = {g: c.jax_device for g, c in (group2ctx or {}).items()}
+
+        # --- device assignment (the PlaceDevice analog) ---------------
+        # op nodes: their group's device, else the device of their first
+        # placed input (propagation), else the default.  var nodes: the
+        # device of their first consumer, so parameters live with the
+        # segment that uses them.
+        dev_of: Dict[int, object] = {}
+        for node in prog.nodes:
+            if node.is_var:
+                continue
+            g = _node_group(node)
+            if g is not None and g in g2d:
+                dev_of[id(node)] = g2d[g]
+            else:
+                dev = None
+                for e in node.inputs:
+                    dev = dev_of.get(id(e.node))
+                    if dev is not None:
+                        break
+                dev_of[id(node)] = dev or self.default_dev
+        for node in prog.nodes:
+            if not node.is_var:
+                continue
+            g = _node_group(node)
+            if g is not None and g in g2d:
+                dev_of[id(node)] = g2d[g]
+                continue
+            dev = None
+            for consumer in prog.nodes:
+                if consumer.is_var:
+                    continue
+                for e in consumer.inputs:
+                    if e.node is node:
+                        dev = dev_of[id(consumer)]
+                        break
+                if dev is not None:
+                    break
+            dev_of[id(node)] = dev or self.default_dev
+        self.dev_of = dev_of
+
+        # --- segmentation: maximal topo-contiguous same-device runs ---
+        self.segments: List[_Segment] = []
+        cur: Optional[_Segment] = None
+        for node in prog.nodes:
+            if node.is_var:
+                continue
+            d = dev_of[id(node)]
+            if cur is None or cur.device is not d:
+                cur = _Segment(d)
+                self.segments.append(cur)
+            cur.nodes.append(node)
+
+        # --- dataflow across segment boundaries -----------------------
+        produced_in: Dict[Tuple[int, int], int] = {}  # entry -> seg index
+        self.var_entries: Dict[Tuple[int, int], Tuple[str, str]] = {}
+        for node in prog.nodes:
+            if node.is_var:
+                self.var_entries[(id(node), 0)] = \
+                    (prog.var_kind[id(node)], node.name)
+        key_off = 0
+        for si, seg in enumerate(self.segments):
+            seg.key_off = key_off
+            in_set, out_set = [], []
+            local = set()
+            for node in seg.nodes:
+                if node.op.needs_rng:
+                    seg.num_rng += 1
+                for e in node.inputs:
+                    key = (id(e.node), e.index)
+                    if key in local or key in in_set:
+                        continue
+                    if key in self.var_entries or produced_in.get(key) != si:
+                        in_set.append(key)
+                for i in range(node.num_outputs()):
+                    produced_in[(id(node), i)] = si
+                    local.add((id(node), i))
+            key_off += seg.num_rng
+            seg.in_entries = in_set
+            seg.out_entries = out_set  # filled below
+        # an entry is a segment output if consumed by a LATER segment,
+        # is a final graph output, or feeds an aux writeback
+        needed = set()
+        for si, seg in enumerate(self.segments):
+            for key in seg.in_entries:
+                if key not in self.var_entries:
+                    needed.add(key)
+        self.head_entries = [(id(e.node), e.index)
+                             for e in prog.symbol._entries]
+        needed.update(self.head_entries)
+        self.aux_out = {}   # aux_name -> entry
+        for aux_name, node, i_out in prog.aux_updates:
+            self.aux_out[aux_name] = (id(node), i_out)
+            needed.add((id(node), i_out))
+        for si, seg in enumerate(self.segments):
+            seg.out_entries = [k for k in needed if produced_in.get(k) == si]
+
+    # -- per-segment pure functions ------------------------------------
+    @functools.lru_cache(maxsize=None)
+    def _seg_fn(self, si: int, train: bool, batch_hint: Optional[int]):
+        seg = self.segments[si]
+
+        from .executor import node_attrs
+
+        def f(in_vals, keys):
+            env = dict(zip(seg.in_entries, in_vals))
+            ki = 0
+            for node in seg.nodes:
+                attrs = node_attrs(node, train, batch_hint)
+                ins = [env[(id(e.node), e.index)] for e in node.inputs]
+                if node.op.needs_rng:
+                    ins = [keys[ki]] + ins
+                    ki += 1
+                out = node.op.fn(attrs, *ins)
+                out = out if isinstance(out, tuple) else (out,)
+                for i, o in enumerate(out):
+                    env[(id(node), i)] = o
+            return tuple(env[k] for k in seg.out_entries)
+        return jax.jit(f)
+
+    # -- execution ------------------------------------------------------
+    def run(self, arg_map, aux_map, keys, train: bool,
+            grad_mask: Optional[Dict[str, bool]] = None, out_cots=None):
+        """Returns (outputs, new_aux_map, grads_map-or-None).
+
+        grad_mask: {arg_name: bool}; grads returned only for True names.
+        """
+        from .executor import batch_hint_from
+        batch_hint = batch_hint_from(arg_map, self.prog.arg_names)
+        env: Dict[Tuple[int, int], object] = {}
+        for key, (kind, name) in self.var_entries.items():
+            src = arg_map if kind == "arg" else aux_map
+            if name in src:
+                env[key] = jax.device_put(src[name], self.dev_of[key[0]])
+        vjps = []
+        for si, seg in enumerate(self.segments):
+            fn = self._seg_fn(si, bool(train), batch_hint)
+            kslice = keys[seg.key_off:seg.key_off + seg.num_rng]
+            ins = tuple(jax.device_put(env[k], seg.device)
+                        for k in seg.in_entries)
+            if grad_mask is not None:
+                outs, vjp = jax.vjp(lambda i: fn(i, kslice), ins)
+                vjps.append(vjp)
+            else:
+                outs = fn(ins, kslice)
+            env.update(zip(seg.out_entries, outs))
+        outputs = tuple(env[k] for k in self.head_entries)
+        new_aux = dict(aux_map)
+        if train:
+            for aux_name, key in self.aux_out.items():
+                new_aux[aux_name] = env[key]
+        if grad_mask is None:
+            return outputs, new_aux, None
+
+        # --- backward: reverse per-segment vjp chain ------------------
+        if out_cots is None:
+            out_cots = tuple(jnp.ones_like(o) for o in outputs)
+        cot: Dict[Tuple[int, int], object] = {}
+
+        def _acc(key, c):
+            if c is None or (hasattr(c, "dtype")
+                             and c.dtype == jax.dtypes.float0):
+                return
+            if key in cot:
+                # consumers may live on different devices; bring the new
+                # cotangent to the accumulator's device before adding
+                prev = cot[key]
+                dev = next(iter(prev.devices())) if hasattr(prev, "devices") \
+                    else None
+                if dev is not None:
+                    c = jax.device_put(c, dev)
+                cot[key] = prev + c
+            else:
+                cot[key] = c
+        for key, c in zip(self.head_entries, out_cots):
+            _acc(key, c)
+        for si in range(len(self.segments) - 1, -1, -1):
+            seg = self.segments[si]
+            seg_cots = tuple(
+                jax.device_put(cot[k], seg.device) if k in cot
+                else jnp.zeros_like(env[k])
+                for k in seg.out_entries)
+            (in_cots,) = vjps[si](seg_cots)
+            for k, c in zip(seg.in_entries, in_cots):
+                _acc(k, c)
+        grads = {}
+        for key, (kind, name) in self.var_entries.items():
+            if kind == "arg" and grad_mask.get(name) and key in cot:
+                grads[name] = cot[key]
+        return outputs, new_aux, grads

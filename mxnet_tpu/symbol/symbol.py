@@ -332,14 +332,15 @@ class Symbol:
         from ..executor import Executor
         return Executor.simple_bind(self, ctx, grad_req=grad_req,
                                     type_dict=type_dict,
-                                    shared_exec=shared_exec, **kwargs)
+                                    shared_exec=shared_exec,
+                                    group2ctx=group2ctx, **kwargs)
 
     def bind(self, ctx, args, args_grad=None, grad_req="write",
              aux_states=None, group2ctx=None, shared_exec=None):
         from ..executor import Executor
         return Executor(self, ctx, args, args_grad=args_grad,
                         grad_req=grad_req, aux_states=aux_states,
-                        shared_exec=shared_exec)
+                        shared_exec=shared_exec, group2ctx=group2ctx)
 
     def eval(self, ctx=None, **kwargs):
         from ..context import cpu

@@ -131,3 +131,57 @@ def test_custom_unregistered_raises():
     x = mx.nd.array(np.ones((2, 2), np.float32))
     with pytest.raises(mx.MXNetError):
         mx.nd.Custom(x, op_type="no_such_op")
+
+
+@op_mod.register("intgather")
+class IntGatherProp(op_mod.CustomOpProp):
+    """Integer second input (indices) — its grad must be float0-dropped,
+    not returned as int zeros (custom_vjp contract)."""
+
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def list_arguments(self):
+        return ["data", "idx"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [(in_shape[1][0], in_shape[0][1])], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        class _Op(op_mod.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                x = in_data[0].asnumpy()
+                i = in_data[1].asnumpy().astype(np.int64)
+                self.assign(out_data[0], req[0], mx.nd.array(x[i]))
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+                g = np.zeros(in_data[0].shape, np.float32)
+                i = in_data[1].asnumpy().astype(np.int64)
+                np.add.at(g, i, out_grad[0].asnumpy())
+                self.assign(in_grad[0], req[0], mx.nd.array(g))
+                self.assign(in_grad[1], req[1],
+                            mx.nd.zeros(in_data[1].shape))
+
+        return _Op()
+
+
+def test_custom_op_integer_input_grad():
+    x = mx.nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    idx = mx.nd.array(np.array([1, 3], dtype=np.int64), dtype="int64")
+    x.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.Custom(x, idx, op_type="intgather")
+        loss = y.sum()
+    loss.backward()
+    expect = np.zeros((4, 3), np.float32)
+    expect[[1, 3]] = 1.0
+    np.testing.assert_allclose(x.grad.asnumpy(), expect)
+
+
+def test_custom_op_inside_ctx_group_scope():
+    with mx.AttrScope(ctx_group="dev1"):
+        sym = mx.sym.Custom(mx.sym.Variable("data"), op_type="scalemul",
+                            scale="3.0")
+    ex = sym.bind(mx.cpu(0), args={"data": mx.nd.ones((2, 2))})
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(),
+                               3 * np.ones((2, 2)))
