@@ -48,7 +48,12 @@ def node_attrs(node, train: bool, batch_hint):
     batch hint, _train injected for mode-dependent ops.  Single source of
     truth for GraphProgram.evaluate and placement.SegmentedProgram."""
     attrs = node.parsed_attrs()
-    if not node.inputs and 0 in (attrs.get("shape") or ()) and batch_hint:
+    if not node.inputs and 0 in (attrs.get("shape") or ()):
+        if not batch_hint:
+            raise ValueError(
+                "creation op %r has 0-dim shape %r but no batch hint is "
+                "available to resolve it (bind with a 'data' input or a "
+                "shaped argument)" % (node.op.name, attrs.get("shape")))
         attrs = type(attrs)(attrs)
         attrs["shape"] = tuple(batch_hint if d == 0 else d
                                for d in attrs["shape"])
@@ -192,11 +197,15 @@ def _resolve_structs(symbol: Symbol, kwargs: Dict[str, Any],
             else:
                 shapes[id(node)] = (None,)
             continue
-        attrs = node.parsed_attrs()
-        if not node.inputs and 0 in (attrs.get("shape") or ()) and batch_hint:
-            attrs = type(attrs)(attrs)
-            attrs["shape"] = tuple(batch_hint if d == 0 else d
-                                   for d in attrs["shape"])
+        # same 0-dim policy as evaluation (node_attrs): fail at bind time,
+        # not first forward, when a 0-dim cannot be resolved
+        try:
+            attrs = node_attrs(node, train=False, batch_hint=batch_hint)
+        except ValueError:
+            if partial:
+                shapes[id(node)] = (None,) * node.num_outputs()
+                continue
+            raise
         in_structs = [shapes[id(e.node)][e.index] for e in node.inputs]
         hook = getattr(node.op, "infer_params", None)
         if hook is not None and any(s is None for s in in_structs):
@@ -369,13 +378,37 @@ class Executor:
         return jax.device_put(h, self._ctx.jax_device)
 
     def _seg_grads(self, gmap, mask):
-        """Order the segmented-path grad dict per arg_names and narrow the
-        mask to names that actually received a cotangent."""
-        grads = tuple(gmap[n] for n, m in zip(self._prog.arg_names, mask)
-                      if m and n in gmap)
-        mask = tuple(m and n in gmap
-                     for n, m in zip(self._prog.arg_names, mask))
-        return grads, mask
+        """Order the segmented-path grad dict per arg_names.  A masked arg
+        that received no cotangent (disconnected from the loss) gets zeros,
+        matching the _jit_fwd_bwd path, rather than keeping a possibly
+        uninitialized grad buffer."""
+        grads = []
+        out_mask = []
+        for n, m in zip(self._prog.arg_names, mask):
+            if not m:
+                out_mask.append(False)
+                continue
+            if n in gmap:
+                grads.append(gmap[n])
+                out_mask.append(True)
+            elif self.grad_dict.get(n) is not None:
+                tgt = self.grad_dict[n]
+                grads.append(jnp.zeros(tuple(tgt.shape),
+                                       dtype=np.dtype(tgt.dtype)))
+                out_mask.append(True)
+            else:
+                # masked but no grad buffer to write — drop from the mask
+                out_mask.append(False)
+        return tuple(grads), tuple(out_mask)
+
+    def _seg_forward(self, args, aux, keys, is_train):
+        """Forward through the segmented (ctx_group) program; aux returned
+        in aux_names order."""
+        outs, new_aux_map, _ = self._seg.run(
+            dict(zip(self._prog.arg_names, args)),
+            dict(zip(self._prog.aux_names, aux)),
+            keys, bool(is_train))
+        return outs, tuple(new_aux_map[n] for n in self._prog.aux_names)
 
     def forward(self, is_train=False, **kwargs):
         for k, v in kwargs.items():
@@ -391,11 +424,7 @@ class Executor:
             # interleaved eval forward (monitor/validation) must not clobber it
             self._last_keys = keys
         if self._seg is not None:
-            arg_map = dict(zip(self._prog.arg_names, args))
-            aux_map = dict(zip(self._prog.aux_names, aux))
-            outs, new_aux_map, _ = self._seg.run(arg_map, aux_map, keys,
-                                                 bool(is_train))
-            new_aux = tuple(new_aux_map[n] for n in self._prog.aux_names)
+            outs, new_aux = self._seg_forward(args, aux, keys, is_train)
         else:
             fn = self._prog._jit_forward(bool(is_train))
             outs, new_aux = fn(args, aux, keys)
@@ -473,8 +502,14 @@ class Executor:
         keys = self._keys()
         self._last_keys = keys
         if not any(mask):
-            outs, new_aux = self._prog._jit_forward(bool(is_train))(
-                args, aux, keys)
+            if self._seg is not None:
+                # aux handles live on segment devices after a segmented step;
+                # the single-device jit would see mixed devices and either
+                # fail or silently ignore placement
+                outs, new_aux = self._seg_forward(args, aux, keys, is_train)
+            else:
+                outs, new_aux = self._prog._jit_forward(bool(is_train))(
+                    args, aux, keys)
             grads = ()
         elif self._seg is not None:
             gm = dict(zip(self._prog.arg_names, mask))

@@ -47,12 +47,9 @@ class DataParallelExecutorGroup:
                  shared_group=None, logger=logging, fixed_param_names=None,
                  grad_req="write", state_names=None, group2ctxs=None):
         # reference executor_group.py:58 _prepare_group2ctxs: a dict applies
-        # to every data-parallel replica; a list gives one dict per replica
-        if group2ctxs is None:
-            group2ctxs = [None] * len(contexts)
-        elif isinstance(group2ctxs, dict):
-            group2ctxs = [group2ctxs] * len(contexts)
-        self.group2ctxs = group2ctxs
+        # to every data-parallel replica (list-valued entries are split one
+        # context per replica); a list gives one dict per replica.
+        self.group2ctxs = self._prepare_group2ctxs(group2ctxs, len(contexts))
         self.param_names = param_names
         self.arg_names = symbol.list_arguments()
         self.aux_names = symbol.list_auxiliary_states()
@@ -89,6 +86,43 @@ class DataParallelExecutorGroup:
             self.grad_req = {k: "null" for k in self.grad_req}
 
         self.bind_exec(data_shapes, label_shapes, shared_group)
+
+    @staticmethod
+    def _prepare_group2ctxs(group2ctxs, ctx_len):
+        """Normalize group2ctxs to one dict of {group: Context} per replica.
+
+        reference executor_group.py:58: a list must have one entry per
+        context; a dict entry whose value is a single Context is shared by
+        every replica, while a list value is distributed one context per
+        replica (a length-1 list is broadcast).
+        """
+        if group2ctxs is None:
+            return [None] * ctx_len
+        if isinstance(group2ctxs, list):
+            if len(group2ctxs) != ctx_len:
+                raise ValueError(
+                    "group2ctxs list must have one dict per context "
+                    "(%d != %d)" % (len(group2ctxs), ctx_len))
+            return group2ctxs
+        if isinstance(group2ctxs, dict):
+            per_replica = [dict() for _ in range(ctx_len)]
+            for group, val in group2ctxs.items():
+                if isinstance(val, Context):
+                    spread = [val] * ctx_len
+                elif len(val) == 1:
+                    spread = list(val) * ctx_len
+                elif len(val) == ctx_len:
+                    spread = list(val)
+                else:
+                    raise ValueError(
+                        "group2ctxs[%r] must hold 1 or %d contexts, got %d"
+                        % (group, ctx_len, len(val)))
+                for i in range(ctx_len):
+                    per_replica[i][group] = spread[i]
+            return per_replica
+        raise TypeError(
+            "group2ctxs must be None, a dict of str->Context(s), or a list "
+            "of such dicts; got %r" % type(group2ctxs))
 
     def decide_slices(self, data_shapes):
         """reference executor_group.py:267"""

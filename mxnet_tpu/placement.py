@@ -21,6 +21,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 __all__ = ["SegmentedProgram", "group_devices"]
 
@@ -221,13 +222,26 @@ class SegmentedProgram:
             return outputs, new_aux, None
 
         # --- backward: reverse per-segment vjp chain ------------------
+        def _zero_cot(v):
+            # jax.vjp requires float0 cotangents for non-inexact primals
+            # (integer argmax/label paths crossing a segment boundary)
+            if not jnp.issubdtype(v.dtype, jnp.inexact):
+                return np.zeros(v.shape, jax.dtypes.float0)
+            return jnp.zeros_like(v)
+
         if out_cots is None:
-            out_cots = tuple(jnp.ones_like(o) for o in outputs)
+            out_cots = tuple(
+                jnp.ones_like(o) if jnp.issubdtype(o.dtype, jnp.inexact)
+                else np.zeros(o.shape, jax.dtypes.float0)
+                for o in outputs)
         cot: Dict[Tuple[int, int], object] = {}
 
         def _acc(key, c):
             if c is None or (hasattr(c, "dtype")
-                             and c.dtype == jax.dtypes.float0):
+                             and (c.dtype == jax.dtypes.float0
+                                  or not jnp.issubdtype(c.dtype, jnp.inexact))):
+                # no gradient flows through integer values; jax.vjp wants
+                # float0 there, which _zero_cot seeds at use time
                 return
             if key in cot:
                 # consumers may live on different devices; bring the new
@@ -246,7 +260,7 @@ class SegmentedProgram:
             seg = self.segments[si]
             seg_cots = tuple(
                 jax.device_put(cot[k], seg.device) if k in cot
-                else jnp.zeros_like(env[k])
+                else _zero_cot(env[k])
                 for k in seg.out_entries)
             (in_cots,) = vjps[si](seg_cots)
             for k, c in zip(seg.in_entries, in_cots):

@@ -124,3 +124,88 @@ def test_fanout_across_groups():
     # d/dx = 2*(1 + 2h) = 2*9 = 18
     np.testing.assert_allclose(ex.grad_dict["x"].asnumpy(),
                                18 * np.ones(shape))
+
+
+def test_group2ctxs_list_values_split_across_replicas():
+    """A dict whose values are context LISTS distributes one context per
+    data-parallel replica (reference _prepare_group2ctxs); a single Context
+    or length-1 list is broadcast to every replica."""
+    from mxnet_tpu.module.executor_group import DataParallelExecutorGroup
+    prep = DataParallelExecutorGroup._prepare_group2ctxs
+    c = [mx.cpu(i) for i in range(8)]
+    out = prep({"a": [c[2], c[3]], "b": c[4], "c": [c[5]]}, 2)
+    assert out == [{"a": c[2], "b": c[4], "c": c[5]},
+                   {"a": c[3], "b": c[4], "c": c[5]}]
+    # wrong lengths must fail loudly, not crash later in group_devices
+    import pytest
+    with pytest.raises(ValueError):
+        prep({"a": [c[0], c[1], c[2]]}, 2)
+    with pytest.raises(ValueError):
+        prep([{"a": c[0]}], 2)
+
+    # end-to-end: 2 DP replicas, each stage pinned per-replica
+    rs = np.random.RandomState(3)
+    x = rs.rand(8, 10).astype(np.float32)
+    y = rs.randint(0, 4, (8,)).astype(np.float32)
+    data = mx.sym.Variable("data")
+    with mx.AttrScope(ctx_group="stage1"):
+        h = mx.sym.FullyConnected(data, name="fc1", num_hidden=16)
+    net = mx.sym.SoftmaxOutput(h, name="softmax")
+    mod = mx.mod.Module(net, context=[mx.cpu(0), mx.cpu(1)],
+                        group2ctxs={"stage1": [mx.cpu(2), mx.cpu(3)]})
+    mod.bind(data_shapes=[("data", x.shape)],
+             label_shapes=[("softmax_label", y.shape)])
+    mod.init_params(mx.init.Uniform(0.1), force_init=True)
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    batch = mx.io.DataBatch(data=[mx.nd.array(x)], label=[mx.nd.array(y)])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    mod.update()
+    assert all(np.isfinite(v.asnumpy()).all()
+               for v in mod.get_params()[0].values())
+
+
+def test_integer_boundary_cotangent():
+    """An integer-dtype value crossing a segment boundary: backward must
+    seed a float0 cotangent for it (jax.vjp requirement), not a dtype
+    error (advisor r2 placement.py:249)."""
+    ctx = {"g1": mx.cpu(1), "g2": mx.cpu(2)}
+    x = mx.sym.Variable("x")
+    with mx.AttrScope(ctx_group="g1"):
+        h = x * 2
+        i = mx.sym.cast(x, dtype="int32")
+    with mx.AttrScope(ctx_group="g2"):
+        out = h + mx.sym.cast(i, dtype="float32")
+    shape = (3, 4)
+    args = {"x": mx.nd.ones(shape) * 1.5}
+    grads = {"x": mx.nd.empty(shape)}
+    ex = out.bind(mx.cpu(0), args=args, args_grad=grads, group2ctx=ctx)
+    ex.forward(is_train=True)
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), 4 * np.ones(shape))
+    ex.backward([mx.nd.ones(shape)])
+    # cast-to-int contributes no gradient; d/dx = 2
+    np.testing.assert_allclose(ex.grad_dict["x"].asnumpy(),
+                               2 * np.ones(shape))
+
+
+def test_disconnected_arg_gets_zero_grad_segmented():
+    """grad_req='write' arg whose path to the loss is blocked: the
+    segmented path must write zeros (matching _jit_fwd_bwd), not leave the
+    uninitialized buffer (advisor r2 executor.py:374)."""
+    ctx = {"g1": mx.cpu(1), "g2": mx.cpu(2)}
+    x = mx.sym.Variable("x")
+    w = mx.sym.Variable("w")
+    with mx.AttrScope(ctx_group="g1"):
+        h = x * 3
+        dead = mx.sym.BlockGrad(w)
+    with mx.AttrScope(ctx_group="g2"):
+        out = h + dead
+    shape = (2, 3)
+    args = {"x": mx.nd.ones(shape), "w": mx.nd.ones(shape)}
+    grads = {"x": mx.nd.empty(shape), "w": mx.nd.full(shape, 7.0)}
+    ex = out.bind(mx.cpu(0), args=args, args_grad=grads, group2ctx=ctx)
+    ex.forward(is_train=True)
+    ex.backward([mx.nd.ones(shape)])
+    np.testing.assert_allclose(ex.grad_dict["x"].asnumpy(), 3 * np.ones(shape))
+    np.testing.assert_allclose(ex.grad_dict["w"].asnumpy(), np.zeros(shape))
