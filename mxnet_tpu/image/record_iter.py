@@ -6,8 +6,12 @@ feeding BatchLoader + PrefetcherIter.
 
 Python/TPU analog: worker THREADS decode+augment (PIL releases the GIL),
 a bounded queue prefetches assembled batches, device transfer is async.
-Native C++ decode path lives in native/ (see native/recordio_reader.cc);
-when built it accelerates frame parsing transparently.
+
+When the native IO plane is built (`make -C native` →
+native/build/libmxnet_tpu_io.so, sources native/record_iter.cc +
+native/image_decode.cc), ImageRecordIter transparently selects it: OMP
+JPEG decode + bounded prefetch queue in C++, the reference's host hot
+loop.  Set MXNET_TPU_NATIVE_IO=0 to force the pure-Python path.
 """
 from __future__ import annotations
 
@@ -52,7 +56,27 @@ class ImageRecordIter(DataIter):
                                        mean=mean, std=std)
         import os
         idx_path = path_imgidx or os.path.splitext(path_imgrec)[0] + ".idx"
-        if os.path.isfile(idx_path):
+        have_idx = os.path.isfile(idx_path)
+
+        # Prefer the native C++ pipeline when built: same parameter surface,
+        # decode+augment under OMP with a bounded prefetch queue.
+        self._native = None
+        if os.environ.get("MXNET_TPU_NATIVE_IO", "1") != "0":
+            from ..io.native import load_native, NativeRecordIter
+            if load_native() is not None:
+                self._native = NativeRecordIter(
+                    path_imgrec, self.data_shape, batch_size,
+                    idx_path=idx_path if have_idx else None,
+                    label_width=label_width, threads=preprocess_threads,
+                    shuffle=shuffle, seed=seed, resize_short=resize,
+                    rand_crop=rand_crop, rand_mirror=rand_mirror,
+                    mean=None if mean is None else tuple(float(v) for v in mean),
+                    std=None if std is None else tuple(float(v) for v in std),
+                    prefetch=prefetch_buffer, part_index=part_index,
+                    num_parts=num_parts if have_idx else 1)
+                return
+
+        if have_idx:
             self._rec = recordio.MXIndexedRecordIO(idx_path, path_imgrec, "r")
             keys = list(self._rec.keys)
         else:
@@ -83,6 +107,9 @@ class ImageRecordIter(DataIter):
         return [DataDesc(self.label_name, shape)]
 
     def reset(self):
+        if self._native is not None:
+            self._native.reset()
+            return
         if self._keys is not None:
             self._order = list(self._keys)
             if self.shuffle:
@@ -110,6 +137,10 @@ class ImageRecordIter(DataIter):
         return img.asnumpy(), label
 
     def next(self):
+        if self._native is not None:
+            data, label, pad = self._native.next()   # raises StopIteration
+            out_label = label[:, 0] if self.label_width == 1 else label
+            return DataBatch([nd_array(data)], [nd_array(out_label)], pad=pad)
         c, h, w = self.data_shape
         bs = self.batch_size
         data = np.zeros((bs, h, w, c), np.float32)

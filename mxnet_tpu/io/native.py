@@ -42,7 +42,7 @@ def load_native():
         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.c_ulonglong, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
-        ctypes.c_int]
+        ctypes.c_int, ctypes.c_int, ctypes.c_int]
     lib.MXTRecordIterNext.restype = ctypes.c_int
     lib.MXTRecordIterNext.argtypes = [ctypes.c_void_p,
                                       ctypes.POINTER(ctypes.c_float),
@@ -59,11 +59,16 @@ class NativeRecordIter:
     def __init__(self, rec_path, data_shape, batch_size, idx_path=None,
                  label_width=1, threads=4, shuffle=False, seed=0,
                  resize_short=0, rand_crop=False, rand_mirror=False,
-                 mean=None, std=None, prefetch=4):
+                 mean=None, std=None, prefetch=4, part_index=0, num_parts=1):
         lib = load_native()
         if lib is None:
             raise RuntimeError(
                 "native IO library not built; run `make -C native`")
+        if num_parts > 1 and not (idx_path and os.path.isfile(idx_path)):
+            raise RuntimeError("num_parts > 1 requires an .idx file")
+        if not 0 <= part_index < max(num_parts, 1):
+            raise ValueError("part_index %d out of range for num_parts %d"
+                             % (part_index, num_parts))
         self._lib = lib
         c, h, w = data_shape
         self._shape = (batch_size, c, h, w)
@@ -73,7 +78,8 @@ class NativeRecordIter:
         self._handle = lib.MXTRecordIterCreate(
             rec_path.encode(), (idx_path or "").encode(), batch_size, c, h,
             w, label_width, threads, int(shuffle), seed, resize_short,
-            int(rand_crop), int(rand_mirror), mean_arr, std_arr, prefetch)
+            int(rand_crop), int(rand_mirror), mean_arr, std_arr, prefetch,
+            part_index, num_parts)
         if not self._handle:
             raise RuntimeError("failed to open %s" % rec_path)
         self._data_buf = np.empty(self._shape, np.float32)

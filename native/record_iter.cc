@@ -34,18 +34,41 @@ class RecordBatchIter {
   RecordBatchIter(const std::string& rec_path, const std::string& idx_path,
                   int batch_size, int c, int h, int w, int label_width,
                   int threads, bool shuffle, uint64_t seed,
-                  const AugmentParams& aug, int prefetch)
+                  const AugmentParams& aug, int prefetch, int part_index,
+                  int num_parts)
       : reader_(rec_path), batch_size_(batch_size), c_(c), h_(h), w_(w),
         label_width_(label_width), threads_(threads > 0 ? threads : 1),
         shuffle_(shuffle), rng_(seed), aug_(aug),
         prefetch_(prefetch > 0 ? prefetch : 2) {
     if (!idx_path.empty()) {
-      LoadIndex(idx_path, &keys_, &offsets_);
+      has_index_ = LoadIndex(idx_path, &keys_, &offsets_);
+      if (num_parts > 1 && has_index_) {
+        // same partition policy as the python path: contiguous equal
+        // slices of the index order, remainder dropped.  A slice can be
+        // EMPTY (num_parts > #records); that must mean "no data", never a
+        // fallback to sequentially reading the whole file.
+        if (part_index < 0 || part_index >= num_parts) {
+          valid_ = false;
+          keys_.clear();
+          offsets_.clear();
+        } else {
+          size_t n = offsets_.size() / (size_t)num_parts;
+          size_t lo = (size_t)part_index * n;
+          std::vector<uint64_t> part_keys(keys_.begin() + lo,
+                                          keys_.begin() + lo + n);
+          std::vector<uint64_t> part_offs(offsets_.begin() + lo,
+                                          offsets_.begin() + lo + n);
+          keys_.swap(part_keys);
+          offsets_.swap(part_offs);
+        }
+      }
     }
     Reset();
   }
 
   ~RecordBatchIter() { Stop(); }
+
+  bool ok() const { return reader_.ok() && valid_; }
 
   void Reset() {
     Stop();
@@ -91,7 +114,7 @@ class RecordBatchIter {
   }
 
   bool ReadRaw(std::vector<uint8_t>* out) {
-    if (!order_.empty()) {
+    if (has_index_) {
       if (cursor_ >= order_.size()) return false;
       reader_.Seek(offsets_[order_[cursor_++]]);
       return reader_.Next(out);
@@ -166,6 +189,8 @@ class RecordBatchIter {
   }
 
   RecordReader reader_;
+  bool has_index_ = false;
+  bool valid_ = true;
   std::vector<uint64_t> keys_, offsets_;
   std::vector<size_t> order_;
   size_t cursor_ = 0;
@@ -196,7 +221,8 @@ void* MXTRecordIterCreate(const char* rec_path, const char* idx_path,
                           int label_width, int threads, int shuffle,
                           unsigned long long seed, int resize_short,
                           int rand_crop, int rand_mirror, const float* mean,
-                          const float* stdv, int prefetch) {
+                          const float* stdv, int prefetch, int part_index,
+                          int num_parts) {
   mxt::AugmentParams aug;
   aug.out_h = h;
   aug.out_w = w;
@@ -207,9 +233,15 @@ void* MXTRecordIterCreate(const char* rec_path, const char* idx_path,
     if (mean) aug.mean[i] = mean[i];
     if (stdv) aug.std[i] = stdv[i];
   }
-  return new mxt::RecordBatchIter(rec_path, idx_path ? idx_path : "",
-                                  batch_size, c, h, w, label_width, threads,
-                                  shuffle != 0, seed, aug, prefetch);
+  auto* it = new mxt::RecordBatchIter(rec_path, idx_path ? idx_path : "",
+                                      batch_size, c, h, w, label_width,
+                                      threads, shuffle != 0, seed, aug,
+                                      prefetch, part_index, num_parts);
+  if (!it->ok()) {
+    delete it;
+    return nullptr;
+  }
+  return it;
 }
 
 int MXTRecordIterNext(void* handle, float* data_out, float* label_out) {
