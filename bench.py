@@ -79,21 +79,22 @@ def main():
               "softmax_label": (global_batch,)}
     params, mom, aux = trainer.init_state(shapes)
 
-    # data generated on device — the tunnel must not be in the loop
-    key = jax.random.PRNGKey(0)
-    data = jax.device_put(
-        jax.random.uniform(key, (global_batch, 3, 224, 224), jnp.float32),
-        spec.batch_sharding())
-    label = jax.device_put(
-        jax.random.randint(key, (global_batch,), 0, 1000).astype(jnp.float32),
-        spec.batch_sharding())
-    batch_dict = {"data": data, "softmax_label": label}
-
     from mxnet_tpu.parallel.trainer import sgd_step_fn
     step = sgd_step_fn(trainer)
     keys = trainer._keys()
 
     io_mode = os.environ.get("BENCH_IO", "0") == "1"
+    if not io_mode:
+        # data generated on device — the tunnel must not be in the loop
+        key = jax.random.PRNGKey(0)
+        data = jax.device_put(
+            jax.random.uniform(key, (global_batch, 3, 224, 224),
+                               jnp.float32), spec.batch_sharding())
+        label = jax.device_put(
+            jax.random.randint(key, (global_batch,), 0,
+                               1000).astype(jnp.float32),
+            spec.batch_sharding())
+        batch_dict = {"data": data, "softmax_label": label}
     if io_mode:
         # End-to-end RecordIO mode.  Tunnel characteristics (measured):
         # a device_put issued while compute is in flight drains the whole

@@ -18,7 +18,7 @@ __all__ = [
     "MXNetError", "NotSupportedForSparseNDArray", "_Null", "string_types",
     "numeric_types", "integer_types", "dtype_np", "dtype_name", "AttrScope",
     "attr_bool", "attr_int", "attr_float", "attr_str", "attr_shape",
-    "attr_dtype", "Param",
+    "attr_dtype", "attr_float_tuple", "Param",
 ]
 
 
@@ -150,6 +150,19 @@ def _parse_shape(v) -> Optional[Tuple[int, ...]]:
     if isinstance(v, (int, np.integer)):
         return (int(v),)
     return tuple(int(x) for x in v)
+
+
+def _parse_float_tuple(v) -> Tuple[float, ...]:
+    """Parse '(0.1, 0.2)' / [0.1, 0.2] / 0.1 → tuple of floats."""
+    if isinstance(v, str):
+        v = ast.literal_eval(v.strip())
+    if isinstance(v, (int, float, np.floating, np.integer)):
+        return (float(v),)
+    return tuple(float(x) for x in v)
+
+
+def attr_float_tuple(default=_Null, required=False):
+    return Param(_parse_float_tuple, default, required, "tuple of <float>")
 
 
 def _parse_dtype(v) -> Optional[str]:

@@ -89,7 +89,7 @@ class GraphProgram:
         for n in self.nodes:
             if n.is_var or not n.op.writeback:
                 continue
-            for i_in, i_out in n.op.writeback.items():
+            for i_in, i_out in n.op.writeback_map(n.parsed_attrs()).items():
                 if i_in < len(n.inputs):
                     src = n.inputs[i_in].node
                     if src.is_var and id(src) in aux_ids:

@@ -12,6 +12,7 @@ populate_module(_sys.modules[__name__])
 stack = _sys.modules[__name__].stack  # registered op wrapper
 
 from . import random   # noqa: E402,F401
+from . import contrib  # noqa: E402,F401
 from . import linalg   # noqa: E402,F401
 from . import sparse   # noqa: E402,F401
 from .sparse import (BaseSparseNDArray, CSRNDArray, RowSparseNDArray,  # noqa: E402

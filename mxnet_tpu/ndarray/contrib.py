@@ -1,0 +1,27 @@
+"""mx.nd.contrib namespace (reference python/mxnet/ndarray/contrib.py).
+
+Every registered ``_contrib_*`` operator is exposed here under its short
+name, so both reference spellings work:
+``mx.nd.contrib.MultiBoxPrior(...)`` and ``mx.nd._contrib_MultiBoxPrior``.
+"""
+import sys as _sys
+
+from ..ops.registry import get_op as _get_op, list_ops as _list_ops
+from .ndarray import _make_wrapper
+
+
+def _populate(mod, make_wrapper):
+    seen = {}
+    for _name in _list_ops():
+        if not _name.startswith("_contrib_"):
+            continue
+        short = _name[len("_contrib_"):]
+        op = _get_op(_name)
+        # CamelCase and snake_case aliases may share one op; either wins
+        if short not in seen or seen[short] is not op:
+            setattr(mod, short, make_wrapper(_name))
+            seen[short] = op
+
+
+_populate(_sys.modules[__name__],
+          lambda name: _make_wrapper(_get_op(name)))

@@ -428,7 +428,7 @@ def imperative_invoke(op: Operator, inputs: Sequence[NDArray],
         _ag._record_op(fn, in_arrays, in_nds, out_nds)
 
     # functional writeback of "mutated" inputs (BN aux, optimizer states)
-    for i_in, i_out in op.writeback.items():
+    for i_in, i_out in op.writeback_map(attrs).items():
         idx = i_in + (1 if op.needs_rng else 0)
         nd = in_nds[idx]
         if nd is not None:

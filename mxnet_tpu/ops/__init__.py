@@ -11,3 +11,4 @@ from . import linalg            # noqa: F401
 from . import optimizer_ops     # noqa: F401
 from . import rnn               # noqa: F401
 from . import contrib           # noqa: F401
+from . import spatial           # noqa: F401

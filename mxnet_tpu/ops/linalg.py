@@ -7,6 +7,7 @@ host-side decompositions where needed.
 from __future__ import annotations
 
 import jax
+import math
 import jax.numpy as jnp
 
 from ..base import attr_bool, attr_float, attr_int
@@ -115,7 +116,8 @@ def _gelqf(attrs, a):
 def _maketrian(attrs, a):
     """Pack vector of triangular entries into a matrix."""
     k = a.shape[-1]
-    n = int((jnp.sqrt(8 * k + 1) - 1) / 2)
+    # static arithmetic: jnp here would yield a tracer under jit
+    n = int((math.isqrt(8 * k + 1) - 1) // 2)
     idx = jnp.tril_indices(n) if attrs.lower else jnp.triu_indices(n)
     out = jnp.zeros(a.shape[:-1] + (n, n), dtype=a.dtype)
     return out.at[..., idx[0], idx[1]].set(a)

@@ -422,3 +422,52 @@ def _sequence_reverse(attrs, data, seq_len=None):
     src = jnp.where(steps < L, L - 1 - steps, steps)  # (T, B)
     src = src.reshape(src.shape + (1,) * (data.ndim - 2))
     return jnp.take_along_axis(data, jnp.broadcast_to(src, data.shape), axis=0)
+
+
+# ---------------------------------------------------------------------------
+# block rearrange + 0index ops — src/operator/tensor/matrix_op.cc,
+# src/operator/tensor/indexing_op.cc (choose/fill_element_0index)
+# ---------------------------------------------------------------------------
+
+@register("depth_to_space", inputs=("data",),
+          params=dict(block_size=attr_int(required=True)))
+def _depth_to_space(attrs, data):
+    """reference: matrix_op.cc depth_to_space (DCR layout, NCHW)."""
+    b = attrs.block_size
+    n, c, h, w = data.shape
+    if b <= 0 or c % (b * b) != 0:
+        raise MXNetError("depth_to_space: depth %d not divisible by %d^2"
+                         % (c, b))
+    x = data.reshape(n, b, b, c // (b * b), h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(n, c // (b * b), h * b, w * b)
+
+
+@register("space_to_depth", inputs=("data",),
+          params=dict(block_size=attr_int(required=True)))
+def _space_to_depth(attrs, data):
+    """reference: matrix_op.cc space_to_depth (inverse of depth_to_space)."""
+    b = attrs.block_size
+    n, c, h, w = data.shape
+    if b <= 0 or h % b != 0 or w % b != 0:
+        raise MXNetError("space_to_depth: spatial dims (%d, %d) not "
+                         "divisible by %d" % (h, w, b))
+    x = data.reshape(n, c, h // b, b, w // b, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+@register("choose_element_0index", inputs=("lhs", "rhs"))
+def _choose_element_0index(attrs, lhs, rhs):
+    """reference: src/operator/tensor/indexing_op.cc choose_element_0index —
+    out[i] = lhs[i, rhs[i]] (the classic softmax-pick)."""
+    idx = rhs.astype(jnp.int32).reshape(lhs.shape[0], 1)
+    return jnp.take_along_axis(lhs, idx, axis=1)[:, 0]
+
+
+@register("fill_element_0index", inputs=("lhs", "mhs", "rhs"))
+def _fill_element_0index(attrs, lhs, mhs, rhs):
+    """reference: indexing_op.cc fill_element_0index —
+    out = lhs with out[i, rhs[i]] = mhs[i]."""
+    rows = jnp.arange(lhs.shape[0])
+    return lhs.at[rows, rhs.astype(jnp.int32)].set(mhs)

@@ -152,3 +152,120 @@ def _signum_update(attrs, weight, grad, mom):
         g + attrs.wd * weight)
     new_w = (1 - attrs.lr * attrs.wd_lh) * weight + attrs.lr * jnp.sign(new_mom)
     return new_w, new_mom
+
+
+# ---------------------------------------------------------------------------
+# multi-tensor SGD — src/operator/optimizer_op.cc multi_sgd_update family:
+# one fused launch updating many parameters (variadic inputs, per-tensor
+# lrs/wds).  Writeback maps are attrs-dependent (num_weights).
+# ---------------------------------------------------------------------------
+
+def _multi_attrs():
+    from ..base import attr_float_tuple, attr_int
+    return dict(lrs=attr_float_tuple(required=True),
+                wds=attr_float_tuple(required=True),
+                rescale_grad=attr_float(1.0),
+                clip_gradient=attr_float(-1.0),
+                num_weights=attr_int(-1),   # -1: derive from num_args
+                num_args=attr_int(0),
+                momentum=attr_float(0.0))
+
+
+def _nw(attrs, stride):
+    """num_weights, derived from the positional arg count if not given."""
+    n = attrs.num_weights
+    if n is None or n < 0:
+        n = (attrs.num_args or stride) // stride
+    return n
+
+
+def _multi_prep(attrs, grad, weight, i):
+    g = grad * attrs.rescale_grad
+    if attrs.clip_gradient > 0:
+        g = jnp.clip(g, -attrs.clip_gradient, attrs.clip_gradient)
+    return g + attrs.wds[i] * weight
+
+
+def _multi_inputs(stride, names):
+    def inputs(attrs, num_args=None):
+        n = attrs.get("num_weights", -1) if attrs else -1
+        if n is None or n < 0:
+            n = (num_args if num_args else
+                 (attrs.get("num_args") if attrs else 0) or stride) // stride
+        return ["%s_%d" % (nm, i) for i in range(n) for nm in names]
+    return inputs
+
+
+@register("multi_sgd_update", inputs=_multi_inputs(2, ("weight", "grad")),
+          params=_multi_attrs(), variadic=True,
+          num_outputs=lambda a: _nw(a, 2),
+          writeback=lambda a: {2 * i: i for i in range(_nw(a, 2))})
+def _multi_sgd_update(attrs, *args):
+    out = []
+    for i in range(_nw(attrs, 2)):
+        w, g = args[2 * i], args[2 * i + 1]
+        out.append(w - attrs.lrs[i] * _multi_prep(attrs, g, w, i))
+    return tuple(out)
+
+
+@register("multi_sgd_mom_update",
+          inputs=_multi_inputs(3, ("weight", "grad", "mom")),
+          params=_multi_attrs(), variadic=True,
+          num_outputs=lambda a: 2 * _nw(a, 3),
+          num_visible_outputs=lambda a: _nw(a, 3),
+          writeback=lambda a: dict(
+              [(3 * i, i) for i in range(_nw(a, 3))] +
+              [(3 * i + 2, _nw(a, 3) + i) for i in range(_nw(a, 3))]))
+def _multi_sgd_mom_update(attrs, *args):
+    ws, ms = [], []
+    n = _nw(attrs, 3)
+    for i in range(n):
+        w, g, m = args[3 * i], args[3 * i + 1], args[3 * i + 2]
+        m2 = attrs.momentum * m - attrs.lrs[i] * _multi_prep(attrs, g, w, i)
+        ws.append(w + m2)
+        ms.append(m2)
+    return tuple(ws + ms)
+
+
+@register("multi_mp_sgd_update",
+          inputs=_multi_inputs(3, ("weight", "grad", "weight32")),
+          params=_multi_attrs(), variadic=True,
+          num_outputs=lambda a: 2 * _nw(a, 3),
+          num_visible_outputs=lambda a: _nw(a, 3),
+          writeback=lambda a: dict(
+              [(3 * i, i) for i in range(_nw(a, 3))] +
+              [(3 * i + 2, _nw(a, 3) + i) for i in range(_nw(a, 3))]))
+def _multi_mp_sgd_update(attrs, *args):
+    ws, w32s = [], []
+    for i in range(_nw(attrs, 3)):
+        w, g, w32 = args[3 * i], args[3 * i + 1], args[3 * i + 2]
+        new32 = w32 - attrs.lrs[i] * _multi_prep(
+            attrs, g.astype(jnp.float32), w32, i)
+        ws.append(new32.astype(w.dtype))
+        w32s.append(new32)
+    return tuple(ws + w32s)
+
+
+@register("multi_mp_sgd_mom_update",
+          inputs=_multi_inputs(4, ("weight", "grad", "mom", "weight32")),
+          params=_multi_attrs(), variadic=True,
+          num_outputs=lambda a: 3 * _nw(a, 4),
+          num_visible_outputs=lambda a: _nw(a, 4),
+          writeback=lambda a: dict(
+              [(4 * i, i) for i in range(_nw(a, 4))] +
+              [(4 * i + 2, _nw(a, 4) + i) for i in range(_nw(a, 4))] +
+              [(4 * i + 3, 2 * _nw(a, 4) + i)
+               for i in range(_nw(a, 4))]))
+def _multi_mp_sgd_mom_update(attrs, *args):
+    ws, ms, w32s = [], [], []
+    n = _nw(attrs, 4)
+    for i in range(n):
+        w, g, m, w32 = (args[4 * i], args[4 * i + 1], args[4 * i + 2],
+                        args[4 * i + 3])
+        m2 = attrs.momentum * m - attrs.lrs[i] * _multi_prep(
+            attrs, g.astype(jnp.float32), w32, i)
+        new32 = w32 + m2
+        ws.append(new32.astype(w.dtype))
+        ms.append(m2)
+        w32s.append(new32)
+    return tuple(ws + ms + w32s)

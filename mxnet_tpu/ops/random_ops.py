@@ -133,13 +133,15 @@ def _multinomial_nout(attrs):
           aliases=("sample_multinomial",))
 def _sample_multinomial(attrs, key, data):
     """data: (..., K) probabilities; samples `shape` draws per distribution."""
-    n = int(jnp.prod(jnp.array(attrs.shape))) if attrs.shape else 1
+    import numpy as _np
+    # static arithmetic: jnp on attr tuples yields tracers under jit
+    n = int(_np.prod(attrs.shape)) if attrs.shape else 1
     logits = jnp.log(jnp.maximum(data, 1e-37))
     batch = data.shape[:-1]
     draw_shape = batch + (tuple(attrs.shape) if attrs.shape else ())
     samples = jax.random.categorical(
         key, logits.reshape(-1, data.shape[-1])[:, None, :],
-        axis=-1, shape=(int(jnp.prod(jnp.array(batch or (1,)))), max(n, 1)))
+        axis=-1, shape=(int(_np.prod(batch or (1,))), max(n, 1)))
     out = samples.reshape(draw_shape if draw_shape else ()).astype(
         dtype_np(attrs.dtype) or jnp.int32)
     if attrs.get_prob:
@@ -148,3 +150,57 @@ def _sample_multinomial(attrs, key, data):
             samples.reshape(len(samples), -1), axis=1).reshape(draw_shape)
         return out, lp
     return out
+
+
+@register("_sample_exponential", inputs=("lam",), needs_rng=True,
+          params=dict(shape=attr_shape(()), dtype=attr_dtype("float32")),
+          aliases=("sample_exponential",))
+def _sample_exponential(attrs, key, lam):
+    shape = tuple(lam.shape) + tuple(attrs.shape or ())
+    bshape = lam.shape + (1,) * (len(shape) - lam.ndim)
+    e = jax.random.exponential(key, shape,
+                               dtype_np(attrs.dtype) or jnp.float32)
+    return e / lam.reshape(bshape)
+
+
+@register("_sample_poisson", inputs=("lam",), needs_rng=True,
+          params=dict(shape=attr_shape(()), dtype=attr_dtype("float32")),
+          aliases=("sample_poisson",))
+def _sample_poisson(attrs, key, lam):
+    shape = tuple(lam.shape) + tuple(attrs.shape or ())
+    bshape = lam.shape + (1,) * (len(shape) - lam.ndim)
+    out = jax.random.poisson(key, jnp.broadcast_to(lam.reshape(bshape),
+                                                   shape))
+    return out.astype(dtype_np(attrs.dtype) or jnp.float32)
+
+
+@register("_sample_negative_binomial", inputs=("k", "p"), needs_rng=True,
+          params=dict(shape=attr_shape(()), dtype=attr_dtype("float32")),
+          aliases=("sample_negative_binomial",))
+def _sample_neg_binomial(attrs, key, k, p):
+    shape = tuple(k.shape) + tuple(attrs.shape or ())
+    bshape = k.shape + (1,) * (len(shape) - k.ndim)
+    k1, k2 = jax.random.split(key)
+    kb = jnp.broadcast_to(k.reshape(bshape).astype(jnp.float32), shape)
+    pb = jnp.broadcast_to(p.reshape(bshape).astype(jnp.float32), shape)
+    lam = jax.random.gamma(k1, kb) * (1 - pb) / pb
+    out = jax.random.poisson(k2, lam)
+    return out.astype(dtype_np(attrs.dtype) or jnp.float32)
+
+
+@register("_sample_generalized_negative_binomial", inputs=("mu", "alpha"),
+          needs_rng=True,
+          params=dict(shape=attr_shape(()), dtype=attr_dtype("float32")),
+          aliases=("sample_generalized_negative_binomial",))
+def _sample_gen_neg_binomial(attrs, key, mu, alpha):
+    shape = tuple(mu.shape) + tuple(attrs.shape or ())
+    bshape = mu.shape + (1,) * (len(shape) - mu.ndim)
+    k1, k2 = jax.random.split(key)
+    mub = jnp.broadcast_to(mu.reshape(bshape).astype(jnp.float32), shape)
+    ab = jnp.broadcast_to(alpha.reshape(bshape).astype(jnp.float32), shape)
+    r = 1.0 / jnp.maximum(ab, 1e-12)
+    lam = jax.random.gamma(k1, r) * mub * ab
+    # alpha → 0 degenerates to plain poisson(mu)
+    lam = jnp.where(ab <= 1e-12, mub, lam)
+    out = jax.random.poisson(k2, lam)
+    return out.astype(dtype_np(attrs.dtype) or jnp.float32)

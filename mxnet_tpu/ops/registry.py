@@ -87,7 +87,9 @@ class Operator:
         # (FMutateInputs, op_attr_types.h): {input_index: output_index} — the
         # runtime writes output j back into the NDArray passed as input i.
         # Used by BatchNorm moving stats and the fused optimizer update ops.
-        self.writeback: Dict[int, int] = dict(writeback or {})
+        # May be a callable(attrs) -> dict for variadic ops (multi_sgd_*).
+        self.writeback = writeback if callable(writeback) \
+            else dict(writeback or {})
         # Input positions that are auxiliary states (reference
         # ListAuxiliaryStates): not arguments, not differentiated, updated
         # via writeback.  E.g. BatchNorm's moving_mean/moving_var.
@@ -127,6 +129,10 @@ class Operator:
         if callable(self._num_outputs):
             return self._num_outputs(attrs)
         return self._num_outputs
+
+    def writeback_map(self, attrs: Optional[AttrDict] = None) -> Dict[int, int]:
+        wb = self.writeback
+        return dict(wb(attrs)) if callable(wb) else dict(wb)
 
     def aux_input_indices(self, attrs: Optional[AttrDict] = None):
         """Aux-state input positions; attrs-dependent for open-schema ops

@@ -1,0 +1,200 @@
+"""Spatial sampling / warping / correlation ops.
+
+Reference: src/operator/spatial_transformer-inl.h, bilinear_sampler-inl.h,
+grid_generator-inl.h, correlation-inl.h, crop-inl.h.
+
+TPU-native design: each op is one pure jnp function — the bilinear gather
+vectorises over the batch with vmap and differentiates through jax.vjp
+(the reference hand-writes CUDA backward kernels for data AND grid; here
+both gradients fall out of autodiff over the same sampling expression).
+Correlation's displacement loop is a static Python loop producing one
+fused XLA program (displacement count is an attr, known at trace time).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError, attr_bool, attr_int, attr_shape, attr_str
+from .registry import register
+
+
+# ---------------------------------------------------------------------------
+# grid generation + bilinear sampling
+# ---------------------------------------------------------------------------
+
+def _affine_grid(theta, th, tw):
+    """theta (n, 6) → sampling grid (n, 2, th, tw), coords in [-1, 1]."""
+    n = theta.shape[0]
+    theta = theta.reshape(n, 2, 3)
+    xt = jnp.linspace(-1.0, 1.0, tw)
+    yt = jnp.linspace(-1.0, 1.0, th)
+    yy, xx = jnp.meshgrid(yt, xt, indexing="ij")
+    ones = jnp.ones_like(xx)
+    base = jnp.stack([xx, yy, ones], axis=0).reshape(3, th * tw)
+    grid = jnp.einsum("nij,jk->nik", theta.astype(jnp.float32),
+                      base.astype(jnp.float32))
+    return grid.reshape(n, 2, th, tw)
+
+
+def _warp_grid(flow):
+    """flow (n, 2, h, w) pixel offsets → normalized grid (n, 2, h, w)."""
+    n, _, h, w = flow.shape
+    xs = jnp.arange(w, dtype=jnp.float32)
+    ys = jnp.arange(h, dtype=jnp.float32)
+    yy, xx = jnp.meshgrid(ys, xs, indexing="ij")
+    gx = (xx + flow[:, 0]) * (2.0 / max(w - 1, 1)) - 1.0
+    gy = (yy + flow[:, 1]) * (2.0 / max(h - 1, 1)) - 1.0
+    return jnp.stack([gx, gy], axis=1)
+
+
+def _bilinear_sample_one(data, gx, gy):
+    """data (c, h, w); gx/gy (th, tw) in source-pixel coords.  Zero padding
+    outside the image (reference BilinearSampler border behaviour)."""
+    c, h, w = data.shape
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = gx - x0
+    wy = gy - y0
+
+    def tap(yi, xi):
+        valid = (xi >= 0) & (xi <= w - 1) & (yi >= 0) & (yi <= h - 1)
+        xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        v = data[:, yc, xc]                      # (c, th, tw)
+        return jnp.where(valid[None], v, 0.0)
+
+    out = (tap(y0, x0) * ((1 - wy) * (1 - wx))[None]
+           + tap(y0, x0 + 1) * ((1 - wy) * wx)[None]
+           + tap(y0 + 1, x0) * (wy * (1 - wx))[None]
+           + tap(y0 + 1, x0 + 1) * (wy * wx)[None])
+    return out
+
+
+def _bilinear_sample(data, grid):
+    """data (n, c, h, w), grid (n, 2, th, tw) normalized → (n, c, th, tw)."""
+    _, _, h, w = data.shape
+    f32 = data.astype(jnp.float32)
+    gx = (grid[:, 0].astype(jnp.float32) + 1.0) * (w - 1) / 2.0
+    gy = (grid[:, 1].astype(jnp.float32) + 1.0) * (h - 1) / 2.0
+    out = jax.vmap(_bilinear_sample_one)(f32, gx, gy)
+    return out.astype(data.dtype)
+
+
+@register("GridGenerator", inputs=("data",),
+          params=dict(transform_type=attr_str(required=True),
+                      target_shape=attr_shape((0, 0))))
+def _grid_generator(attrs, data):
+    """reference: src/operator/grid_generator-inl.h"""
+    if attrs.transform_type == "affine":
+        th, tw = attrs.target_shape
+        if th <= 0 or tw <= 0:
+            raise MXNetError("GridGenerator(affine) needs target_shape")
+        return _affine_grid(data, th, tw)
+    if attrs.transform_type == "warp":
+        return _warp_grid(data)
+    raise MXNetError("unknown transform_type %r" % (attrs.transform_type,))
+
+
+@register("BilinearSampler", inputs=("data", "grid"))
+def _bilinear_sampler(attrs, data, grid):
+    """reference: src/operator/bilinear_sampler-inl.h"""
+    return _bilinear_sample(data, grid)
+
+
+@register("SpatialTransformer", inputs=("data", "loc"),
+          params=dict(target_shape=attr_shape(required=True),
+                      transform_type=attr_str("affine"),
+                      sampler_type=attr_str("bilinear")))
+def _spatial_transformer(attrs, data, loc):
+    """reference: src/operator/spatial_transformer-inl.h — affine grid from
+    the localisation net output + bilinear sampling, in one program."""
+    if attrs.transform_type != "affine" or attrs.sampler_type != "bilinear":
+        raise MXNetError("SpatialTransformer supports affine/bilinear")
+    th, tw = attrs.target_shape
+    grid = _affine_grid(loc, th, tw)
+    return _bilinear_sample(data, grid)
+
+
+# ---------------------------------------------------------------------------
+# Correlation (FlowNet-style cost volume)
+# ---------------------------------------------------------------------------
+
+@register("Correlation", inputs=("data1", "data2"),
+          params=dict(kernel_size=attr_int(1), max_displacement=attr_int(1),
+                      stride1=attr_int(1), stride2=attr_int(1),
+                      pad_size=attr_int(0), is_multiply=attr_bool(True)))
+def _correlation(attrs, data1, data2):
+    """reference: src/operator/correlation-inl.h — patch correlation of two
+    feature maps over a displacement neighbourhood."""
+    k = attrs.kernel_size
+    md = attrs.max_displacement
+    s1, s2 = attrs.stride1, attrs.stride2
+    p = attrs.pad_size
+    kr = (k - 1) // 2
+    border = md + kr
+    n, c, h, w = data1.shape
+    f1 = jnp.pad(data1.astype(jnp.float32),
+                 ((0, 0), (0, 0), (p, p), (p, p)))
+    f2 = jnp.pad(data2.astype(jnp.float32),
+                 ((0, 0), (0, 0), (p, p), (p, p)))
+    hp, wp = h + 2 * p, w + 2 * p
+    out_h = (hp - 2 * border - 1) // s1 + 1
+    out_w = (wp - 2 * border - 1) // s1 + 1
+    if out_h <= 0 or out_w <= 0:
+        raise MXNetError("Correlation: output would be empty")
+    ngr = md // s2
+    gw = 2 * ngr + 1
+
+    planes = []
+    for dy in range(-ngr, ngr + 1):
+        for dx in range(-ngr, ngr + 1):
+            sy, sx = dy * s2, dx * s2
+            shifted = jnp.roll(f2, (-sy, -sx), axis=(2, 3))
+            if attrs.is_multiply:
+                prod = (f1 * shifted).sum(axis=1)          # (n, hp, wp)
+            else:
+                prod = -jnp.abs(f1 - shifted).sum(axis=1)
+            # window sum over the k x k kernel (valid), then subsample the
+            # strided output grid starting at the displacement border
+            if k > 1:
+                win = jax.lax.reduce_window(
+                    prod, 0.0, jax.lax.add, (1, k, k), (1, 1, 1), "valid")
+            else:
+                win = prod
+            sub = win[:, md:md + out_h * s1:s1, md:md + out_w * s1:s1]
+            planes.append(sub / (k * k * c))
+    out = jnp.stack(planes, axis=1)      # (n, gw*gw, out_h, out_w)
+    del gw
+    return out.astype(data1.dtype)
+
+
+# ---------------------------------------------------------------------------
+# legacy Crop
+# ---------------------------------------------------------------------------
+
+def _crop_inputs(attrs, num_args=None):
+    n = (attrs.get("num_args") if attrs else None) or num_args or 1
+    return ["data"] if n == 1 else ["data", "crop_like"]
+
+
+@register("Crop", inputs=_crop_inputs,
+          params=dict(num_args=attr_int(1), offset=attr_shape((0, 0)),
+                      h_w=attr_shape((0, 0)), center_crop=attr_bool(False)))
+def _crop(attrs, data, *rest):
+    """reference: src/operator/crop-inl.h — crop data to h_w (or to the
+    spatial size of crop_like when num_args=2)."""
+    _, _, h, w = data.shape
+    if rest:
+        th, tw = rest[0].shape[2], rest[0].shape[3]
+    else:
+        th, tw = attrs.h_w
+    if th <= 0 or tw <= 0 or th > h or tw > w:
+        raise MXNetError("Crop: invalid target size (%d, %d)" % (th, tw))
+    if attrs.center_crop:
+        y0, x0 = (h - th) // 2, (w - tw) // 2
+    else:
+        y0, x0 = attrs.offset
+    if y0 + th > h or x0 + tw > w:
+        raise MXNetError("Crop: offset out of range")
+    return data[:, :, y0:y0 + th, x0:x0 + tw]

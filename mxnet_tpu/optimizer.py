@@ -210,6 +210,74 @@ class SGD(Optimizer):
 
 
 @register
+class LBSGD(Optimizer):
+    """Large-Batch SGD: warmup schedules + LARS layer-wise scaling
+    (reference optimizer.py:650).
+
+    The learning rate is scaled toward ``batch_scale`` over
+    ``warmup_epochs`` (strategies: linear / power2 / sqrt / lars); with
+    'lars' each layer additionally gets the trust ratio
+    ``||w|| / (||g|| + wd ||w|| + eps)``.
+    """
+
+    def __init__(self, momentum=0.0, multi_precision=False,
+                 warmup_strategy="linear", warmup_epochs=5, batch_scale=1,
+                 updates_per_epoch=32, begin_epoch=0, num_epochs=60,
+                 **kwargs):
+        super().__init__(multi_precision=multi_precision, **kwargs)
+        self.momentum = momentum
+        self.warmup_strategy = warmup_strategy
+        self.warmup_epochs = warmup_epochs
+        self.batch_scale = batch_scale
+        self.updates_per_epoch = updates_per_epoch
+        self.init_updates = begin_epoch * updates_per_epoch
+        self.num_epochs = num_epochs
+        self.lbmult = 1.0
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, dtype=weight.dtype, ctx=weight.context)
+
+    def _warmup_mult(self, nup):
+        nwup = self.warmup_epochs * self.updates_per_epoch
+        maxmult = float(self.batch_scale)
+        if nwup <= 0 or maxmult < 1 or nup >= nwup:
+            return maxmult if maxmult >= 1 else 1.0
+        frac = nup / nwup
+        if self.warmup_strategy == "power2":
+            frac = frac * frac
+        elif self.warmup_strategy == "sqrt":
+            frac = math.sqrt(frac)
+        return 1.0 + (maxmult - 1.0) * frac
+
+    def _lars_mult(self, weight, grad, wd):
+        # norms reduce on device; only two scalars cross to the host
+        wnorm = float(invoke_with_arrays("norm", [weight], {}).asnumpy())
+        gnorm = float(invoke_with_arrays("norm", [grad], {}).asnumpy()) \
+            * self.rescale_grad
+        if wnorm > 0.0 and gnorm > 0.0:
+            return wnorm / (gnorm + wd * wnorm + 1e-9)
+        return 1.0
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        nup = self.num_update + self.init_updates
+        if self.warmup_strategy == "lars":
+            mult = self._lars_mult(weight, grad, kw["wd"])
+        else:
+            mult = self._warmup_mult(nup)
+        self.lbmult = mult
+        kw["lr"] = kw["lr"] * mult
+        if state is not None:
+            invoke_with_arrays("sgd_mom_update", [weight, grad, state],
+                               dict(momentum=self.momentum, **kw))
+        else:
+            invoke_with_arrays("sgd_update", [weight, grad], kw)
+
+
+@register
 class Signum(Optimizer):
     """reference optimizer.py:540 — sign-SGD with momentum."""
 

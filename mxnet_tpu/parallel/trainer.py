@@ -46,7 +46,8 @@ class ShardedTrainer:
 
     def __init__(self, symbol, spec: MeshSpec, data_names=("data",),
                  label_names=("softmax_label",), lr=0.01, momentum=0.9,
-                 wd=0.0001, loss_scale=1.0, param_dtype=None):
+                 wd=0.0001, loss_scale=1.0, param_dtype=None,
+                 shard_optimizer_state=False):
         self.symbol = symbol
         self.spec = spec
         self.prog = GraphProgram(symbol)
@@ -64,6 +65,101 @@ class ShardedTrainer:
         self.wd = wd
         self.param_dtype = param_dtype
         self._step = None
+        # tensor parallelism: the tp mesh axis (auto-detected) + per-var
+        # __shard__ annotations from the Symbol graph
+        tp = spec.tp_axis
+        if tp is None and "tp" in spec.mesh.axis_names:
+            tp = "tp"
+        self.tp_axis = tp if (tp and spec.mesh.shape.get(tp, 1) > 1) else None
+        self._shard_attrs = {}
+        for node in self.prog.nodes:
+            if node.is_var and "__shard__" in node.attrs:
+                self._shard_attrs[node.name] = str(node.attrs["__shard__"])
+        self._param_shapes = None   # filled by init_state; step shardings
+        # ZeRO-style sharded optimizer state (the BIGARRAY/server-side-
+        # optimizer analog, kvstore_dist.h:156 + kvstore_dist_server.h:187,
+        # SURVEY §5.8): momentum shards over 'dp'; under GSPMD the weight
+        # update becomes reduce-scatter grad slice → update owned shard →
+        # all-gather new weights (cf. "Automatic Cross-Replica Sharding of
+        # Weight Update in Data-Parallel Training").
+        self.shard_optimizer_state = bool(shard_optimizer_state)
+
+    # -- tensor-parallel sharding rules -----------------------------------
+    def param_sharding(self, name: str, shape) -> NamedSharding:
+        """PartitionSpec for one parameter.
+
+        Explicit ``__shard__`` Symbol attr wins (value: comma list of mesh
+        axis names / '*' per tensor dim, e.g. ``"tp,*"`` shards dim 0 over
+        tp — the ctx_group-style per-layer annotation pattern).  Otherwise,
+        when a tp axis is active, the default recipe (SURVEY §2.3: tensor
+        parallelism via GSPMD sharding annotations) shards the output
+        channels of FC/Convolution weights and the vocab dim of embeddings;
+        XLA propagates activation shardings and inserts the collectives.
+        """
+        mesh = self.spec.mesh
+        if self.tp_axis is None:
+            return self.spec.replicated()
+        tp = self.tp_axis
+        size = mesh.shape[tp]
+        ann = self._shard_attrs.get(name)
+        if ann is not None:
+            dims = [None if d.strip() in ("*", "None", "") else d.strip()
+                    for d in ann.split(",")]
+            if len(dims) > len(shape):
+                raise ValueError(
+                    "__shard__=%r on %s names %d dims but the tensor has "
+                    "%d" % (ann, name, len(dims), len(shape)))
+            unknown = [d for d in dims
+                       if d is not None and d not in mesh.axis_names]
+            if unknown:
+                raise ValueError(
+                    "__shard__=%r on %s names mesh axes %s not in mesh %s"
+                    % (ann, name, unknown, tuple(mesh.axis_names)))
+            dims += [None] * (len(shape) - len(dims))
+            dims = [d if (d is not None and shape[i] % mesh.shape[d] == 0)
+                    else None for i, d in enumerate(dims)]
+            return NamedSharding(mesh, P(*dims))
+        if name.endswith("_weight") and len(shape) in (2, 4) \
+                and shape[0] % size == 0 and shape[0] >= size:
+            # FC (out, in) / Conv (out, in, kh, kw) / Embedding (vocab, dim):
+            # shard dim 0 (output channels / vocab rows) over tp
+            return NamedSharding(mesh, P(*([tp] + [None] * (len(shape) - 1))))
+        return self.spec.replicated()
+
+    def mom_sharding(self, name: str, shape) -> NamedSharding:
+        """Sharding for one optimizer-state tensor: the param's sharding,
+        plus — with shard_optimizer_state — the first free divisible dim
+        sharded over 'dp' so per-chip state memory scales down with the
+        data-parallel degree."""
+        base = self.param_sharding(name, shape)
+        if not self.shard_optimizer_state:
+            return base
+        mesh = self.spec.mesh
+        dp = self.spec.dp_axis
+        size = mesh.shape.get(dp, 1)
+        if size <= 1:
+            return base
+        dims = list(base.spec) + [None] * (len(shape) - len(base.spec))
+        for i, d in enumerate(shape):
+            if dims[i] is None and d % size == 0 and d >= size:
+                dims[i] = dp
+                break
+        return NamedSharding(mesh, P(*dims))
+
+    def _param_shardings(self):
+        if self._param_shapes is None:
+            from ..executor import _resolve_structs
+            _, known, _ = _resolve_structs(
+                self.symbol, getattr(self, "_last_shapes", {}) or {})
+            self._param_shapes = {n: tuple(known[n].shape)
+                                  for n in self.param_names if n in known}
+        return tuple(self.param_sharding(n, self._param_shapes.get(n, ()))
+                     for n in self.param_names)
+
+    def _mom_shardings(self):
+        self._param_shardings()   # ensure shapes resolved
+        return tuple(self.mom_sharding(n, self._param_shapes.get(n, ()))
+                     for n in self.param_names)
 
     # -- state ------------------------------------------------------------
     def init_state(self, shapes: Dict[str, tuple], initializer=None,
@@ -74,6 +170,9 @@ class ShardedTrainer:
         from ..ndarray.ndarray import NDArray
         import numpy as _np
         prog, known, _ = _resolve_structs(self.symbol, shapes)
+        self._last_shapes = dict(shapes)
+        self._param_shapes = {n: tuple(known[n].shape)
+                              for n in self.param_names if n in known}
         initializer = initializer or Xavier(rnd_type="gaussian",
                                             factor_type="in", magnitude=2)
         rep = self.spec.replicated()
@@ -97,9 +196,11 @@ class ShardedTrainer:
                 dt = dtype_np(self.param_dtype)
             else:
                 dt = s.dtype
-            params.append(jax.device_put(host.astype(dt), rep))
+            params.append(jax.device_put(
+                host.astype(dt), self.param_sharding(n, s.shape)))
         _rng_mod._get().key, _rng_mod._get().counter = saved
-        mom = tuple(jax.device_put(np.zeros(known[n].shape, np.float32), rep)
+        mom = tuple(jax.device_put(np.zeros(known[n].shape, np.float32),
+                                   self.mom_sharding(n, known[n].shape))
                     for n in self.param_names)
         aux = tuple(jax.device_put(
             (np.zeros if "mean" in n else np.ones)(known[n].shape, np.float32),
@@ -134,16 +235,18 @@ class ShardedTrainer:
 
         rep = self.spec.replicated()
         bat = self.spec.batch_sharding()
+        pshard = self._param_shardings()
+        mshard = self._mom_shardings()
         in_shardings = (
-            tuple(rep for _ in self.param_names),   # params
-            tuple(rep for _ in self.param_names),   # mom
+            pshard,                                 # params (tp-aware)
+            mshard,                                 # mom (ZeRO: +dp-sharded)
             tuple(rep for _ in self.prog.aux_names),  # aux
             {n: bat for n in self.input_names},     # batch
             rep,                                    # keys
         )
         out_shardings = (
-            tuple(rep for _ in self.param_names),
-            tuple(rep for _ in self.param_names),
+            pshard,
+            mshard,
             tuple(rep for _ in self.prog.aux_names),
             rep,
         )

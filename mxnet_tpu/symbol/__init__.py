@@ -28,4 +28,5 @@ for _name in _list_ops():
     setattr(_sys.modules[__name__], _name, _make_sym_wrapper(_name))
 
 from . import random  # noqa: E402,F401
+from . import contrib  # noqa: E402,F401
 from . import linalg  # noqa: E402,F401

@@ -1,0 +1,8 @@
+"""mx.sym.contrib namespace (reference python/mxnet/symbol/contrib.py):
+every ``_contrib_*`` op as a symbolic constructor under its short name."""
+import sys as _sys
+
+from ..ndarray.contrib import _populate
+from . import _make_sym_wrapper
+
+_populate(_sys.modules[__name__], _make_sym_wrapper)
