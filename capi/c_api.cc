@@ -859,17 +859,14 @@ static void FillShapeTriple(PyObject *lst, int slot, mx_uint *size,
   *data_out = pp.data();
 }
 
-int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args, const char **keys,
-                       const mx_uint *arg_ind_ptr,
-                       const mx_uint *arg_shape_data, mx_uint *in_shape_size,
-                       const mx_uint **in_shape_ndim,
-                       const mx_uint ***in_shape_data,
-                       mx_uint *out_shape_size,
-                       const mx_uint **out_shape_ndim,
-                       const mx_uint ***out_shape_data,
-                       mx_uint *aux_shape_size,
-                       const mx_uint **aux_shape_ndim,
-                       const mx_uint ***aux_shape_data, int *complete) {
+static int MXSymbolInferShapeImpl(
+    SymbolHandle sym, mx_uint num_args, const char **keys,
+    const mx_uint *arg_ind_ptr, const mx_uint *arg_shape_data,
+    mx_uint *in_shape_size, const mx_uint **in_shape_ndim,
+    const mx_uint ***in_shape_data, mx_uint *out_shape_size,
+    const mx_uint **out_shape_ndim, const mx_uint ***out_shape_data,
+    mx_uint *aux_shape_size, const mx_uint **aux_shape_ndim,
+    const mx_uint ***aux_shape_data, int *complete, int partial) {
   API_BEGIN();
   PyObject *shapes = PyList_New(num_args);
   for (mx_uint i = 0; i < num_args; ++i) {
@@ -881,7 +878,8 @@ int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args, const char **keys,
   }
   PyObject *r = Call("symbol_infer_shape",
                      Py_BuildValue("(KNNi)", (unsigned long long)H(sym),
-                                   StrList(keys, num_args), shapes, 0));
+                                   StrList(keys, num_args), shapes,
+                                   partial));
   CHECK_PY(r);
   FillShapeTriple(PyTuple_GetItem(r, 0), 0, in_shape_size, in_shape_ndim,
                   in_shape_data);
@@ -892,6 +890,24 @@ int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args, const char **keys,
   *complete = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 3)));
   Py_DECREF(r);
   API_END();
+}
+
+int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args, const char **keys,
+                       const mx_uint *arg_ind_ptr,
+                       const mx_uint *arg_shape_data, mx_uint *in_shape_size,
+                       const mx_uint **in_shape_ndim,
+                       const mx_uint ***in_shape_data,
+                       mx_uint *out_shape_size,
+                       const mx_uint **out_shape_ndim,
+                       const mx_uint ***out_shape_data,
+                       mx_uint *aux_shape_size,
+                       const mx_uint **aux_shape_ndim,
+                       const mx_uint ***aux_shape_data, int *complete) {
+  return MXSymbolInferShapeImpl(
+      sym, num_args, keys, arg_ind_ptr, arg_shape_data, in_shape_size,
+      in_shape_ndim, in_shape_data, out_shape_size, out_shape_ndim,
+      out_shape_data, aux_shape_size, aux_shape_ndim, aux_shape_data,
+      complete, 0);
 }
 
 int MXSymbolInferType(SymbolHandle sym, mx_uint num_args, const char **keys,
@@ -1403,4 +1419,1195 @@ int MXRecordIOReaderReadRecord(RecordIOHandle handle, char const **buf,
   }
   Py_DECREF(r);
   API_END();
+}
+
+/* ====================================================================== */
+/* round 3: sparse/grad NDArray, autograd, CachedOp, Function API,        */
+/* executor/kvstore extensions, predict API (c_predict_api.h)             */
+/* ====================================================================== */
+
+#include "mxnet_tpu_c_predict_api.h"
+
+namespace {
+PyObject *UIntList(const mx_uint *arr, mx_uint n) {
+  PyObject *l = PyList_New(n);
+  for (mx_uint i = 0; i < n; ++i)
+    PyList_SetItem(l, i, PyLong_FromUnsignedLong(arr ? arr[i] : 0));
+  return l;
+}
+}  // namespace
+
+/* ---- NDArray sparse / grad / raw ---- */
+
+int MXNDArrayCreateSparseEx(int storage_type, const mx_uint *shape,
+                            mx_uint ndim, int dev_type, int dev_id,
+                            int delay_alloc, int dtype, mx_uint num_aux,
+                            int *aux_type, mx_uint *aux_ndims,
+                            const mx_uint *aux_shape, NDArrayHandle *out) {
+  (void)delay_alloc; (void)num_aux; (void)aux_type; (void)aux_ndims;
+  (void)aux_shape;
+  API_BEGIN();
+  PyObject *r = Call("ndarray_create_sparse",
+                     Py_BuildValue("(iNiii)", storage_type,
+                                   UIntList(shape, ndim), dev_type, dev_id,
+                                   dtype));
+  CHECK_PY(r);
+  *out = HP(PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDArrayGetDataNDArray(NDArrayHandle handle, NDArrayHandle *out) {
+  API_BEGIN();
+  PyObject *r = Call("ndarray_get_data_ndarray",
+                     Py_BuildValue("(K)", (unsigned long long)H(handle)));
+  CHECK_PY(r);
+  *out = HP(PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDArrayGetAuxNDArray(NDArrayHandle handle, mx_uint i,
+                           NDArrayHandle *out) {
+  API_BEGIN();
+  PyObject *r = Call("ndarray_get_aux_ndarray",
+                     Py_BuildValue("(KI)", (unsigned long long)H(handle), i));
+  CHECK_PY(r);
+  *out = HP(PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDArrayGetAuxType(NDArrayHandle handle, mx_uint i, int *out_type) {
+  API_BEGIN();
+  PyObject *r = Call("ndarray_get_aux_type",
+                     Py_BuildValue("(KI)", (unsigned long long)H(handle), i));
+  CHECK_PY(r);
+  *out_type = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDArrayGetData(NDArrayHandle handle, void **out_pdata) {
+  API_BEGIN();
+  PyObject *r = Call("ndarray_get_data",
+                     Py_BuildValue("(K)", (unsigned long long)H(handle)));
+  CHECK_PY(r);
+  *out_pdata = reinterpret_cast<void *>(PyLong_AsUnsignedLongLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDArraySyncCheckFormat(NDArrayHandle handle, const bool full_check) {
+  API_BEGIN();
+  PyObject *r = Call("ndarray_sync_check_format",
+                     Py_BuildValue("(Ki)", (unsigned long long)H(handle),
+                                   full_check ? 1 : 0));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDArraySyncCopyFromNDArray(NDArrayHandle handle_dst,
+                                 const NDArrayHandle handle_src,
+                                 const int i) {
+  API_BEGIN();
+  PyObject *r = Call("ndarray_sync_copy_from_ndarray",
+                     Py_BuildValue("(KKi)",
+                                   (unsigned long long)H(handle_dst),
+                                   (unsigned long long)H(handle_src), i));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDArrayDetach(NDArrayHandle handle, NDArrayHandle *out) {
+  API_BEGIN();
+  PyObject *r = Call("ndarray_detach",
+                     Py_BuildValue("(K)", (unsigned long long)H(handle)));
+  CHECK_PY(r);
+  *out = HP(PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out) {
+  API_BEGIN();
+  PyObject *r = Call("ndarray_get_grad",
+                     Py_BuildValue("(K)", (unsigned long long)H(handle)));
+  CHECK_PY(r);
+  *out = HP(PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDArraySetGradState(NDArrayHandle handle, int state) {
+  API_BEGIN();
+  PyObject *r = Call("ndarray_set_grad_state",
+                     Py_BuildValue("(Ki)", (unsigned long long)H(handle),
+                                   state));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDArrayGetGradState(NDArrayHandle handle, int *out) {
+  API_BEGIN();
+  PyObject *r = Call("ndarray_get_grad_state",
+                     Py_BuildValue("(K)", (unsigned long long)H(handle)));
+  CHECK_PY(r);
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDArraySaveRawBytes(NDArrayHandle handle, size_t *out_size,
+                          const char **out_buf) {
+  API_BEGIN();
+  PyObject *r = Call("ndarray_save_raw_bytes",
+                     Py_BuildValue("(K)", (unsigned long long)H(handle)));
+  CHECK_PY(r);
+  char *data;
+  Py_ssize_t n;
+  PyBytes_AsStringAndSize(r, &data, &n);
+  tls.bytes.assign(data, data + n);
+  *out_buf = tls.bytes.data();
+  *out_size = static_cast<size_t>(n);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDArrayLoadFromRawBytes(const void *buf, size_t size,
+                              NDArrayHandle *out) {
+  API_BEGIN();
+  PyObject *r = Call("ndarray_load_from_raw_bytes",
+                     Py_BuildValue("(N)", PyBytes_FromStringAndSize(
+                         static_cast<const char *>(buf),
+                         (Py_ssize_t)size)));
+  CHECK_PY(r);
+  *out = HP(PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDArrayGetSharedMemHandle(NDArrayHandle handle, int *shared_pid,
+                                int *shared_id) {
+  API_BEGIN();
+  PyObject *r = Call("ndarray_get_shared_mem_handle",
+                     Py_BuildValue("(K)", (unsigned long long)H(handle)));
+  CHECK_PY(r);
+  *shared_pid = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 0)));
+  *shared_id = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 1)));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDArrayCreateFromSharedMem(int shared_pid, int shared_id,
+                                 const mx_uint *shape, mx_uint ndim,
+                                 int dtype, NDArrayHandle *out) {
+  API_BEGIN();
+  PyObject *r = Call("ndarray_create_from_shared_mem",
+                     Py_BuildValue("(iiNi)", shared_pid, shared_id,
+                                   UIntList(shape, ndim), dtype));
+  CHECK_PY(r);
+  *out = HP(PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+/* ---- autograd ---- */
+
+int MXAutogradSetIsRecording(int is_recording, int *prev) {
+  API_BEGIN();
+  PyObject *r = Call("autograd_set_recording",
+                     Py_BuildValue("(i)", is_recording));
+  CHECK_PY(r);
+  if (prev) *prev = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXAutogradSetIsTraining(int is_training, int *prev) {
+  API_BEGIN();
+  PyObject *r = Call("autograd_set_training",
+                     Py_BuildValue("(i)", is_training));
+  CHECK_PY(r);
+  if (prev) *prev = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXAutogradIsRecording(bool *curr) {
+  API_BEGIN();
+  PyObject *r = Call("autograd_is_recording", PyTuple_New(0));
+  CHECK_PY(r);
+  *curr = PyLong_AsLong(r) != 0;
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXAutogradIsTraining(bool *curr) {
+  API_BEGIN();
+  PyObject *r = Call("autograd_is_training", PyTuple_New(0));
+  CHECK_PY(r);
+  *curr = PyLong_AsLong(r) != 0;
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXAutogradMarkVariables(mx_uint num_var, NDArrayHandle *var_handles,
+                            mx_uint *reqs_array,
+                            NDArrayHandle *grad_handles) {
+  API_BEGIN();
+  PyObject *reqs = PyList_New(num_var);
+  for (mx_uint i = 0; i < num_var; ++i)
+    PyList_SetItem(reqs, i, PyLong_FromUnsignedLong(reqs_array[i]));
+  PyObject *r = Call("autograd_mark_variables",
+                     Py_BuildValue("(NNN)", HandleList(var_handles, num_var),
+                                   reqs, HandleList(grad_handles, num_var)));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXAutogradComputeGradient(mx_uint num_output,
+                              NDArrayHandle *output_handles) {
+  API_BEGIN();
+  PyObject *r = Call("autograd_compute_gradient",
+                     Py_BuildValue("(N)",
+                                   HandleList(output_handles, num_output)));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXAutogradBackward(mx_uint num_output, NDArrayHandle *output_handles,
+                       NDArrayHandle *ograd_handles, int retain_graph) {
+  API_BEGIN();
+  PyObject *ogr = ograd_handles ? HandleList(ograd_handles, num_output)
+                                : PyList_New(0);
+  PyObject *r = Call("autograd_backward",
+                     Py_BuildValue("(NNii)",
+                                   HandleList(output_handles, num_output),
+                                   ogr, retain_graph, 1));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXAutogradBackwardEx(mx_uint num_output, NDArrayHandle *output_handles,
+                         NDArrayHandle *ograd_handles, mx_uint num_variables,
+                         NDArrayHandle *var_handles, int retain_graph,
+                         int create_graph, int is_train,
+                         NDArrayHandle **grad_handles, int **grad_stypes) {
+  (void)create_graph;
+  API_BEGIN();
+  PyObject *ogr = ograd_handles ? HandleList(ograd_handles, num_output)
+                                : PyList_New(0);
+  PyObject *r = Call("autograd_backward",
+                     Py_BuildValue("(NNii)",
+                                   HandleList(output_handles, num_output),
+                                   ogr, retain_graph, is_train));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  if (num_variables > 0 && grad_handles != nullptr) {
+    /* gather .grad of each requested variable */
+    tls.handles2.clear();
+    tls.types[0].clear();
+    for (mx_uint i = 0; i < num_variables; ++i) {
+      PyObject *g = Call("ndarray_get_grad",
+                         Py_BuildValue("(K)",
+                                       (unsigned long long)H(var_handles[i])));
+      CHECK_PY(g);
+      tls.handles2.push_back(HP(PyLong_AsLongLong(g)));
+      tls.types[0].push_back(0);  /* dense */
+      Py_DECREF(g);
+    }
+    *grad_handles = tls.handles2.data();
+    if (grad_stypes) *grad_stypes = tls.types[0].data();
+  }
+  API_END();
+}
+
+/* ---- CachedOp ---- */
+
+int MXCreateCachedOp(SymbolHandle handle, CachedOpHandle *out) {
+  API_BEGIN();
+  PyObject *r = Call("cachedop_create",
+                     Py_BuildValue("(K)", (unsigned long long)H(handle)));
+  CHECK_PY(r);
+  *out = HP(PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXCreateCachedOpEx(SymbolHandle handle, int num_flags, const char **keys,
+                       const char **vals, CachedOpHandle *out) {
+  API_BEGIN();
+  PyObject *r = Call("cachedop_create",
+                     Py_BuildValue("(KNN)", (unsigned long long)H(handle),
+                                   StrList(keys, num_flags),
+                                   StrList(vals, num_flags)));
+  CHECK_PY(r);
+  *out = HP(PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXFreeCachedOp(CachedOpHandle handle) {
+  API_BEGIN();
+  PyObject *r = Call("cachedop_free",
+                     Py_BuildValue("(K)", (unsigned long long)H(handle)));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXInvokeCachedOp(CachedOpHandle handle, int num_inputs,
+                     NDArrayHandle *inputs, int *num_outputs,
+                     NDArrayHandle **outputs) {
+  API_BEGIN();
+  PyObject *r = Call("cachedop_invoke",
+                     Py_BuildValue("(KN)", (unsigned long long)H(handle),
+                                   HandleList(inputs, num_inputs)));
+  CHECK_PY(r);
+  mx_uint n;
+  void **arr;
+  ParseHandleList(r, &n, &arr);
+  Py_DECREF(r);
+  *num_outputs = static_cast<int>(n);
+  *outputs = arr;
+  API_END();
+}
+
+int MXInvokeCachedOpEx(CachedOpHandle handle, int num_inputs,
+                       NDArrayHandle *inputs, int *num_outputs,
+                       NDArrayHandle **outputs, const int **out_stypes) {
+  int ret = MXInvokeCachedOp(handle, num_inputs, inputs, num_outputs,
+                             outputs);
+  if (ret != 0) return ret;
+  Gil gil_;
+  tls.types[1].assign(*num_outputs, 0);  /* dense */
+  *out_stypes = tls.types[1].data();
+  return 0;
+}
+
+/* ---- legacy Function API: FunctionHandle = interned op-name string ---- */
+
+int MXListFunctions(mx_uint *out_size, FunctionHandle **out_array) {
+  API_BEGIN();
+  if (EnsureOpNames() != 0) return -1;
+  tls.handles3.clear();
+  for (auto &s : *g_op_names)
+    tls.handles3.push_back(const_cast<void *>(
+        reinterpret_cast<const void *>(&s)));
+  *out_size = static_cast<mx_uint>(tls.handles3.size());
+  *out_array = (FunctionHandle *)(tls.handles3.data());
+  API_END();
+}
+
+int MXGetFunction(const char *name, FunctionHandle *out) {
+  API_BEGIN();
+  if (EnsureOpNames() != 0) return -1;
+  for (auto &s : *g_op_names) {
+    if (s == name) {
+      *out = reinterpret_cast<FunctionHandle>(&s);
+      return 0;
+    }
+  }
+  return Fail(std::string("unknown function ") + name);
+  API_END();
+}
+
+int MXFuncGetInfo(FunctionHandle fun, const char **name,
+                  const char **description, mx_uint *num_args,
+                  const char ***arg_names, const char ***arg_type_infos,
+                  const char ***arg_descriptions, const char **return_type) {
+  if (return_type) *return_type = "";
+  return MXSymbolGetAtomicSymbolInfo(
+      const_cast<void *>(fun), name, description, num_args, arg_names,
+      arg_type_infos, arg_descriptions, nullptr);
+}
+
+int MXFuncDescribe(FunctionHandle fun, mx_uint *num_use_vars,
+                   mx_uint *num_scalars, mx_uint *num_mutate_vars,
+                   int *type_mask) {
+  API_BEGIN();
+  PyObject *r = Call("func_describe",
+                     Py_BuildValue("(s)", CreatorName(
+                         const_cast<void *>(fun))));
+  CHECK_PY(r);
+  *num_use_vars = static_cast<mx_uint>(
+      PyLong_AsLong(PyTuple_GetItem(r, 0)));
+  *num_scalars = static_cast<mx_uint>(PyLong_AsLong(PyTuple_GetItem(r, 1)));
+  *num_mutate_vars = static_cast<mx_uint>(
+      PyLong_AsLong(PyTuple_GetItem(r, 2)));
+  *type_mask = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 3)));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXFuncInvoke(FunctionHandle fun, NDArrayHandle *use_vars,
+                 mx_float *scalar_args, NDArrayHandle *mutate_vars) {
+  return MXFuncInvokeEx(fun, use_vars, scalar_args, mutate_vars, 0, nullptr,
+                        nullptr);
+}
+
+int MXFuncInvokeEx(FunctionHandle fun, NDArrayHandle *use_vars,
+                   mx_float *scalar_args, NDArrayHandle *mutate_vars,
+                   int num_params, char **param_keys, char **param_vals) {
+  (void)scalar_args;
+  API_BEGIN();
+  mx_uint n_use, n_scalar, n_mut;
+  int mask;
+  int ret = MXFuncDescribe(fun, &n_use, &n_scalar, &n_mut, &mask);
+  if (ret != 0) return ret;
+  PyObject *r = Call("func_invoke",
+                     Py_BuildValue("(sNNNNN)",
+                                   CreatorName(const_cast<void *>(fun)),
+                                   HandleList(use_vars, n_use), PyList_New(0),
+                                   HandleList(mutate_vars, n_mut),
+                                   StrList(const_cast<const char **>(
+                                       param_keys), num_params),
+                                   StrList(const_cast<const char **>(
+                                       param_vals), num_params)));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXImperativeInvokeEx(AtomicSymbolCreator creator, int num_inputs,
+                         NDArrayHandle *inputs, int *num_outputs,
+                         NDArrayHandle **outputs, int num_params,
+                         const char **param_keys, const char **param_vals,
+                         const int **out_stypes) {
+  int ret = MXImperativeInvoke(creator, num_inputs, inputs, num_outputs,
+                               outputs, num_params, param_keys, param_vals);
+  if (ret != 0) return ret;
+  Gil gil_;
+  tls.types[2].assign(*num_outputs, 0);
+  *out_stypes = tls.types[2].data();
+  return 0;
+}
+
+/* ---- Symbol extensions ---- */
+
+int MXSymbolGetChildren(SymbolHandle symbol, SymbolHandle *out) {
+  API_BEGIN();
+  PyObject *r = Call("symbol_get_children",
+                     Py_BuildValue("(K)", (unsigned long long)H(symbol)));
+  CHECK_PY(r);
+  *out = HP(PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXSymbolGrad(SymbolHandle sym, mx_uint num_wrt, const char **wrt,
+                 SymbolHandle *out) {
+  (void)sym; (void)num_wrt; (void)wrt; (void)out;
+  /* deprecated in the reference too (symbolic grad graphs are built by
+   * the executor; autograd covers the imperative path) */
+  return Fail("MXSymbolGrad is deprecated: bind an executor (gradients "
+              "are built by Executor.backward) or use autograd");
+}
+
+int MXSymbolInferShapePartial(
+    SymbolHandle sym, mx_uint num_args, const char **keys,
+    const mx_uint *arg_ind_ptr, const mx_uint *arg_shape_data,
+    mx_uint *in_shape_size, const mx_uint **in_shape_ndim,
+    const mx_uint ***in_shape_data, mx_uint *out_shape_size,
+    const mx_uint **out_shape_ndim, const mx_uint ***out_shape_data,
+    mx_uint *aux_shape_size, const mx_uint **aux_shape_ndim,
+    const mx_uint ***aux_shape_data, int *complete) {
+  return MXSymbolInferShapeImpl(
+      sym, num_args, keys, arg_ind_ptr, arg_shape_data, in_shape_size,
+      in_shape_ndim, in_shape_data, out_shape_size, out_shape_ndim,
+      out_shape_data, aux_shape_size, aux_shape_ndim, aux_shape_data,
+      complete, 1);
+}
+
+int MXSymbolListAttr(SymbolHandle symbol, mx_uint *out_size,
+                     const char ***out) {
+  API_BEGIN();
+  PyObject *r = Call("symbol_list_attr",
+                     Py_BuildValue("(Ki)", (unsigned long long)H(symbol), 1));
+  CHECK_PY(r);
+  ParseStrList(r, out_size, out);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXSymbolListAttrShallow(SymbolHandle symbol, mx_uint *out_size,
+                            const char ***out) {
+  API_BEGIN();
+  PyObject *r = Call("symbol_list_attr",
+                     Py_BuildValue("(Ki)", (unsigned long long)H(symbol), 0));
+  CHECK_PY(r);
+  ParseStrList(r, out_size, out);
+  Py_DECREF(r);
+  API_END();
+}
+
+/* ---- Executor extensions ---- */
+
+int MXExecutorPrint(ExecutorHandle handle, const char **out_str) {
+  API_BEGIN();
+  PyObject *r = Call("executor_print",
+                     Py_BuildValue("(K)", (unsigned long long)H(handle)));
+  CHECK_PY(r);
+  tls.text = PyUnicode_AsUTF8(r);
+  *out_str = tls.text.c_str();
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXExecutorBackwardEx(ExecutorHandle handle, mx_uint len,
+                         NDArrayHandle *head_grads, int is_train) {
+  API_BEGIN();
+  PyObject *r = Call("executor_backward_ex",
+                     Py_BuildValue("(KNi)", (unsigned long long)H(handle),
+                                   HandleList(head_grads, len), is_train));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+namespace {
+PyObject *BindXArgs(SymbolHandle symbol_handle, int dev_type, int dev_id,
+                    mx_uint len_map_keys, const char **map_keys,
+                    const int *map_dev_types, const int *map_dev_ids,
+                    mx_uint len, NDArrayHandle *in_args,
+                    NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                    mx_uint aux_states_len, NDArrayHandle *aux_states) {
+  PyObject *reqs = PyList_New(len);
+  for (mx_uint i = 0; i < len; ++i)
+    PyList_SetItem(reqs, i, PyLong_FromUnsignedLong(grad_req_type[i]));
+  return Py_BuildValue(
+      "(KiiNNNNNNN)", (unsigned long long)H(symbol_handle), dev_type, dev_id,
+      StrList(map_keys, len_map_keys), IntList(map_dev_types, len_map_keys),
+      IntList(map_dev_ids, len_map_keys), HandleList(in_args, len),
+      HandleList(arg_grad_store, len), reqs,
+      HandleList(aux_states, aux_states_len));
+}
+}  // namespace
+
+int MXExecutorBindX(SymbolHandle symbol_handle, int dev_type, int dev_id,
+                    mx_uint len_map_keys, const char **map_keys,
+                    const int *map_dev_types, const int *map_dev_ids,
+                    mx_uint len, NDArrayHandle *in_args,
+                    NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                    mx_uint aux_states_len, NDArrayHandle *aux_states,
+                    ExecutorHandle *out) {
+  API_BEGIN();
+  PyObject *r = Call("executor_bind_x",
+                     BindXArgs(symbol_handle, dev_type, dev_id, len_map_keys,
+                               map_keys, map_dev_types, map_dev_ids, len,
+                               in_args, arg_grad_store, grad_req_type,
+                               aux_states_len, aux_states));
+  CHECK_PY(r);
+  *out = HP(PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXExecutorBindEX(SymbolHandle symbol_handle, int dev_type, int dev_id,
+                     mx_uint len_map_keys, const char **map_keys,
+                     const int *map_dev_types, const int *map_dev_ids,
+                     mx_uint len, NDArrayHandle *in_args,
+                     NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                     mx_uint aux_states_len, NDArrayHandle *aux_states,
+                     ExecutorHandle shared_exec, ExecutorHandle *out) {
+  (void)shared_exec;  /* memory sharing is XLA's job in this stack */
+  return MXExecutorBindX(symbol_handle, dev_type, dev_id, len_map_keys,
+                         map_keys, map_dev_types, map_dev_ids, len, in_args,
+                         arg_grad_store, grad_req_type, aux_states_len,
+                         aux_states, out);
+}
+
+namespace {
+struct MonitorCtx {
+  ExecutorMonitorCallback fn;
+  void *handle;
+};
+
+PyObject *MonitorTrampoline(PyObject *self, PyObject *args) {
+  auto *ctx = static_cast<MonitorCtx *>(PyCapsule_GetPointer(self, nullptr));
+  const char *name;
+  long long arr;
+  if (!PyArg_ParseTuple(args, "sL", &name, &arr)) return nullptr;
+  Py_BEGIN_ALLOW_THREADS
+  ctx->fn(name, HP(arr), ctx->handle);
+  Py_END_ALLOW_THREADS
+  Py_RETURN_NONE;
+}
+
+PyMethodDef g_monitor_def = {"_exec_monitor", MonitorTrampoline,
+                             METH_VARARGS, nullptr};
+
+void FreeMonitorCtx(PyObject *capsule) {
+  delete static_cast<MonitorCtx *>(PyCapsule_GetPointer(capsule, nullptr));
+}
+
+struct ControllerCtx {
+  MXKVStoreServerController *fn;
+  void *handle;
+};
+
+PyObject *ControllerTrampoline(PyObject *self, PyObject *args) {
+  auto *ctx =
+      static_cast<ControllerCtx *>(PyCapsule_GetPointer(self, nullptr));
+  int head;
+  const char *body;
+  if (!PyArg_ParseTuple(args, "is", &head, &body)) return nullptr;
+  Py_BEGIN_ALLOW_THREADS
+  ctx->fn(head, body, ctx->handle);
+  Py_END_ALLOW_THREADS
+  Py_RETURN_NONE;
+}
+
+PyMethodDef g_controller_def = {"_kv_controller", ControllerTrampoline,
+                                METH_VARARGS, nullptr};
+
+void FreeControllerCtx(PyObject *capsule) {
+  delete static_cast<ControllerCtx *>(PyCapsule_GetPointer(capsule, nullptr));
+}
+
+struct StrUpdaterCtx {
+  MXKVStoreStrUpdater *fn;
+  void *handle;
+};
+
+PyObject *StrUpdaterTrampoline(PyObject *self, PyObject *args) {
+  auto *ctx =
+      static_cast<StrUpdaterCtx *>(PyCapsule_GetPointer(self, nullptr));
+  const char *key;
+  long long recv, local;
+  if (!PyArg_ParseTuple(args, "sLL", &key, &recv, &local)) return nullptr;
+  Py_BEGIN_ALLOW_THREADS
+  ctx->fn(key, HP(recv), HP(local), ctx->handle);
+  Py_END_ALLOW_THREADS
+  Py_RETURN_NONE;
+}
+
+PyMethodDef g_str_updater_def = {"_kv_str_updater", StrUpdaterTrampoline,
+                                 METH_VARARGS, nullptr};
+
+void FreeStrUpdaterCtx(PyObject *capsule) {
+  delete static_cast<StrUpdaterCtx *>(PyCapsule_GetPointer(capsule, nullptr));
+}
+}  // namespace
+
+int MXExecutorSetMonitorCallback(ExecutorHandle handle,
+                                 ExecutorMonitorCallback callback,
+                                 void *callback_handle) {
+  API_BEGIN();
+  auto *ctx = new MonitorCtx{callback, callback_handle};
+  PyObject *capsule = PyCapsule_New(ctx, nullptr, FreeMonitorCtx);
+  PyObject *cb = PyCFunction_New(&g_monitor_def, capsule);
+  Py_DECREF(capsule);
+  PyObject *r = Call("executor_set_monitor_callback",
+                     Py_BuildValue("(KN)", (unsigned long long)H(handle),
+                                   cb));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+/* ---- Data IO extensions ---- */
+
+int MXDataIterGetIndex(DataIterHandle handle, uint64_t **out_index,
+                       uint64_t *out_size) {
+  API_BEGIN();
+  PyObject *r = Call("data_iter_get_index",
+                     Py_BuildValue("(K)", (unsigned long long)H(handle)));
+  CHECK_PY(r);
+  static thread_local std::vector<uint64_t> t_idx;
+  t_idx.clear();
+  Py_ssize_t n = PySequence_Size(r);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *it = PySequence_GetItem(r, i);
+    t_idx.push_back(static_cast<uint64_t>(PyLong_AsUnsignedLongLong(it)));
+    Py_DECREF(it);
+  }
+  Py_DECREF(r);
+  *out_index = t_idx.data();
+  *out_size = static_cast<uint64_t>(t_idx.size());
+  API_END();
+}
+
+/* ---- KVStore extensions ---- */
+
+int MXInitPSEnv(mx_uint num_vars, const char **keys, const char **vals) {
+  API_BEGIN();
+  PyObject *r = Call("init_ps_env",
+                     Py_BuildValue("(NN)", StrList(keys, num_vars),
+                                   StrList(vals, num_vars)));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXKVStoreInitEx(KVStoreHandle handle, mx_uint num, const char **keys,
+                    NDArrayHandle *vals) {
+  API_BEGIN();
+  PyObject *r = Call("kvstore_init_ex",
+                     Py_BuildValue("(KNN)", (unsigned long long)H(handle),
+                                   StrList(keys, num),
+                                   HandleList(vals, num)));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXKVStorePushEx(KVStoreHandle handle, mx_uint num, const char **keys,
+                    NDArrayHandle *vals, int priority) {
+  API_BEGIN();
+  PyObject *r = Call("kvstore_push_ex",
+                     Py_BuildValue("(KNNi)", (unsigned long long)H(handle),
+                                   StrList(keys, num), HandleList(vals, num),
+                                   priority));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXKVStorePullEx(KVStoreHandle handle, mx_uint num, const char **keys,
+                    NDArrayHandle *vals, int priority) {
+  API_BEGIN();
+  PyObject *r = Call("kvstore_pull_ex",
+                     Py_BuildValue("(KNNi)", (unsigned long long)H(handle),
+                                   StrList(keys, num), HandleList(vals, num),
+                                   priority));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXKVStorePullRowSparse(KVStoreHandle handle, mx_uint num,
+                           const int *keys, NDArrayHandle *vals,
+                           const NDArrayHandle *row_ids, int priority) {
+  API_BEGIN();
+  PyObject *k = PyList_New(num);
+  for (mx_uint i = 0; i < num; ++i)
+    PyList_SetItem(k, i, PyLong_FromLong(keys[i]));
+  PyObject *r = Call("kvstore_pull_row_sparse",
+                     Py_BuildValue("(KNNNi)", (unsigned long long)H(handle),
+                                   k, HandleList(vals, num),
+                                   HandleList(const_cast<void *const *>(
+                                       row_ids), num), priority));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXKVStorePullRowSparseEx(KVStoreHandle handle, mx_uint num,
+                             const char **keys, NDArrayHandle *vals,
+                             const NDArrayHandle *row_ids, int priority) {
+  API_BEGIN();
+  PyObject *r = Call("kvstore_pull_row_sparse",
+                     Py_BuildValue("(KNNNi)", (unsigned long long)H(handle),
+                                   StrList(keys, num), HandleList(vals, num),
+                                   HandleList(const_cast<void *const *>(
+                                       row_ids), num), priority));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXKVStoreSetGradientCompression(KVStoreHandle handle, mx_uint num_params,
+                                    const char **keys, const char **vals) {
+  API_BEGIN();
+  PyObject *r = Call("kvstore_set_gradient_compression",
+                     Py_BuildValue("(KNN)", (unsigned long long)H(handle),
+                                   StrList(keys, num_params),
+                                   StrList(vals, num_params)));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXKVStoreSetUpdaterEx(KVStoreHandle handle, MXKVStoreUpdater updater,
+                          MXKVStoreStrUpdater str_updater,
+                          void *updater_handle) {
+  if (str_updater == nullptr)
+    return MXKVStoreSetUpdater(handle, updater, updater_handle);
+  API_BEGIN();
+  auto *ctx = new StrUpdaterCtx{str_updater, updater_handle};
+  PyObject *capsule = PyCapsule_New(ctx, nullptr, FreeStrUpdaterCtx);
+  PyObject *cb = PyCFunction_New(&g_str_updater_def, capsule);
+  Py_DECREF(capsule);
+  PyObject *r = Call("kvstore_set_updater_ex",
+                     Py_BuildValue("(KN)", (unsigned long long)H(handle),
+                                   cb));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXKVStoreIsServerNode(int *ret) {
+  API_BEGIN();
+  PyObject *r = Call("kvstore_is_server_node", PyTuple_New(0));
+  CHECK_PY(r);
+  *ret = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXKVStoreIsSchedulerNode(int *ret) {
+  API_BEGIN();
+  PyObject *r = Call("kvstore_is_scheduler_node", PyTuple_New(0));
+  CHECK_PY(r);
+  *ret = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXKVStoreRunServer(KVStoreHandle handle,
+                       MXKVStoreServerController controller,
+                       void *controller_handle) {
+  API_BEGIN();
+  auto *ctx = new ControllerCtx{controller, controller_handle};
+  PyObject *capsule = PyCapsule_New(ctx, nullptr, FreeControllerCtx);
+  PyObject *cb = PyCFunction_New(&g_controller_def, capsule);
+  Py_DECREF(capsule);
+  PyObject *r = Call("kvstore_run_server",
+                     Py_BuildValue("(KN)", (unsigned long long)H(handle),
+                                   cb));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXKVStoreSendCommmandToServers(KVStoreHandle handle, int cmd_id,
+                                   const char *cmd_body) {
+  API_BEGIN();
+  PyObject *r = Call("kvstore_send_command_to_servers",
+                     Py_BuildValue("(Kis)", (unsigned long long)H(handle),
+                                   cmd_id, cmd_body));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXKVStoreSetBarrierBeforeExit(KVStoreHandle handle,
+                                  const int barrier_before_exit) {
+  API_BEGIN();
+  PyObject *r = Call("kvstore_set_barrier_before_exit",
+                     Py_BuildValue("(Ki)", (unsigned long long)H(handle),
+                                   barrier_before_exit));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXKVStoreGetNumDeadNode(KVStoreHandle handle, const int node_id,
+                            int *number, const int timeout_sec) {
+  API_BEGIN();
+  PyObject *r = Call("kvstore_get_num_dead_node",
+                     Py_BuildValue("(Kii)", (unsigned long long)H(handle),
+                                   node_id, timeout_sec));
+  CHECK_PY(r);
+  *number = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+/* ---- misc globals ---- */
+
+int MXEngineSetBulkSize(int bulk_size, int *prev_bulk_size) {
+  API_BEGIN();
+  PyObject *r = Call("engine_set_bulk_size", Py_BuildValue("(i)", bulk_size));
+  CHECK_PY(r);
+  if (prev_bulk_size) *prev_bulk_size = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXSetNumOMPThreads(int thread_num) {
+  API_BEGIN();
+  PyObject *r = Call("set_num_omp_threads", Py_BuildValue("(i)", thread_num));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXRtcCreate(char *, mx_uint, mx_uint, char **, char **, NDArrayHandle *,
+                NDArrayHandle *, char *, void **) {
+  Gil gil_;
+  return Fail("MXRtcCreate: CUDA runtime compilation has no TPU analog; "
+              "hot custom kernels are Pallas/XLA programs in this stack");
+}
+
+int MXRtcPush(void *, mx_uint, mx_uint, NDArrayHandle *, NDArrayHandle *,
+              mx_uint, mx_uint, mx_uint, mx_uint, mx_uint, mx_uint) {
+  Gil gil_;
+  return Fail("MXRtcPush: CUDA RTC not supported on the TPU backend");
+}
+
+int MXRtcFree(void *) {
+  Gil gil_;
+  return Fail("MXRtcFree: CUDA RTC not supported on the TPU backend");
+}
+
+int MXCustomOpRegister(const char *op_type, void *creator) {
+  (void)op_type; (void)creator;
+  Gil gil_;
+  return Fail("MXCustomOpRegister: C-callback custom ops are not "
+              "supported; register custom ops from python via "
+              "mxnet_tpu.operator (CustomOp/CustomOpProp)");
+}
+
+int MXCustomFunctionRecord(int, NDArrayHandle *, int, NDArrayHandle *,
+                           void *) {
+  Gil gil_;
+  return Fail("MXCustomFunctionRecord: use mxnet_tpu.autograd.Function "
+              "from python");
+}
+
+/* ---- RecordIO extensions ---- */
+
+int MXRecordIOReaderSeek(RecordIOHandle handle, size_t pos) {
+  API_BEGIN();
+  PyObject *r = Call("recordio_reader_seek",
+                     Py_BuildValue("(KK)", (unsigned long long)H(handle),
+                                   (unsigned long long)pos));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXRecordIOReaderTell(RecordIOHandle handle, size_t *pos) {
+  API_BEGIN();
+  PyObject *r = Call("recordio_reader_tell",
+                     Py_BuildValue("(K)", (unsigned long long)H(handle)));
+  CHECK_PY(r);
+  *pos = static_cast<size_t>(PyLong_AsUnsignedLongLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXRecordIOWriterTell(RecordIOHandle handle, size_t *pos) {
+  API_BEGIN();
+  PyObject *r = Call("recordio_writer_tell",
+                     Py_BuildValue("(K)", (unsigned long long)H(handle)));
+  CHECK_PY(r);
+  *pos = static_cast<size_t>(PyLong_AsUnsignedLongLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+/* ---- predict API (mxnet_tpu_c_predict_api.h) ---- */
+
+namespace {
+PyObject *PredShapes(mx_uint num, const mx_uint *indptr,
+                     const mx_uint *data) {
+  PyObject *l = PyList_New(num);
+  for (mx_uint i = 0; i < num; ++i) {
+    mx_uint lo = indptr[i], hi = indptr[i + 1];
+    PyObject *s = PyList_New(hi - lo);
+    for (mx_uint j = lo; j < hi; ++j)
+      PyList_SetItem(s, j - lo, PyLong_FromUnsignedLong(data[j]));
+    PyList_SetItem(l, i, s);
+  }
+  return l;
+}
+}  // namespace
+
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char **input_keys,
+                 const mx_uint *input_shape_indptr,
+                 const mx_uint *input_shape_data, PredictorHandle *out) {
+  API_BEGIN();
+  PyObject *r = Call(
+      "pred_create",
+      Py_BuildValue("(sNiiNN)", symbol_json_str,
+                    PyBytes_FromStringAndSize(
+                        static_cast<const char *>(param_bytes),
+                        (Py_ssize_t)param_size),
+                    dev_type, dev_id,
+                    StrList(input_keys, num_input_nodes),
+                    PredShapes(num_input_nodes, input_shape_indptr,
+                               input_shape_data)));
+  CHECK_PY(r);
+  *out = HP(PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXPredCreatePartialOut(const char *symbol_json_str,
+                           const void *param_bytes, int param_size,
+                           int dev_type, int dev_id,
+                           mx_uint num_input_nodes, const char **input_keys,
+                           const mx_uint *input_shape_indptr,
+                           const mx_uint *input_shape_data,
+                           mx_uint num_output_nodes,
+                           const char **output_keys, PredictorHandle *out) {
+  API_BEGIN();
+  PyObject *r = Call(
+      "pred_create_partial",
+      Py_BuildValue("(sNiiNNN)", symbol_json_str,
+                    PyBytes_FromStringAndSize(
+                        static_cast<const char *>(param_bytes),
+                        (Py_ssize_t)param_size),
+                    dev_type, dev_id,
+                    StrList(input_keys, num_input_nodes),
+                    PredShapes(num_input_nodes, input_shape_indptr,
+                               input_shape_data),
+                    StrList(output_keys, num_output_nodes)));
+  CHECK_PY(r);
+  *out = HP(PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint **shape_data, mx_uint *shape_ndim) {
+  API_BEGIN();
+  PyObject *r = Call("pred_get_output_shape",
+                     Py_BuildValue("(KI)", (unsigned long long)H(handle),
+                                   index));
+  CHECK_PY(r);
+  tls.shape.clear();
+  Py_ssize_t n = PySequence_Size(r);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *it = PySequence_GetItem(r, i);
+    tls.shape.push_back(static_cast<mx_uint>(PyLong_AsUnsignedLong(it)));
+    Py_DECREF(it);
+  }
+  Py_DECREF(r);
+  *shape_data = tls.shape.data();
+  *shape_ndim = static_cast<mx_uint>(tls.shape.size());
+  API_END();
+}
+
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const mx_float *data, mx_uint size) {
+  API_BEGIN();
+  PyObject *r = Call("pred_set_input_ptr",
+                     Py_BuildValue("(KsKI)", (unsigned long long)H(handle),
+                                   key, (unsigned long long)(uintptr_t)data,
+                                   size));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXPredForward(PredictorHandle handle) {
+  API_BEGIN();
+  PyObject *r = Call("pred_forward",
+                     Py_BuildValue("(K)", (unsigned long long)H(handle)));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXPredPartialForward(PredictorHandle handle, int step, int *step_left) {
+  /* the whole graph is ONE XLA program; there are no per-node steps */
+  int ret = MXPredForward(handle);
+  if (ret == 0 && step_left) *step_left = 0;
+  (void)step;
+  return ret;
+}
+
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float *data,
+                    mx_uint size) {
+  API_BEGIN();
+  PyObject *r = Call("pred_get_output",
+                     Py_BuildValue("(KIKI)", (unsigned long long)H(handle),
+                                   index,
+                                   (unsigned long long)(uintptr_t)data,
+                                   size));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXPredFree(PredictorHandle handle) {
+  API_BEGIN();
+  PyObject *r = Call("pred_free",
+                     Py_BuildValue("(K)", (unsigned long long)H(handle)));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDListCreate(const char *nd_file_bytes, int nd_file_size,
+                   NDListHandle *out) {
+  API_BEGIN();
+  PyObject *r = Call("ndlist_create",
+                     Py_BuildValue("(N)", PyBytes_FromStringAndSize(
+                         nd_file_bytes, (Py_ssize_t)nd_file_size)));
+  CHECK_PY(r);
+  *out = HP(PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDListGet(NDListHandle handle, mx_uint index, const char **out_key,
+                const mx_float **out_data, const mx_uint **out_shape,
+                mx_uint *out_ndim) {
+  API_BEGIN();
+  PyObject *r = Call("ndlist_get",
+                     Py_BuildValue("(KI)", (unsigned long long)H(handle),
+                                   index));
+  CHECK_PY(r);
+  tls.text = PyUnicode_AsUTF8(PyTuple_GetItem(r, 0));
+  *out_key = tls.text.c_str();
+  *out_data = reinterpret_cast<const mx_float *>(
+      PyLong_AsUnsignedLongLong(PyTuple_GetItem(r, 1)));
+  PyObject *shp = PyTuple_GetItem(r, 2);
+  tls.shape.clear();
+  Py_ssize_t n = PySequence_Size(shp);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *it = PySequence_GetItem(shp, i);
+    tls.shape.push_back(static_cast<mx_uint>(PyLong_AsUnsignedLong(it)));
+    Py_DECREF(it);
+  }
+  Py_DECREF(r);
+  *out_shape = tls.shape.data();
+  *out_ndim = static_cast<mx_uint>(tls.shape.size());
+  API_END();
+}
+
+int MXNDListFree(NDListHandle handle) {
+  return MXPredFree(handle);
+}
+
+/* ---- remaining surface: CudaModule RTC + autograd symbol capture ---- */
+
+int MXAutogradGetSymbol(NDArrayHandle handle, SymbolHandle *out) {
+  (void)handle; (void)out;
+  Gil gil_;
+  return Fail("MXAutogradGetSymbol: the imperative tape records jitted "
+              "closures, not named graph nodes; hybridize (CachedOp) or "
+              "build the Symbol graph directly to export a symbol");
+}
+
+int MXRtcCudaModuleCreate(const char *, int, const char **, int,
+                          const char **, void **) {
+  Gil gil_;
+  return Fail("MXRtcCudaModuleCreate: CUDA RTC has no TPU analog");
+}
+
+int MXRtcCudaModuleFree(void *) {
+  Gil gil_;
+  return Fail("MXRtcCudaModuleFree: CUDA RTC has no TPU analog");
+}
+
+int MXRtcCudaKernelCreate(void *, const char *, int, int *, int *, int *,
+                          void **) {
+  Gil gil_;
+  return Fail("MXRtcCudaKernelCreate: CUDA RTC has no TPU analog");
+}
+
+int MXRtcCudaKernelFree(void *) {
+  Gil gil_;
+  return Fail("MXRtcCudaKernelFree: CUDA RTC has no TPU analog");
+}
+
+int MXRtcCudaKernelCall(void *, int, void **, mx_uint, mx_uint, mx_uint,
+                        mx_uint, mx_uint, mx_uint, mx_uint) {
+  Gil gil_;
+  return Fail("MXRtcCudaKernelCall: CUDA RTC has no TPU analog");
 }

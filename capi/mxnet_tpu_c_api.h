@@ -21,6 +21,9 @@ extern "C" {
 
 #include <stdint.h>
 #include <stddef.h>
+#ifndef __cplusplus
+#include <stdbool.h>
+#endif
 
 #define MXNET_DLL __attribute__((visibility("default")))
 
@@ -220,6 +223,233 @@ MXNET_DLL int MXKVStoreGetGroupSize(KVStoreHandle handle, int *ret);
 MXNET_DLL int MXKVStoreBarrier(KVStoreHandle handle);
 MXNET_DLL int MXKVStoreIsWorkerNode(int *ret);
 
+/* ---- part 1b: NDArray sparse / grad / raw (reference c_api.h:250+) ---- */
+typedef void *CachedOpHandle;
+MXNET_DLL int MXNDArrayCreateSparseEx(
+    int storage_type, const mx_uint *shape, mx_uint ndim, int dev_type,
+    int dev_id, int delay_alloc, int dtype, mx_uint num_aux,
+    int *aux_type, mx_uint *aux_ndims, const mx_uint *aux_shape,
+    NDArrayHandle *out);
+MXNET_DLL int MXNDArrayGetDataNDArray(NDArrayHandle handle,
+                                      NDArrayHandle *out);
+MXNET_DLL int MXNDArrayGetAuxNDArray(NDArrayHandle handle, mx_uint i,
+                                     NDArrayHandle *out);
+MXNET_DLL int MXNDArrayGetAuxType(NDArrayHandle handle, mx_uint i,
+                                  int *out_type);
+MXNET_DLL int MXNDArrayGetData(NDArrayHandle handle, void **out_pdata);
+MXNET_DLL int MXNDArraySyncCheckFormat(NDArrayHandle handle,
+                                       const bool full_check);
+MXNET_DLL int MXNDArraySyncCopyFromNDArray(NDArrayHandle handle_dst,
+                                           const NDArrayHandle handle_src,
+                                           const int i);
+MXNET_DLL int MXNDArrayDetach(NDArrayHandle handle, NDArrayHandle *out);
+MXNET_DLL int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out);
+MXNET_DLL int MXNDArraySetGradState(NDArrayHandle handle, int state);
+MXNET_DLL int MXNDArrayGetGradState(NDArrayHandle handle, int *out);
+MXNET_DLL int MXNDArraySaveRawBytes(NDArrayHandle handle, size_t *out_size,
+                                    const char **out_buf);
+MXNET_DLL int MXNDArrayLoadFromRawBytes(const void *buf, size_t size,
+                                        NDArrayHandle *out);
+MXNET_DLL int MXNDArrayGetSharedMemHandle(NDArrayHandle handle,
+                                          int *shared_pid, int *shared_id);
+MXNET_DLL int MXNDArrayCreateFromSharedMem(int shared_pid, int shared_id,
+                                           const mx_uint *shape,
+                                           mx_uint ndim, int dtype,
+                                           NDArrayHandle *out);
+
+/* ---- part 2b: autograd (reference c_api.h:579+) ---- */
+MXNET_DLL int MXAutogradSetIsRecording(int is_recording, int *prev);
+MXNET_DLL int MXAutogradSetIsTraining(int is_training, int *prev);
+MXNET_DLL int MXAutogradIsRecording(bool *curr);
+MXNET_DLL int MXAutogradIsTraining(bool *curr);
+MXNET_DLL int MXAutogradMarkVariables(mx_uint num_var,
+                                      NDArrayHandle *var_handles,
+                                      mx_uint *reqs_array,
+                                      NDArrayHandle *grad_handles);
+MXNET_DLL int MXAutogradComputeGradient(mx_uint num_output,
+                                        NDArrayHandle *output_handles);
+MXNET_DLL int MXAutogradBackward(mx_uint num_output,
+                                 NDArrayHandle *output_handles,
+                                 NDArrayHandle *ograd_handles,
+                                 int retain_graph);
+MXNET_DLL int MXAutogradBackwardEx(mx_uint num_output,
+                                   NDArrayHandle *output_handles,
+                                   NDArrayHandle *ograd_handles,
+                                   mx_uint num_variables,
+                                   NDArrayHandle *var_handles,
+                                   int retain_graph, int create_graph,
+                                   int is_train, NDArrayHandle **grad_handles,
+                                   int **grad_stypes);
+
+/* ---- part 2c: CachedOp (Gluon hybridize over the ABI) ---- */
+MXNET_DLL int MXCreateCachedOp(SymbolHandle handle, CachedOpHandle *out);
+MXNET_DLL int MXCreateCachedOpEx(SymbolHandle handle, int num_flags,
+                                 const char **keys, const char **vals,
+                                 CachedOpHandle *out);
+MXNET_DLL int MXFreeCachedOp(CachedOpHandle handle);
+MXNET_DLL int MXInvokeCachedOp(CachedOpHandle handle, int num_inputs,
+                               NDArrayHandle *inputs, int *num_outputs,
+                               NDArrayHandle **outputs);
+MXNET_DLL int MXInvokeCachedOpEx(CachedOpHandle handle, int num_inputs,
+                                 NDArrayHandle *inputs, int *num_outputs,
+                                 NDArrayHandle **outputs,
+                                 const int **out_stypes);
+
+/* ---- part 2d: legacy Function API ---- */
+MXNET_DLL int MXListFunctions(mx_uint *out_size, FunctionHandle **out_array);
+MXNET_DLL int MXGetFunction(const char *name, FunctionHandle *out);
+MXNET_DLL int MXFuncGetInfo(FunctionHandle fun, const char **name,
+                            const char **description, mx_uint *num_args,
+                            const char ***arg_names,
+                            const char ***arg_type_infos,
+                            const char ***arg_descriptions,
+                            const char **return_type);
+MXNET_DLL int MXFuncDescribe(FunctionHandle fun, mx_uint *num_use_vars,
+                             mx_uint *num_scalars, mx_uint *num_mutate_vars,
+                             int *type_mask);
+MXNET_DLL int MXFuncInvoke(FunctionHandle fun, NDArrayHandle *use_vars,
+                           mx_float *scalar_args,
+                           NDArrayHandle *mutate_vars);
+MXNET_DLL int MXFuncInvokeEx(FunctionHandle fun, NDArrayHandle *use_vars,
+                             mx_float *scalar_args,
+                             NDArrayHandle *mutate_vars, int num_params,
+                             char **param_keys, char **param_vals);
+MXNET_DLL int MXImperativeInvokeEx(AtomicSymbolCreator creator,
+                                   int num_inputs, NDArrayHandle *inputs,
+                                   int *num_outputs, NDArrayHandle **outputs,
+                                   int num_params, const char **param_keys,
+                                   const char **param_vals,
+                                   const int **out_stypes);
+
+/* ---- part 3b: Symbol extensions ---- */
+MXNET_DLL int MXSymbolGetChildren(SymbolHandle symbol, SymbolHandle *out);
+MXNET_DLL int MXSymbolGrad(SymbolHandle sym, mx_uint num_wrt,
+                           const char **wrt, SymbolHandle *out);
+MXNET_DLL int MXSymbolInferShapePartial(
+    SymbolHandle sym, mx_uint num_args, const char **keys,
+    const mx_uint *arg_ind_ptr, const mx_uint *arg_shape_data,
+    mx_uint *in_shape_size, const mx_uint **in_shape_ndim,
+    const mx_uint ***in_shape_data, mx_uint *out_shape_size,
+    const mx_uint **out_shape_ndim, const mx_uint ***out_shape_data,
+    mx_uint *aux_shape_size, const mx_uint **aux_shape_ndim,
+    const mx_uint ***aux_shape_data, int *complete);
+MXNET_DLL int MXSymbolListAttr(SymbolHandle symbol, mx_uint *out_size,
+                               const char ***out);
+MXNET_DLL int MXSymbolListAttrShallow(SymbolHandle symbol, mx_uint *out_size,
+                                      const char ***out);
+
+/* ---- part 4b: Executor extensions ---- */
+typedef void (*ExecutorMonitorCallback)(const char *, NDArrayHandle, void *);
+MXNET_DLL int MXExecutorPrint(ExecutorHandle handle, const char **out_str);
+MXNET_DLL int MXExecutorBackwardEx(ExecutorHandle handle, mx_uint len,
+                                   NDArrayHandle *head_grads, int is_train);
+MXNET_DLL int MXExecutorBindX(SymbolHandle symbol_handle, int dev_type,
+                              int dev_id, mx_uint len_map_keys,
+                              const char **map_keys, const int *map_dev_types,
+                              const int *map_dev_ids, mx_uint len,
+                              NDArrayHandle *in_args,
+                              NDArrayHandle *arg_grad_store,
+                              mx_uint *grad_req_type, mx_uint aux_states_len,
+                              NDArrayHandle *aux_states, ExecutorHandle *out);
+MXNET_DLL int MXExecutorBindEX(SymbolHandle symbol_handle, int dev_type,
+                               int dev_id, mx_uint len_map_keys,
+                               const char **map_keys,
+                               const int *map_dev_types,
+                               const int *map_dev_ids, mx_uint len,
+                               NDArrayHandle *in_args,
+                               NDArrayHandle *arg_grad_store,
+                               mx_uint *grad_req_type, mx_uint aux_states_len,
+                               NDArrayHandle *aux_states,
+                               ExecutorHandle shared_exec,
+                               ExecutorHandle *out);
+MXNET_DLL int MXExecutorSetMonitorCallback(ExecutorHandle handle,
+                                           ExecutorMonitorCallback callback,
+                                           void *callback_handle);
+
+/* ---- part 5b: Data IO extensions ---- */
+MXNET_DLL int MXDataIterGetIndex(DataIterHandle handle, uint64_t **out_index,
+                                 uint64_t *out_size);
+
+/* ---- part 6b: KVStore extensions ---- */
+typedef void(MXKVStoreStrUpdater)(const char *key, NDArrayHandle recv,
+                                  NDArrayHandle local, void *handle);
+typedef void(MXKVStoreServerController)(int head, const char *body,
+                                        void *controller_handle);
+MXNET_DLL int MXInitPSEnv(mx_uint num_vars, const char **keys,
+                          const char **vals);
+MXNET_DLL int MXKVStoreInitEx(KVStoreHandle handle, mx_uint num,
+                              const char **keys, NDArrayHandle *vals);
+MXNET_DLL int MXKVStorePushEx(KVStoreHandle handle, mx_uint num,
+                              const char **keys, NDArrayHandle *vals,
+                              int priority);
+MXNET_DLL int MXKVStorePullEx(KVStoreHandle handle, mx_uint num,
+                              const char **keys, NDArrayHandle *vals,
+                              int priority);
+MXNET_DLL int MXKVStorePullRowSparse(KVStoreHandle handle, mx_uint num,
+                                     const int *keys, NDArrayHandle *vals,
+                                     const NDArrayHandle *row_ids,
+                                     int priority);
+MXNET_DLL int MXKVStorePullRowSparseEx(KVStoreHandle handle, mx_uint num,
+                                       const char **keys,
+                                       NDArrayHandle *vals,
+                                       const NDArrayHandle *row_ids,
+                                       int priority);
+MXNET_DLL int MXKVStoreSetGradientCompression(KVStoreHandle handle,
+                                              mx_uint num_params,
+                                              const char **keys,
+                                              const char **vals);
+MXNET_DLL int MXKVStoreSetUpdaterEx(KVStoreHandle handle,
+                                    MXKVStoreUpdater updater,
+                                    MXKVStoreStrUpdater str_updater,
+                                    void *updater_handle);
+MXNET_DLL int MXKVStoreIsServerNode(int *ret);
+MXNET_DLL int MXKVStoreIsSchedulerNode(int *ret);
+MXNET_DLL int MXKVStoreRunServer(KVStoreHandle handle,
+                                 MXKVStoreServerController controller,
+                                 void *controller_handle);
+MXNET_DLL int MXKVStoreSendCommmandToServers(KVStoreHandle handle,
+                                             int cmd_id, const char *cmd_body);
+MXNET_DLL int MXKVStoreSetBarrierBeforeExit(KVStoreHandle handle,
+                                            const int barrier_before_exit);
+MXNET_DLL int MXKVStoreGetNumDeadNode(KVStoreHandle handle, const int node_id,
+                                      int *number, const int timeout_sec);
+
+/* ---- misc globals ---- */
+MXNET_DLL int MXEngineSetBulkSize(int bulk_size, int *prev_bulk_size);
+MXNET_DLL int MXSetNumOMPThreads(int thread_num);
+/* CUDA runtime compilation has no TPU analog (kernels are XLA/Pallas);
+ * the MXRtc* entry points exist for ABI parity and fail with a clear
+ * message. */
+MXNET_DLL int MXRtcCreate(char *name, mx_uint num_input, mx_uint num_output,
+                          char **input_names, char **output_names,
+                          NDArrayHandle *inputs, NDArrayHandle *outputs,
+                          char *kernel, void **out);
+MXNET_DLL int MXRtcPush(void *handle, mx_uint num_input, mx_uint num_output,
+                        NDArrayHandle *inputs, NDArrayHandle *outputs,
+                        mx_uint gridDimX, mx_uint gridDimY, mx_uint gridDimZ,
+                        mx_uint blockDimX, mx_uint blockDimY,
+                        mx_uint blockDimZ);
+MXNET_DLL int MXRtcFree(void *handle);
+MXNET_DLL int MXCustomOpRegister(const char *op_type, void *creator);
+MXNET_DLL int MXAutogradGetSymbol(NDArrayHandle handle, SymbolHandle *out);
+MXNET_DLL int MXRtcCudaModuleCreate(const char *source, int num_options,
+                                    const char **options, int num_exports,
+                                    const char **exports, void **out);
+MXNET_DLL int MXRtcCudaModuleFree(void *handle);
+MXNET_DLL int MXRtcCudaKernelCreate(void *handle, const char *name,
+                                    int num_args, int *is_ndarray,
+                                    int *is_const, int *arg_types,
+                                    void **out);
+MXNET_DLL int MXRtcCudaKernelFree(void *handle);
+MXNET_DLL int MXRtcCudaKernelCall(void *handle, int dev_id, void **args,
+                                  mx_uint grid_dim_x, mx_uint grid_dim_y,
+                                  mx_uint grid_dim_z, mx_uint block_dim_x,
+                                  mx_uint block_dim_y, mx_uint block_dim_z,
+                                  mx_uint shared_mem);
+MXNET_DLL int MXCustomFunctionRecord(int num_inputs, NDArrayHandle *inputs,
+                                     int num_outputs, NDArrayHandle *outputs,
+                                     void *callbacks);
+
 /* ---- RecordIO ---- */
 MXNET_DLL int MXRecordIOWriterCreate(const char *uri, RecordIOHandle *out);
 MXNET_DLL int MXRecordIOWriterFree(RecordIOHandle handle);
@@ -229,6 +459,9 @@ MXNET_DLL int MXRecordIOReaderCreate(const char *uri, RecordIOHandle *out);
 MXNET_DLL int MXRecordIOReaderFree(RecordIOHandle handle);
 MXNET_DLL int MXRecordIOReaderReadRecord(RecordIOHandle handle,
                                          char const **buf, size_t *size);
+MXNET_DLL int MXRecordIOReaderSeek(RecordIOHandle handle, size_t pos);
+MXNET_DLL int MXRecordIOReaderTell(RecordIOHandle handle, size_t *pos);
+MXNET_DLL int MXRecordIOWriterTell(RecordIOHandle handle, size_t *pos);
 
 #ifdef __cplusplus
 }

@@ -1,0 +1,67 @@
+/*!
+ * mxnet_tpu C predict API — mirrors the reference
+ * include/mxnet/c_predict_api.h (standalone inference deployment:
+ * MXPredCreate/MXPredForward/MXPredGetOutput over a static, grad-free
+ * executor; here an AOT-jitted XLA program with weights baked in).
+ * Implemented by capi/c_api.cc alongside the main ABI.
+ */
+#ifndef MXNET_TPU_C_PREDICT_API_H_
+#define MXNET_TPU_C_PREDICT_API_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#include <stdint.h>
+#include <stddef.h>
+
+#ifndef MXNET_DLL
+#define MXNET_DLL __attribute__((visibility("default")))
+#endif
+
+typedef uint32_t mx_uint;
+typedef float mx_float;
+typedef void *PredictorHandle;
+typedef void *NDListHandle;
+
+MXNET_DLL const char *MXGetLastError();
+
+/*! Create a predictor from a symbol JSON and a parameter blob (the bytes
+ * of an NDArray save file with "arg:"/"aux:" named entries). */
+MXNET_DLL int MXPredCreate(const char *symbol_json_str,
+                           const void *param_bytes, int param_size,
+                           int dev_type, int dev_id, mx_uint num_input_nodes,
+                           const char **input_keys,
+                           const mx_uint *input_shape_indptr,
+                           const mx_uint *input_shape_data,
+                           PredictorHandle *out);
+MXNET_DLL int MXPredCreatePartialOut(
+    const char *symbol_json_str, const void *param_bytes, int param_size,
+    int dev_type, int dev_id, mx_uint num_input_nodes,
+    const char **input_keys, const mx_uint *input_shape_indptr,
+    const mx_uint *input_shape_data, mx_uint num_output_nodes,
+    const char **output_keys, PredictorHandle *out);
+MXNET_DLL int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                                   mx_uint **shape_data,
+                                   mx_uint *shape_ndim);
+MXNET_DLL int MXPredSetInput(PredictorHandle handle, const char *key,
+                             const mx_float *data, mx_uint size);
+MXNET_DLL int MXPredForward(PredictorHandle handle);
+MXNET_DLL int MXPredPartialForward(PredictorHandle handle, int step,
+                                   int *step_left);
+MXNET_DLL int MXPredGetOutput(PredictorHandle handle, mx_uint index,
+                              mx_float *data, mx_uint size);
+MXNET_DLL int MXPredFree(PredictorHandle handle);
+
+/*! NDArray-file list: parse a .nd/.params blob into named arrays. */
+MXNET_DLL int MXNDListCreate(const char *nd_file_bytes, int nd_file_size,
+                             NDListHandle *out);
+MXNET_DLL int MXNDListGet(NDListHandle handle, mx_uint index,
+                          const char **out_key, const mx_float **out_data,
+                          const mx_uint **out_shape, mx_uint *out_ndim);
+MXNET_DLL int MXNDListFree(NDListHandle handle);
+
+#ifdef __cplusplus
+}
+#endif
+#endif  /* MXNET_TPU_C_PREDICT_API_H_ */

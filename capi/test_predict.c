@@ -1,0 +1,109 @@
+/*
+ * C predict API end-to-end test: load a checkpoint (symbol JSON + params
+ * blob written by the python test driver), create a predictor, score a
+ * batch, and print the argmax per row.
+ *
+ * Mirrors the reference's amalgamation/predict deployment consumer
+ * (c_predict_api.h usage: MXPredCreate -> SetInput -> Forward ->
+ * GetOutput).
+ *
+ * Usage: test_predict <prefix>   (expects <prefix>-symbol.json and
+ *        <prefix>.params, input "data" of shape 4x3)
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "mxnet_tpu_c_predict_api.h"
+
+#define CHECK(x)                                                        \
+  do {                                                                  \
+    if ((x) != 0) {                                                     \
+      fprintf(stderr, "FAILED %s:%d: %s\n", __FILE__, __LINE__,         \
+              MXGetLastError());                                        \
+      exit(1);                                                          \
+    }                                                                   \
+  } while (0)
+
+static char *read_file(const char *path, long *size) {
+  FILE *f = fopen(path, "rb");
+  if (!f) {
+    fprintf(stderr, "cannot open %s\n", path);
+    exit(1);
+  }
+  fseek(f, 0, SEEK_END);
+  *size = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  char *buf = (char *)malloc(*size + 1);
+  if (fread(buf, 1, *size, f) != (size_t)*size) {
+    fprintf(stderr, "short read on %s\n", path);
+    exit(1);
+  }
+  buf[*size] = 0;
+  fclose(f);
+  return buf;
+}
+
+int main(int argc, char **argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s <prefix>\n", argv[0]);
+    return 1;
+  }
+  char path[1024];
+  long sym_size, param_size;
+  snprintf(path, sizeof(path), "%s-symbol.json", argv[1]);
+  char *sym_json = read_file(path, &sym_size);
+  snprintf(path, sizeof(path), "%s.params", argv[1]);
+  char *params = read_file(path, &param_size);
+
+  const char *input_keys[] = {"data"};
+  const mx_uint indptr[] = {0, 2};
+  const mx_uint shape_data[] = {4, 3};
+
+  /* the NDList API must parse the same blob */
+  NDListHandle ndlist;
+  CHECK(MXNDListCreate(params, (int)param_size, &ndlist));
+
+  PredictorHandle pred;
+  CHECK(MXPredCreate(sym_json, params, (int)param_size, 1 /* cpu */, 0, 1,
+                     input_keys, indptr, shape_data, &pred));
+
+  mx_uint *oshape, ondim;
+  CHECK(MXPredGetOutputShape(pred, 0, &oshape, &ondim));
+  if (ondim != 2 || oshape[0] != 4) {
+    fprintf(stderr, "unexpected output shape ndim=%u\n", ondim);
+    return 1;
+  }
+  mx_uint ncls = oshape[1];
+
+  float input[12];
+  for (int i = 0; i < 12; ++i) input[i] = (float)(i % 3) - 1.0f;
+  CHECK(MXPredSetInput(pred, "data", input, 12));
+  CHECK(MXPredForward(pred));
+
+  float *out = (float *)malloc(4 * ncls * sizeof(float));
+  CHECK(MXPredGetOutput(pred, 0, out, 4 * ncls));
+
+  /* each row must be a probability distribution */
+  for (int r = 0; r < 4; ++r) {
+    float s = 0;
+    int am = 0;
+    for (mx_uint c = 0; c < ncls; ++c) {
+      s += out[r * ncls + c];
+      if (out[r * ncls + c] > out[r * ncls + am]) am = (int)c;
+    }
+    if (s < 0.99f || s > 1.01f) {
+      fprintf(stderr, "row %d does not sum to 1 (%f)\n", r, s);
+      return 1;
+    }
+    printf("row %d argmax %d\n", r, am);
+  }
+
+  CHECK(MXPredFree(pred));
+  CHECK(MXNDListFree(ndlist));
+  free(sym_json);
+  free(params);
+  free(out);
+  printf("PREDICT OK\n");
+  return 0;
+}

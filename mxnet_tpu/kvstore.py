@@ -199,6 +199,12 @@ class KVStore:
     def barrier(self):
         pass
 
+    def num_dead_node(self, node_id=0, timeout_sec=60):
+        """Count of unreachable nodes (reference KVStore::get_num_dead_node,
+        include/mxnet/kvstore.h:338).  Local stores have no peers; the dist
+        store probes the jax.distributed client."""
+        return 0
+
     def save_optimizer_states(self, fname, dump_optimizer=False):
         assert self._updater is not None, "Cannot save states for distributed training"
         with open(fname, "wb") as f:
@@ -287,6 +293,32 @@ class KVStoreTPUDist(KVStore):
     def barrier(self):
         from .parallel import barrier as _barrier
         _barrier()
+
+    _dead_probe_counter = 0
+
+    def num_dead_node(self, node_id=0, timeout_sec=60):
+        """Reference kvstore.h:338 (ps-lite heartbeat count).  In the TPU
+        failure model the coordination service heartbeats peers itself and
+        FAILS collectives when one dies (the launcher then tears the job
+        down; recovery = checkpoint restart, SURVEY §5.3) — so a healthy
+        store always reports 0.  This probe validates the coordinator is
+        reachable with a key-value roundtrip; an unreachable coordinator
+        counts as one dead node.  No collectives are issued (a timed-out
+        side-thread barrier would desynchronize later collectives)."""
+        if self.num_workers <= 1:
+            return 0
+        try:
+            from jax._src import distributed
+            client = getattr(distributed.global_state, "client", None)
+            if client is None:
+                return 0
+            KVStoreTPUDist._dead_probe_counter += 1
+            key = "mxt_dead_probe/%d/%d" % (self.rank,
+                                            self._dead_probe_counter)
+            client.key_value_set(key, "1")
+            return 0
+        except Exception:
+            return 1
 
     def _reduce(self, k, vlist):
         merged = super()._reduce(k, vlist)

@@ -154,7 +154,8 @@ def _correlation(attrs, data1, data2):
             if attrs.is_multiply:
                 prod = (f1 * shifted).sum(axis=1)          # (n, hp, wp)
             else:
-                prod = -jnp.abs(f1 - shifted).sum(axis=1)
+                # reference correlation-inl.h subtract mode: sum |a - b|
+                prod = jnp.abs(f1 - shifted).sum(axis=1)
             # window sum over the k x k kernel (valid), then subsample the
             # strided output grid starting at the displacement border
             if k > 1:

@@ -271,3 +271,162 @@ def test_simple_bind_over_abi(capi_lib):
                                       ctypes.byref(outs)))
     assert n_out.value == 1
     _check(lib, lib.MXExecutorFree(exe))
+
+
+def test_c_predict_api(capi_lib, tmp_path):
+    """capi/test_predict.c: save a checkpoint from python, then a real C
+    program loads and scores it through MXPred* (reference
+    c_predict_api.h / amalgamation deployment role)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.ndarray import serialization
+    rs = np.random.RandomState(0)
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc", num_hidden=5)
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    prefix = str(tmp_path / "model")
+    net.save(prefix + "-symbol.json")
+    serialization.save(prefix + ".params", {
+        "arg:fc_weight": mx.nd.array(rs.rand(5, 3).astype(np.float32)),
+        "arg:fc_bias": mx.nd.array(rs.rand(5).astype(np.float32))})
+    exe = os.path.join(CAPI, "build", "test_predict")
+    assert os.path.isfile(exe)
+    env = dict(os.environ, MXNET_TPU_HOME=REPO)
+    r = subprocess.run([exe, prefix], capture_output=True, text=True,
+                       env=env, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "PREDICT OK" in r.stdout
+
+
+def test_c_autograd_and_cachedop(capi_lib):
+    """MXAutograd* + MXCreateCachedOp/MXInvokeCachedOp over ctypes."""
+    lib = capi_lib
+    ctypes_arr = (ctypes.c_uint * 1)(3)
+
+    def make_nd(vals):
+        h = ctypes.c_void_p()
+        _check(lib, lib.MXNDArrayCreate(ctypes_arr, 1, 1, 0, 0,
+                                        ctypes.byref(h)))
+        host = np.asarray(vals, np.float32)
+        _check(lib, lib.MXNDArraySyncCopyFromCPU(
+            h, host.ctypes.data_as(ctypes.c_void_p), 3))
+        return h
+
+    def read_nd(h):
+        out = np.zeros(3, np.float32)
+        _check(lib, lib.MXNDArraySyncCopyToCPU(
+            h, out.ctypes.data_as(ctypes.c_void_p), 3))
+        return out
+
+    x = make_nd([1., 2., 3.])
+    g = make_nd([0., 0., 0.])
+    reqs = (ctypes.c_uint * 1)(1)
+    vars_ = (ctypes.c_void_p * 1)(x)
+    grads = (ctypes.c_void_p * 1)(g)
+    _check(lib, lib.MXAutogradMarkVariables(1, vars_, reqs, grads))
+    prev = ctypes.c_int()
+    _check(lib, lib.MXAutogradSetIsRecording(1, ctypes.byref(prev)))
+
+    # y = square(x) via imperative invoke
+    creators = ctypes.POINTER(ctypes.c_void_p)()
+    ncr = ctypes.c_uint()
+    _check(lib, lib.MXSymbolListAtomicSymbolCreators(ctypes.byref(ncr),
+                                                     ctypes.byref(creators)))
+    sq = None
+    name = ctypes.c_char_p()
+    for i in range(ncr.value):
+        _check(lib, lib.MXSymbolGetAtomicSymbolName(
+            ctypes.c_void_p(creators[i]), ctypes.byref(name)))
+        if name.value == b"square":
+            sq = ctypes.c_void_p(creators[i])
+            break
+    assert sq is not None
+    n_out = ctypes.c_int(0)
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    ins = (ctypes.c_void_p * 1)(x)
+    _check(lib, lib.MXImperativeInvoke(sq, 1, ins, ctypes.byref(n_out),
+                                       ctypes.byref(outs), 0, None, None))
+    _check(lib, lib.MXAutogradSetIsRecording(0, ctypes.byref(prev)))
+    heads = (ctypes.c_void_p * 1)(outs[0])
+    _check(lib, lib.MXAutogradBackward(1, heads, None, 0))
+    np.testing.assert_allclose(read_nd(g), [2., 4., 6.])
+
+    # grad handle retrievable through the ABI
+    gh = ctypes.c_void_p()
+    _check(lib, lib.MXNDArrayGetGrad(x, ctypes.byref(gh)))
+    np.testing.assert_allclose(read_nd(gh), [2., 4., 6.])
+
+    # CachedOp: fc symbol invoked with raw inputs
+    json_sym = None
+    import mxnet_tpu as mx
+    net = mx.sym.square(mx.sym.Variable("a"))
+    sym_h = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolCreateFromJSON(net.tojson().encode(),
+                                           ctypes.byref(sym_h)))
+    cop = ctypes.c_void_p()
+    _check(lib, lib.MXCreateCachedOp(sym_h, ctypes.byref(cop)))
+    n_out2 = ctypes.c_int(0)
+    outs2 = ctypes.POINTER(ctypes.c_void_p)()
+    ins2 = (ctypes.c_void_p * 1)(x)
+    _check(lib, lib.MXInvokeCachedOp(cop, 1, ins2, ctypes.byref(n_out2),
+                                     ctypes.byref(outs2)))
+    assert n_out2.value == 1
+    np.testing.assert_allclose(read_nd(outs2[0]), [1., 4., 9.])
+    _check(lib, lib.MXFreeCachedOp(cop))
+
+
+def test_c_sparse_and_raw_bytes(capi_lib):
+    lib = capi_lib
+    import mxnet_tpu as mx
+    # raw bytes roundtrip
+    h = ctypes.c_void_p()
+    shape = (ctypes.c_uint * 2)(2, 2)
+    _check(lib, lib.MXNDArrayCreate(shape, 2, 1, 0, 0, ctypes.byref(h)))
+    host = np.arange(4, dtype=np.float32)
+    _check(lib, lib.MXNDArraySyncCopyFromCPU(
+        h, host.ctypes.data_as(ctypes.c_void_p), 4))
+    size = ctypes.c_size_t()
+    buf = ctypes.c_char_p()
+    _check(lib, lib.MXNDArraySaveRawBytes(h, ctypes.byref(size),
+                                          ctypes.byref(buf)))
+    raw = ctypes.string_at(buf, size.value)
+    h2 = ctypes.c_void_p()
+    _check(lib, lib.MXNDArrayLoadFromRawBytes(raw, len(raw),
+                                              ctypes.byref(h2)))
+    out = np.zeros(4, np.float32)
+    _check(lib, lib.MXNDArraySyncCopyToCPU(
+        h2, out.ctypes.data_as(ctypes.c_void_p), 4))
+    np.testing.assert_allclose(out, host)
+    # sparse creation + aux introspection
+    hs = ctypes.c_void_p()
+    sshape = (ctypes.c_uint * 2)(4, 3)
+    _check(lib, lib.MXNDArrayCreateSparseEx(1, sshape, 2, 1, 0, 0, 0, 0,
+                                            None, None, None,
+                                            ctypes.byref(hs)))
+    st = ctypes.c_int()
+    _check(lib, lib.MXNDArrayGetStorageType(hs, ctypes.byref(st)))
+    assert st.value == 1      # row_sparse
+    at = ctypes.c_int()
+    _check(lib, lib.MXNDArrayGetAuxType(hs, 0, ctypes.byref(at)))
+    assert at.value == 6      # int64 indices
+
+
+def test_c_misc_abi_surface(capi_lib):
+    lib = capi_lib
+    prev = ctypes.c_int()
+    _check(lib, lib.MXEngineSetBulkSize(32, ctypes.byref(prev)))
+    _check(lib, lib.MXSetNumOMPThreads(2))
+    ret = ctypes.c_int()
+    _check(lib, lib.MXKVStoreIsWorkerNode(ctypes.byref(ret)))
+    assert ret.value == 1
+    _check(lib, lib.MXKVStoreIsServerNode(ctypes.byref(ret)))
+    assert ret.value == 0
+    # legacy function API: square via MXFuncInvoke
+    fh = ctypes.c_void_p()
+    _check(lib, lib.MXGetFunction(b"square", ctypes.byref(fh)))
+    nu = ctypes.c_uint(); ns = ctypes.c_uint(); nm = ctypes.c_uint()
+    tm = ctypes.c_int()
+    _check(lib, lib.MXFuncDescribe(fh, ctypes.byref(nu), ctypes.byref(ns),
+                                   ctypes.byref(nm), ctypes.byref(tm)))
+    assert nu.value == 1
+    # Rtc is documented-unsupported and must fail loudly, not crash
+    assert lib.MXRtcFree(None) != 0

@@ -465,3 +465,16 @@ def test_all_public_ops_covered():
     # alias groups count as covered if their canonical name is
     missing = sorted(n for n in canonical if n not in covered)
     assert not missing, "untested public ops: %s" % missing
+
+
+def test_correlation_subtract_mode():
+    """is_multiply=False is the |a-b| cost volume (positive, reference
+    correlation-inl.h subtract mode)."""
+    a = mx.nd.array(np.ones((1, 1, 3, 3), np.float32))
+    b = mx.nd.array(np.zeros((1, 1, 3, 3), np.float32))
+    out = mx.nd.Correlation(a, b, kernel_size=1, max_displacement=0,
+                            is_multiply=False)
+    np.testing.assert_allclose(out.asnumpy(), np.ones((1, 1, 3, 3)))
+    out2 = mx.nd.Correlation(a, a, kernel_size=1, max_displacement=0,
+                             is_multiply=False)
+    np.testing.assert_allclose(out2.asnumpy(), np.zeros((1, 1, 3, 3)))
