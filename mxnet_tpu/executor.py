@@ -27,7 +27,80 @@ from .ops import shape_hints  # installs infer_params hooks  # noqa: F401
 from .symbol.symbol import Node, NodeEntry, Symbol, _topo_order
 from . import rng as _rng
 
-__all__ = ["Executor", "GraphProgram", "infer_shapes", "infer_types"]
+__all__ = ["Executor", "GraphProgram", "infer_shapes", "infer_types",
+           "set_backward_mirror", "backward_mirror_policy"]
+
+
+# ---------------------------------------------------------------------------
+# Activation-memory mirroring (MXNET_BACKWARD_DO_MIRROR analog).
+#
+# The reference recomputes cheap forward nodes during backward instead of
+# keeping their activations (src/executor/graph_executor.cc:253-311,
+# docs/faq/env_var.md:89-94), trading ~30-50% activation memory for ~5% step
+# time.  TPU-native analog: jax.checkpoint (remat) around the whole forward,
+# with an XLA rematerialisation policy choosing what to keep:
+#
+#   'none'          - keep every activation (no remat)
+#   'dots'          - keep matmul/conv outputs, recompute elementwise/norm
+#                     chains (closest to the reference mirror heuristic)
+#   'dots_no_batch' - keep only weight-style matmuls (no batch dims)
+#   'full'          - keep nothing; recompute the entire forward in backward
+#
+# Selection: set_backward_mirror(policy) > MXNET_TPU_REMAT_POLICY >
+# MXNET_BACKWARD_DO_MIRROR=1 (maps to 'dots').
+# ---------------------------------------------------------------------------
+
+_mirror_override: Optional[str] = None
+
+
+def set_backward_mirror(policy: Optional[str]):
+    """Select the activation-remat policy programmatically.
+
+    policy: 'none' | 'dots' | 'dots_no_batch' | 'full' | None (None defers
+    back to the MXNET_TPU_REMAT_POLICY / MXNET_BACKWARD_DO_MIRROR env vars).
+    """
+    global _mirror_override
+    if policy is not None and policy not in _REMAT_POLICIES:
+        raise ValueError("unknown remat policy %r (choose from %s)"
+                         % (policy, sorted(_REMAT_POLICIES)))
+    _mirror_override = policy
+
+
+def backward_mirror_policy() -> str:
+    """Resolve the active remat policy name."""
+    import os
+    if _mirror_override is not None:
+        return _mirror_override
+    env = os.environ.get("MXNET_TPU_REMAT_POLICY")
+    if env:
+        if env not in _REMAT_POLICIES:
+            import warnings
+            warnings.warn("MXNET_TPU_REMAT_POLICY=%r is not one of %s; "
+                          "remat stays off" % (env, sorted(_REMAT_POLICIES)))
+            return "none"
+        return env
+    if os.environ.get("MXNET_BACKWARD_DO_MIRROR", "0") not in ("0", ""):
+        return "dots"
+    return "none"
+
+
+def _remat_wrap(fn, policy: str):
+    """Wrap a pure forward fn in jax.checkpoint per the named policy."""
+    if policy == "none":
+        return fn
+    xla_policy = _REMAT_POLICIES[policy]()
+    if xla_policy is None:   # 'full': keep nothing (jax.checkpoint default)
+        return jax.checkpoint(fn)
+    return jax.checkpoint(fn, policy=xla_policy)
+
+
+_REMAT_POLICIES = {
+    "none": lambda: None,
+    "full": lambda: None,
+    "dots": lambda: jax.checkpoint_policies.dots_saveable,
+    "dots_no_batch":
+        lambda: jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+}
 
 
 def batch_hint_from(arg_map: Dict[str, Any], arg_names: Sequence[str]):
@@ -130,9 +203,13 @@ class GraphProgram:
             return self.evaluate(args, aux, keys, train)
         return jax.jit(f)
 
-    @functools.lru_cache(maxsize=None)
     def _jit_fwd_bwd(self, train: bool, grad_mask: tuple):
         """One XLA computation: outputs + grads of selected args + new aux."""
+        return self._jit_fwd_bwd_impl(train, grad_mask,
+                                      backward_mirror_policy())
+
+    @functools.lru_cache(maxsize=None)
+    def _jit_fwd_bwd_impl(self, train: bool, grad_mask: tuple, remat: str):
         def f(args, aux, keys, out_cots):
             diff_args = [a for a, m in zip(args, grad_mask) if m]
 
@@ -142,7 +219,8 @@ class GraphProgram:
                 outs, new_aux = self.evaluate(full, aux, keys, train)
                 return outs, new_aux
 
-            (outs, new_aux), vjp = jax.vjp(split_fn, diff_args)
+            (outs, new_aux), vjp = jax.vjp(_remat_wrap(split_fn, remat),
+                                           diff_args)
             zero_aux = tuple(jnp.zeros_like(a) for a in new_aux)
             (grads,) = vjp((tuple(out_cots), zero_aux))
             return outs, new_aux, grads

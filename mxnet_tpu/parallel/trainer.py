@@ -65,6 +65,8 @@ class ShardedTrainer:
         self.wd = wd
         self.param_dtype = param_dtype
         self._step = None
+        from ..executor import backward_mirror_policy
+        self._built_remat = backward_mirror_policy()
         # tensor parallelism: the tp mesh axis (auto-detected) + per-var
         # __shard__ annotations from the Symbol graph
         tp = spec.tp_axis
@@ -226,6 +228,9 @@ class ShardedTrainer:
             loss = sum(jnp.sum(o.astype(jnp.float32)) for o in outs)
             return loss, (outs, new_aux)
 
+        from ..executor import _remat_wrap
+        loss_fn = _remat_wrap(loss_fn, self._built_remat)
+
         def step_fn(params, mom, aux, inputs, keys):
             (loss, (outs, new_aux)), grads = jax.value_and_grad(
                 loss_fn, argnums=0, has_aux=True)(params, inputs, aux, keys)
@@ -258,7 +263,10 @@ class ShardedTrainer:
     def step(self, params, mom, aux, batch: Dict[str, np.ndarray]):
         """One synchronous data-parallel SGD step.  batch arrays are global
         (host) arrays; they get sharded over dp."""
-        if self._step is None:
+        from ..executor import backward_mirror_policy
+        remat = backward_mirror_policy()
+        if self._step is None or remat != self._built_remat:
+            self._built_remat = remat
             self._step = self._build_step()
         inputs = {n: jax.device_put(v, self.spec.batch_sharding())
                   for n, v in batch.items()}

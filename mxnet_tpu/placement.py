@@ -194,8 +194,10 @@ class SegmentedProgram:
 
         grad_mask: {arg_name: bool}; grads returned only for True names.
         """
-        from .executor import batch_hint_from
+        from .executor import (batch_hint_from, _remat_wrap,
+                               backward_mirror_policy)
         batch_hint = batch_hint_from(arg_map, self.prog.arg_names)
+        remat = backward_mirror_policy()
         env: Dict[Tuple[int, int], object] = {}
         for key, (kind, name) in self.var_entries.items():
             src = arg_map if kind == "arg" else aux_map
@@ -208,7 +210,8 @@ class SegmentedProgram:
             ins = tuple(jax.device_put(env[k], seg.device)
                         for k in seg.in_entries)
             if grad_mask is not None:
-                outs, vjp = jax.vjp(lambda i: fn(i, kslice), ins)
+                seg_fwd = _remat_wrap(lambda i: fn(i, kslice), remat)
+                outs, vjp = jax.vjp(seg_fwd, ins)
                 vjps.append(vjp)
             else:
                 outs = fn(ins, kslice)
