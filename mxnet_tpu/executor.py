@@ -14,6 +14,7 @@ PlaceDevice/ctx_group → sharding annotations (see parallel/).
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 import jax
@@ -171,11 +172,36 @@ class GraphProgram:
     def evaluate(self, arg_arrays: Sequence, aux_arrays: Sequence,
                  keys, train: bool):
         """Pure evaluation. Returns (outputs, new_aux)."""
+        outputs, new_aux, _ = self._evaluate_impl(
+            arg_arrays, aux_arrays, keys, train, tap=False)
+        return outputs, new_aux
+
+    def tap_names(self):
+        """Names of every non-variable node output, in topo order — the
+        per-node tap points the reference monitor sees
+        (graph_executor.cc:121 invokes the callback on every op output)."""
+        names = []
+        for node in self.nodes:
+            if node.is_var:
+                continue
+            n_vis = node.op.num_visible_outputs(node.parsed_attrs())
+            if n_vis == 1:
+                names.append(node.name + "_output")
+            else:
+                # multi-output nodes number every output, matching
+                # Symbol.list_outputs ("<name>_output0", "<name>_output1", …)
+                names.extend(node.name + "_output%d" % i
+                             for i in range(n_vis))
+        return names
+
+    def _evaluate_impl(self, arg_arrays, aux_arrays, keys, train: bool,
+                       tap: bool):
         arg_map = dict(zip(self.arg_names, arg_arrays))
         aux_map = dict(zip(self.aux_names, aux_arrays))
         batch_hint = batch_hint_from(arg_map, self.arg_names)
         key_idx = 0
         raw: Dict[int, tuple] = {}
+        taps = []
         for node in self.nodes:
             if node.is_var:
                 kind = self.var_kind[id(node)]
@@ -188,19 +214,29 @@ class GraphProgram:
                 ins = [keys[key_idx]] + ins
                 key_idx += 1
             out = node.op.fn(attrs, *ins)
-            raw[id(node)] = out if isinstance(out, tuple) else (out,)
+            out = out if isinstance(out, tuple) else (out,)
+            raw[id(node)] = out
+            if tap:
+                taps.extend(out[:node.op.num_visible_outputs(attrs)])
         outputs = [raw[id(e.node)][e.index] for e in self.symbol._entries]
         new_aux = list(aux_arrays)
         aux_pos = {n: i for i, n in enumerate(self.aux_names)}
         for aux_name, node, i_out in self.aux_updates:
             new_aux[aux_pos[aux_name]] = raw[id(node)][i_out]
-        return tuple(outputs), tuple(new_aux)
+        return tuple(outputs), tuple(new_aux), tuple(taps)
 
     # jitted entry points -------------------------------------------------
     @functools.lru_cache(maxsize=None)
     def _jit_forward(self, train: bool):
         def f(args, aux, keys):
             return self.evaluate(args, aux, keys, train)
+        return jax.jit(f)
+
+    @functools.lru_cache(maxsize=None)
+    def _jit_forward_tapped(self, train: bool):
+        """Forward that also returns every node output (monitor support)."""
+        def f(args, aux, keys):
+            return self._evaluate_impl(args, aux, keys, train, tap=True)
         return jax.jit(f)
 
     def _jit_fwd_bwd(self, train: bool, grad_mask: tuple):
@@ -410,6 +446,7 @@ class Executor:
 
         self.outputs: List[NDArray] = []
         self._monitor_callback = None
+        self._monitor_all = False
         self._last_keys = None  # RNG keys of the last forward, for backward
 
         # ctx_group model parallelism: if the symbol carries grouped nodes
@@ -479,6 +516,21 @@ class Executor:
                 out_mask.append(False)
         return tuple(grads), tuple(out_mask)
 
+    def _prof_tic(self):
+        from . import profiler as _prof
+        return time.perf_counter() * 1e6 if _prof.is_running() else None
+
+    def _prof_toc(self, t0, suffix, results):
+        """Record one timed executor-step event (true wall time: profile
+        mode syncs on the result, matching the reference engine timing)."""
+        if t0 is None:
+            return
+        from . import profiler as _prof
+        jax.block_until_ready(results)
+        name = (self._symbol.name or "graph") + suffix
+        _prof.record_event(name, t0, time.perf_counter() * 1e6 - t0,
+                           cat="symbolic")
+
     def _seg_forward(self, args, aux, keys, is_train):
         """Forward through the segmented (ctx_group) program; aux returned
         in aux_names order."""
@@ -501,19 +553,24 @@ class Executor:
             # only a train forward defines the mask backward must reuse; an
             # interleaved eval forward (monitor/validation) must not clobber it
             self._last_keys = keys
+        taps = None
+        t0 = self._prof_tic()
         if self._seg is not None:
             outs, new_aux = self._seg_forward(args, aux, keys, is_train)
+        elif self._monitor_active() and self._monitor_all:
+            outs, new_aux, taps = self._prog._jit_forward_tapped(
+                bool(is_train))(args, aux, keys)
         else:
             fn = self._prog._jit_forward(bool(is_train))
             outs, new_aux = fn(args, aux, keys)
+        self._prof_toc(t0, "_forward", outs)
         if is_train:
             for nd_, na in zip(self.aux_arrays, new_aux):
                 nd_._handle = na
         self.outputs = [NDArray(o) for o in outs]
-        if self._monitor_callback is not None:
-            names = self._symbol.list_outputs()
-            for n, o in zip(names, self.outputs):
-                self._monitor_callback(n, o)
+        if self._monitor_active():
+            self._fire_monitor(args, aux, keys, is_train, self.outputs,
+                               taps=taps)
         return self.outputs
 
     def _write_grads(self, grads, mask):
@@ -557,6 +614,7 @@ class Executor:
                 out_grads = [out_grads]
             cots = tuple(g._handle if isinstance(g, NDArray) else jnp.asarray(g)
                          for g in out_grads)
+        t0 = self._prof_tic()
         if self._seg is not None:
             gm = dict(zip(self._prog.arg_names, mask))
             _, _, gmap = self._seg.run(dict(zip(self._prog.arg_names, args)),
@@ -567,6 +625,7 @@ class Executor:
         else:
             fn = self._prog._jit_fwd_bwd(bool(is_train), mask)
             _, _, grads = fn(args, aux, keys, cots)
+        self._prof_toc(t0, "_backward", grads)
         self._write_grads(grads, mask)
 
     def run_fwd_bwd(self, out_cots=None, is_train=True):
@@ -579,6 +638,7 @@ class Executor:
         aux = tuple(a._handle for a in self.aux_arrays)
         keys = self._keys()
         self._last_keys = keys
+        t0 = self._prof_tic()
         if not any(mask):
             if self._seg is not None:
                 # aux handles live on segment devices after a segmented step;
@@ -612,9 +672,12 @@ class Executor:
         if is_train:
             for nd_, na in zip(self.aux_arrays, new_aux):
                 nd_._handle = na
+        self._prof_toc(t0, "_fwd_bwd", (outs, grads))
         self.outputs = [NDArray(o) for o in outs]
         if grads:
             self._write_grads(grads, mask)
+        if self._monitor_active():
+            self._fire_monitor(args, aux, keys, is_train, self.outputs)
         return self.outputs
 
     # -- misc API parity -------------------------------------------------
@@ -656,8 +719,36 @@ class Executor:
                         grad_req=self.grad_req, aux_states=self.aux_dict,
                         program=self._prog)
 
-    def set_monitor_callback(self, callback):
+    def set_monitor_callback(self, callback, monitor_all=False):
+        """Install a (name, NDArray) callback fired after each forward.
+
+        monitor_all=False taps only graph outputs; True taps EVERY node
+        output (the reference graph_executor.cc:121 behavior) by running
+        the instrumented forward program."""
         self._monitor_callback = callback
+        self._monitor_all = monitor_all
+
+    def _monitor_active(self):
+        if self._monitor_callback is None:
+            return False
+        gate = getattr(self._monitor_callback, "monitor_active", None)
+        return gate() if gate is not None else True
+
+    def _fire_monitor(self, args, aux, keys, is_train, outs, taps=None):
+        """Invoke the monitor callback on outputs, or on every node output
+        when monitor_all.  taps: precomputed node outputs from a tapped
+        forward; when absent under monitor_all an extra tapped forward runs
+        (monitor is a debug tool and Monitor.tic gates it to every Nth
+        batch)."""
+        if self._monitor_all and self._seg is None:
+            if taps is None:
+                _, _, taps = self._prog._jit_forward_tapped(bool(is_train))(
+                    args, aux, keys)
+            for n, t in zip(self._prog.tap_names(), taps):
+                self._monitor_callback(n, NDArray(t))
+        else:
+            for n, o in zip(self._symbol.list_outputs(), outs):
+                self._monitor_callback(n, o)
 
     def debug_str(self):
         return self._symbol.debug_str()

@@ -91,6 +91,11 @@ class KVStore:
     def push(self, key, value, priority=0):
         """Reduce value(s) into the store; run updater if set (reference
         KVStoreLocal::PushImpl kvstore_local.h:159)."""
+        from . import profiler
+        with profiler.Scope("kvstore_push", cat="kvstore"):
+            self._push(key, value, priority)
+
+    def _push(self, key, value, priority=0):
         keys, values = self._normalize_push(key, value)
         for k, vlist in zip(keys, values):
             merged = self._reduce(k, vlist)
@@ -115,6 +120,11 @@ class KVStore:
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         """Broadcast the stored value to each out array, keeping each on its
         own device (the Comm::Broadcast analog, comm.h)."""
+        from . import profiler
+        with profiler.Scope("kvstore_pull", cat="kvstore"):
+            self._pull(key, out, priority, ignore_sparse)
+
+    def _pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys, outs = self._normalize_push(key, out)
         for k, olist in zip(keys, outs):
             src = self._store[k]

@@ -53,6 +53,11 @@ set_config = profiler_set_config
 set_state = profiler_set_state
 
 
+def is_running() -> bool:
+    """Fast gate for instrumented dispatch paths (zero-cost when off)."""
+    return _state["running"]
+
+
 def record_event(name: str, start_us: float, dur_us: float, cat="operator"):
     """Append one op event (called by instrumented dispatch paths)."""
     if not _state["running"]:

@@ -1,0 +1,167 @@
+"""Profiler op-event and per-node Monitor tests.
+
+Reference analogs: src/engine/profiler.cc:147 (chrome trace with per-op
+events) and src/executor/graph_executor.cc:121 (monitor callback invoked on
+every node output — the tool for finding the exploding/NaN layer).
+"""
+import json
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu import profiler
+
+
+def test_profiler_records_op_and_executor_events(tmp_path):
+    fname = str(tmp_path / "profile.json")
+    profiler.set_config(filename=fname)
+    profiler.set_state("run")
+    try:
+        # imperative ops
+        a = nd.ones((4, 4))
+        b = (a * 2 + 1).asnumpy()
+        # symbolic executor fwd + bwd
+        x = sym.Variable("x")
+        net = sym.FullyConnected(x, num_hidden=3, name="fc")
+        ex = net.simple_bind(mx.cpu(), x=(2, 5))
+        ex.forward(is_train=True)
+        ex.backward(out_grads=nd.ones((2, 3)))
+        # kvstore push/pull
+        kv = mx.kv.create("local")
+        kv.init(0, nd.zeros((3,)))
+        kv.push(0, nd.ones((3,)))
+        out = nd.zeros((3,))
+        kv.pull(0, out=out)
+    finally:
+        profiler.set_state("stop")
+    path = profiler.dump_profile()
+    with open(path) as f:
+        trace = json.load(f)
+    events = trace["traceEvents"]
+    assert events, "trace must not be empty"
+    names = {e["name"] for e in events}
+    cats = {e["cat"] for e in events}
+    assert any("_mul_scalar" in n or "_plus_scalar" in n for n in names), names
+    assert "fc_forward" in names or any(n.endswith("_forward") for n in names)
+    assert any(n.endswith("_backward") for n in names)
+    assert "kvstore_push" in names and "kvstore_pull" in names
+    assert "operator" in cats and "symbolic" in cats
+    for e in events:
+        assert e["dur"] >= 0 and e["ph"] == "X"
+
+
+def test_profiler_records_fit_batches(tmp_path):
+    fname = str(tmp_path / "profile_fit.json")
+    profiler.set_config(filename=fname)
+    rng = np.random.RandomState(0)
+    x = rng.rand(32, 8).astype(np.float32)
+    y = rng.randint(0, 2, 32).astype(np.float32)
+    net = sym.Variable("data")
+    net = sym.FullyConnected(net, num_hidden=2)
+    net = sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    it = mx.io.NDArrayIter(x, y, batch_size=8, label_name="softmax_label")
+    profiler.set_state("run")
+    try:
+        mod.fit(it, num_epoch=1, optimizer_params={"learning_rate": 0.1})
+    finally:
+        profiler.set_state("stop")
+    with open(profiler.dump_profile()) as f:
+        events = json.load(f)["traceEvents"]
+    batch_events = [e for e in events if e["cat"] == "batch"]
+    assert len(batch_events) == 4, [e["name"] for e in batch_events]
+
+
+def test_monitor_all_taps_every_node_and_finds_nan():
+    """fc1 produces negatives -> log() produces NaNs -> fc2 hides them in
+    the final output magnitude.  Per-node monitoring must finger the log
+    layer by name."""
+    data = sym.Variable("data")
+    h = sym.FullyConnected(data, num_hidden=4, name="fc1")
+    bad = sym.log(h, name="badlog")
+    net = sym.FullyConnected(bad, num_hidden=2, name="fc2")
+
+    def nan_stat(arr):
+        return nd.array([float(np.isnan(arr.asnumpy()).any())])
+
+    mon = mx.mon.Monitor(interval=1, stat_func=nan_stat, monitor_all=True)
+    ex = net.simple_bind(mx.cpu(), data=(3, 5))
+    rng = np.random.RandomState(0)
+    for arr in ex.arg_arrays:
+        arr[:] = nd.array(rng.normal(0, 1, arr.shape))
+    mon.install(ex)
+    mon.tic()
+    ex.forward(is_train=True)
+    res = mon.toc()
+    stats = {k: float(v) for _, k, v in
+             [(n, k, s.strip().split("\t")[0]) for n, k, s in res]}
+    assert "badlog_output" in stats, sorted(stats)
+    assert "fc1_output" in stats and "fc2_output" in stats
+    assert stats["badlog_output"] == 1.0   # NaN born here
+    assert stats["fc1_output"] == 0.0      # clean before
+
+
+def test_monitor_all_fires_on_fused_module_path():
+    """Module.fit uses the fused run_fwd_bwd; monitor_all must still tap
+    per-node outputs there."""
+    rng = np.random.RandomState(0)
+    x = rng.rand(16, 6).astype(np.float32)
+    y = rng.randint(0, 2, 16).astype(np.float32)
+    net = sym.Variable("data")
+    net = sym.FullyConnected(net, num_hidden=3, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="act1")
+    net = sym.FullyConnected(net, num_hidden=2, name="fc2")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    seen = []
+
+    def stat(arr):
+        return nd.array([1.0])
+
+    mon = mx.mon.Monitor(interval=1, stat_func=stat, monitor_all=True)
+    orig_helper = mon.stat_helper
+
+    def spy(name, arr):
+        seen.append(name)
+        orig_helper(name, arr)
+    spy.monitor_active = orig_helper.monitor_active
+    mon.stat_helper = spy
+
+    mod = mx.mod.Module(net, context=mx.cpu())
+    it = mx.io.NDArrayIter(x, y, batch_size=8, label_name="softmax_label")
+    mod.fit(it, num_epoch=1, monitor=mon,
+            optimizer_params={"learning_rate": 0.1})
+    assert "act1_output" in seen and "fc1_output" in seen, sorted(set(seen))
+
+
+def test_monitor_all_multi_output_names_match_list_outputs():
+    """Multi-output nodes must tap under the same names list_outputs uses
+    ("<name>_output0", "<name>_output1", ...)."""
+    data = sym.Variable("data")
+    net = sym.SliceChannel(data, num_outputs=2, name="split0")
+    assert net.list_outputs() == ["split0_output0", "split0_output1"]
+    mon = mx.mon.Monitor(interval=1, monitor_all=True)
+    ex = net.simple_bind(mx.cpu(), data=(2, 4))
+    ex.arg_arrays[0][:] = nd.ones((2, 4))
+    mon.install(ex)
+    mon.tic()
+    ex.forward(is_train=True)
+    tapped = {k for _, k, _ in mon.toc()}
+    assert {"split0_output0", "split0_output1"} <= tapped, sorted(tapped)
+
+
+def test_monitor_outputs_only_default_unchanged():
+    """monitor_all=False (default) keeps the outputs-only contract."""
+    net = sym.Variable("data")
+    net = sym.FullyConnected(net, num_hidden=2, name="fc")
+    ex = net.simple_bind(mx.cpu(), data=(2, 3))
+    for arr in ex.arg_arrays:
+        arr[:] = nd.ones(arr.shape)
+    mon = mx.mon.Monitor(interval=1)
+    mon.install(ex)
+    mon.tic()
+    ex.forward(is_train=True)
+    res = mon.toc()
+    tapped = {k for _, k, _ in res}
+    assert "fc_output" in tapped
