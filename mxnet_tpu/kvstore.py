@@ -342,6 +342,82 @@ class KVStoreTPUDist(KVStore):
         return merged
 
 
+class KVStoreTPUDistAsync(KVStoreTPUDist):
+    """Staleness-tolerant 'dist_async' (reference kvstore_dist_server.h:503
+    applies each worker's push the moment it arrives — no cross-worker
+    gradient aggregation, workers never wait for each other per step).
+
+    A collectives backend has no parameter server to absorb that
+    asynchrony, so it maps to local-update + periodic averaging:
+
+      * push applies the rank-LOCAL gradient to the rank-local weight
+        immediately — no allreduce and no per-step barrier, so a fast rank
+        streams ahead of a slow one;
+      * every MXNET_TPU_ASYNC_AVG_INTERVAL pushes of a key (default 16)
+        the stored weights are averaged across ranks with one psum — the
+        DCN analog of every worker pulling the same server table.
+
+    Divergence semantics: between averaging rounds ranks hold DIFFERENT
+    weights with bounded staleness (= the interval), like async ps-lite
+    with a bounded-delay server.  All ranks must still execute the same
+    number of pushes per key (the averaging collective must line up);
+    rank speed may vary freely in between.  Call sync_weights() before
+    checkpointing to put every rank on the averaged state.
+    """
+
+    def __init__(self, kv_type="dist_async"):
+        super().__init__(kv_type)
+        import os
+        self._avg_interval = int(
+            os.environ.get("MXNET_TPU_ASYNC_AVG_INTERVAL", "16"))
+        self._push_counts: Dict = {}
+
+    def _reduce(self, k, vlist):
+        # local merge only — skip KVStoreTPUDist's cross-worker allreduce
+        return KVStore._reduce(self, k, vlist)
+
+    def _push(self, key, value, priority=0):
+        keys, values = self._normalize_push(key, value)
+        super()._push(keys, values, priority)
+        if self.num_workers <= 1 or self._avg_interval <= 0:
+            return
+        for k in keys:
+            c = self._push_counts.get(k, 0) + 1
+            self._push_counts[k] = c
+            if c % self._avg_interval == 0:
+                self._average_key(k)
+
+    def _average_key(self, k):
+        from .parallel import allreduce_array
+        stored = self._store[k]
+        if isinstance(stored, RowSparseNDArray):
+            # union-sum, then divide each row by HOW MANY ranks hold it
+            # (a row on k<N ranks averaged over N would shrink by k/N)
+            from .parallel import allreduce_row_sparse
+            avg = allreduce_row_sparse(stored)
+            ones = jnp.zeros((stored.shape[0],), jnp.float32)
+            ones = ones.at[jnp.asarray(stored._indices)].set(1.0)
+            counts = allreduce_array(ones)
+            denom = jnp.maximum(counts[jnp.asarray(avg._indices)], 1.0)
+            avg._data = avg._data / denom.reshape(
+                (-1,) + (1,) * (avg._data.ndim - 1))
+            self._store[k] = avg
+        else:
+            stored._handle = allreduce_array(stored._handle) \
+                / self.num_workers
+
+    def sync_weights(self):
+        """Average every stored value across ranks once (collective; all
+        ranks must call).  Use before checkpoint/eval so ranks agree."""
+        if self.num_workers <= 1:
+            return
+        # insertion order is identical across ranks (all ranks init keys in
+        # the same order), so the collectives line up; sorting would break
+        # on mixed int/str keys
+        for k in list(self._store):
+            self._average_key(k)
+
+
 def create(name="local") -> KVStore:
     """reference: src/kvstore/kvstore.cc:40-75 factory."""
     if not isinstance(name, str):
@@ -349,6 +425,8 @@ def create(name="local") -> KVStore:
     if name in ("local", "local_update_cpu", "local_allreduce_cpu",
                 "local_allreduce_device", "device", "nccl", "tpu"):
         return KVStore(name)
+    if name == "dist_async":
+        return KVStoreTPUDistAsync(name)
     if name.startswith("dist"):
         return KVStoreTPUDist(name)
     raise MXNetError("unknown KVStore type %s" % name)

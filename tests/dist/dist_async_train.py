@@ -1,0 +1,96 @@
+"""Multi-process ASYNC data-parallel training through Module.fit with
+kvstore('dist_async') — the reference dist_async mode
+(kvstore_dist_server.h:503: each push applied immediately, workers never
+wait per step).  Here asynchrony = rank-local immediate updates + periodic
+cross-rank weight averaging, so the invariants differ from dist_sync:
+
+ 1. ranks deliberately step at DIFFERENT speeds (per-rank sleep) and must
+    not deadlock — no per-step barrier exists between averaging rounds;
+ 2. training still converges on every rank despite bounded staleness;
+ 3. after kv.sync_weights() all ranks agree exactly (checkpoint contract).
+
+Run:  python tools/launch.py -n 4 python tests/dist/dist_async_train.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+os.environ.setdefault("MXNET_TPU_ASYNC_AVG_INTERVAL", "4")
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import parallel  # noqa: E402
+
+
+def main():
+    parallel.init_distributed()
+    kv = mx.kv.create("dist_async")
+    rank, nworker = kv.rank, kv.num_workers
+    assert type(kv).__name__ == "KVStoreTPUDistAsync"
+
+    rs = np.random.RandomState(0)
+    X = rs.randn(512, 16).astype(np.float32)
+    w_true = rs.randn(16).astype(np.float32)
+    y = (X @ w_true > 0).astype(np.float32)
+    shard = slice(rank * 128, (rank + 1) * 128)
+    it = mx.io.NDArrayIter(X[shard], y[shard], batch_size=32, shuffle=False)
+
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=2, name="fc2")
+    net = mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+    # rank-dependent per-batch delay: rank 3 is 4x slower than rank 0;
+    # async mode must neither deadlock nor stop converging
+    def slow_batch(param):
+        time.sleep(0.002 * rank)
+
+    mod = mx.mod.Module(net, context=mx.cpu())
+    metric = mx.metric.Accuracy()
+    mod.fit(it, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.2},
+            initializer=mx.init.Xavier(),
+            eval_metric=metric, num_epoch=15, kvstore=kv,
+            batch_end_callback=slow_batch)
+
+    # rank-local params converge despite staleness
+    it.reset()
+    metric.reset()
+    mod.score(it, metric)
+    acc = dict(metric.get_name_value())["accuracy"]
+    assert acc > 0.85, "rank %d accuracy %.3f" % (rank, acc)
+
+    kv.sync_weights()
+    # after an explicit sync, every rank must hold identical stored params
+    for k in list(kv._store):
+        mine = kv._store[k].asnumpy().astype(np.float64)
+        total = np.asarray(parallel.allreduce_array(jax.numpy.asarray(mine)))
+        np.testing.assert_allclose(total, mine * nworker, rtol=1e-5,
+                                   err_msg="key %r diverged post-sync" % (k,))
+    # row-sparse averaging: a row held by k<N ranks must be divided by k,
+    # not N (union-sum + per-row holder counts)
+    import jax.numpy as jnp
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+    data = np.full((2, 3), float(rank + 1), np.float32)
+    idx = np.array([0, rank + 1], np.int64)   # row 0: all ranks; row rank+1: one
+    kv._store["rs_probe"] = RowSparseNDArray(
+        jnp.asarray(data), jnp.asarray(idx), (nworker + 1, 3))
+    kv._average_key("rs_probe")
+    dense = kv._store["rs_probe"].asnumpy()
+    np.testing.assert_allclose(dense[0], np.full(3, 2.5), rtol=1e-6)
+    for r in range(nworker):
+        np.testing.assert_allclose(dense[r + 1], np.full(3, r + 1.0),
+                                   rtol=1e-6)
+
+    print("dist_async_train rank %d/%d OK acc=%.3f" % (rank, nworker, acc),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -22,9 +22,11 @@ def _run_dist(script, n=4, timeout=420):
         [sys.executable, LAUNCH, "-n", str(n), sys.executable,
          os.path.join(REPO, "tests", "dist", script)],
         capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO)
-    ok_lines = [l for l in (r.stdout + r.stderr).splitlines() if " OK" in l]
     assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
-    assert len(ok_lines) == n, (ok_lines, r.stderr[-1000:])
+    # count occurrences, not lines: ranks finishing simultaneously can
+    # interleave their stdout writes onto one line
+    n_ok = (r.stdout + r.stderr).count(" OK")
+    assert n_ok == n, (n_ok, r.stdout[-1000:], r.stderr[-500:])
 
 
 def test_dist_sync_kvstore_4proc():
@@ -37,3 +39,10 @@ def test_dist_train_mlp_4proc():
     """Module.fit with kvstore('dist_sync') over 4 ranks: converges and
     all ranks hold identical params (reference dist_lenet.py analog)."""
     _run_dist("dist_train_mlp.py")
+
+
+def test_dist_async_train_4proc():
+    """Module.fit with kvstore('dist_async') over 4 ranks stepping at
+    different speeds: no deadlock, per-rank convergence, identical params
+    after sync_weights (reference kvstore_dist_server.h:503 semantics)."""
+    _run_dist("dist_async_train.py")
