@@ -23,10 +23,16 @@ def _get():
 
 
 def seed(seed_state: int):
-    """mx.random.seed equivalent."""
+    """mx.random.seed equivalent.  Also reseeds the host-side batched
+    image-augmentation generator so augmentation draws are reproducible."""
     s = _get()
     s.key = jax.random.PRNGKey(int(seed_state))
     s.counter = 0
+    try:
+        from .image import image as _image
+        _image.reseed(int(seed_state))
+    except ImportError:
+        pass
 
 
 def next_key():

@@ -1,0 +1,292 @@
+"""Image augmentation + ImageIter/ImageDetIter tests on synthetic JPEGs.
+
+Reference behaviors: python/mxnet/image/image.py:482-873 (augmenters),
+:999 (ImageIter), python/mxnet/image/detection.py (ImageDetIter).  The
+augmenter math here is BATCHED (batch_call over (N,H,W,C)); these tests pin
+it against per-sample closed forms.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image as img_mod
+from mxnet_tpu import nd
+
+LUMA = np.array([0.299, 0.587, 0.114], np.float32)
+
+
+def _batch(n=4, h=8, w=6, seed=0):
+    rs = np.random.RandomState(seed)
+    return rs.uniform(0, 255, (n, h, w, 3)).astype(np.float32)
+
+
+# -- batched augmenter math --------------------------------------------------
+
+def test_brightness_batch_independent_alphas():
+    arr = _batch()
+    rng = np.random.default_rng(1)
+    out = img_mod.BrightnessJitterAug(0.5).batch_call(arr.copy(), rng)
+    # recover per-sample alpha; all pixels of a sample share it, samples differ
+    alphas = out.reshape(4, -1) / arr.reshape(4, -1)
+    per_sample = alphas.mean(axis=1)
+    np.testing.assert_allclose(
+        alphas, np.broadcast_to(per_sample[:, None], alphas.shape),
+        rtol=1e-4)
+    assert np.std(per_sample) > 1e-4, "samples must get independent draws"
+    assert np.all(np.abs(per_sample - 1.0) <= 0.5 + 1e-6)
+
+
+def test_contrast_batch_matches_closed_form():
+    arr = _batch()
+    rng = np.random.default_rng(2)
+    out = img_mod.ContrastJitterAug(0.4).batch_call(arr.copy(), rng)
+    # out = a*x + (1-a)*mean_luma  =>  recover a from any two pixels, then
+    # verify against the sample's own mean luma
+    for i in range(arr.shape[0]):
+        x = arr[i].ravel()
+        y = out[i].ravel()
+        a = (y[0] - y[1]) / (x[0] - x[1])
+        mluma = (arr[i] @ LUMA).mean()
+        np.testing.assert_allclose(y, a * x + (1 - a) * mluma, rtol=1e-3)
+
+
+def test_saturation_batch_matches_closed_form():
+    arr = _batch()
+    rng = np.random.default_rng(3)
+    out = img_mod.SaturationJitterAug(0.4).batch_call(arr.copy(), rng)
+    for i in range(arr.shape[0]):
+        luma = (arr[i] @ LUMA)[..., None]
+        # gray pixels (all channels equal) are fixed points => recover a
+        # from a colored pixel's deviation
+        dev_in = arr[i] - luma
+        dev_out = out[i] - luma
+        nz = np.abs(dev_in) > 1e-3
+        a = (dev_out[nz] / dev_in[nz]).mean()
+        np.testing.assert_allclose(out[i], a * arr[i] + (1 - a) * luma,
+                                   rtol=1e-3, atol=1e-2)
+
+
+def test_hue_zero_is_identity_and_preserves_luma_rotation():
+    arr = _batch()
+    rng = np.random.default_rng(4)
+    out0 = img_mod.HueJitterAug(0.0).batch_call(arr.copy(), rng)
+    # FROM_YIQ @ TO_YIQ is the reference's approximate inverse pair
+    # (identity only to ~0.3% of full scale)
+    np.testing.assert_allclose(out0, arr, rtol=0.2, atol=1.0)
+    out = img_mod.HueJitterAug(0.3).batch_call(arr.copy(), rng)
+    assert not np.allclose(out, arr)
+    # Y (luma) channel of YIQ is invariant under the chroma rotation
+    np.testing.assert_allclose(out @ LUMA, arr @ LUMA, rtol=1e-2, atol=0.5)
+
+
+def test_lighting_shifts_whole_sample_uniformly():
+    arr = _batch()
+    eigval = np.array([55.46, 4.794, 1.148])
+    eigvec = np.random.RandomState(0).normal(size=(3, 3))
+    rng = np.random.default_rng(5)
+    out = img_mod.LightingAug(0.5, eigval, eigvec).batch_call(arr.copy(), rng)
+    shift = out - arr  # every pixel of a sample shifts by the same rgb
+    np.testing.assert_allclose(
+        shift, np.broadcast_to(shift[:, :1, :1, :], shift.shape),
+        rtol=1e-4, atol=1e-3)
+    assert np.std(shift[:, 0, 0, :], axis=0).max() > 1e-4
+
+
+def test_random_gray_all_and_none():
+    arr = _batch()
+    rng = np.random.default_rng(6)
+    out = img_mod.RandomGrayAug(1.0).batch_call(arr.copy(), rng)
+    expect = arr @ np.array([[0.21] * 3, [0.72] * 3, [0.07] * 3], np.float32)
+    np.testing.assert_allclose(out, expect, rtol=1e-4)
+    same = img_mod.RandomGrayAug(0.0).batch_call(arr.copy(), rng)
+    np.testing.assert_allclose(same, arr)
+
+
+def test_flip_batch_and_partial():
+    arr = _batch()
+    rng = np.random.default_rng(7)
+    out = img_mod.HorizontalFlipAug(1.0).batch_call(arr.copy(), rng)
+    np.testing.assert_allclose(out, arr[:, :, ::-1])
+    # partial: each sample either flipped or untouched
+    out2 = img_mod.HorizontalFlipAug(0.5).batch_call(arr.copy(), rng)
+    for i in range(arr.shape[0]):
+        ok = np.allclose(out2[i], arr[i]) or \
+            np.allclose(out2[i], arr[i, :, ::-1])
+        assert ok
+
+
+def test_normalize_and_cast_batch():
+    arr = _batch()
+    rng = np.random.default_rng(8)
+    mean = np.array([10.0, 20.0, 30.0], np.float32)
+    std = np.array([2.0, 4.0, 8.0], np.float32)
+    out = img_mod.ColorNormalizeAug(mean, std).batch_call(arr.copy(), rng)
+    np.testing.assert_allclose(out, (arr - mean) / std, rtol=1e-5)
+    assert img_mod.CastAug().batch_call(arr.astype(np.uint8), rng).dtype \
+        == np.float32
+
+
+def test_seed_makes_batched_draws_reproducible():
+    import mxnet_tpu.image.image as im
+    arr = _batch()
+    mx.random.seed(42)
+    a = img_mod.BrightnessJitterAug(0.5).batch_call(arr.copy(), im._rng)
+    mx.random.seed(42)
+    b = img_mod.BrightnessJitterAug(0.5).batch_call(arr.copy(), im._rng)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_random_gray_single_image_dtype_passthrough():
+    src = nd.array(np.arange(48, dtype=np.uint8).reshape(4, 4, 3),
+                   dtype=np.uint8)
+    out = img_mod.RandomGrayAug(0.0)(src)
+    assert out.dtype == np.uint8 and out is src
+    gray = img_mod.RandomGrayAug(1.0)(src)
+    assert np.allclose(np.ptp(gray.asnumpy(), axis=2), 0, atol=1e-4)
+
+
+def test_single_image_call_delegates_to_batch():
+    arr = _batch(n=1)[0]
+    out = img_mod.BrightnessJitterAug(0.0)(nd.array(arr))
+    np.testing.assert_allclose(out.asnumpy(), arr, rtol=1e-5)
+    out = img_mod.SaturationJitterAug(0.0)(nd.array(arr))
+    np.testing.assert_allclose(out.asnumpy(), arr, rtol=1e-4, atol=1e-2)
+
+
+def test_sequential_and_random_order_batchable():
+    seq = img_mod.SequentialAug([img_mod.BrightnessJitterAug(0.1),
+                                 img_mod.ColorNormalizeAug([0.] * 3,
+                                                           [1.] * 3)])
+    assert seq.batchable
+    mixed = img_mod.SequentialAug([img_mod.ResizeAug(8),
+                                   img_mod.CastAug()])
+    assert not mixed.batchable
+    jit = img_mod.ColorJitterAug(0.1, 0.1, 0.1)
+    assert jit.batchable
+    out = jit.batch_call(_batch(), np.random.default_rng(0))
+    assert out.shape == (4, 8, 6, 3)
+
+
+def test_scale_down_reference_equivalence():
+    """The one-scale formulation must agree with the reference's two-step
+    clamp (image.py scale_down) across a grid."""
+    def ref(src_size, size):
+        w, h = size
+        sw, sh = src_size
+        if sh < h:
+            w, h = float(w * sh) / h, sh
+        if sw < w:
+            w, h = sw, float(h * sw) / w
+        return int(w), int(h)
+
+    for sw in (1, 3, 7, 20, 100):
+        for sh in (1, 4, 9, 33, 50):
+            for w in (1, 5, 12, 40):
+                for h in (2, 8, 25, 60):
+                    assert img_mod.scale_down((sw, sh), (w, h)) == \
+                        ref((sw, sh), (w, h)), ((sw, sh), (w, h))
+
+
+# -- ImageIter on synthetic JPEGs -------------------------------------------
+
+@pytest.fixture(scope="module")
+def jpeg_dir(tmp_path_factory):
+    from PIL import Image
+    d = tmp_path_factory.mktemp("imgs")
+    rs = np.random.RandomState(0)
+    entries = []
+    for i in range(10):
+        arr = rs.randint(0, 255, (32 + i, 40, 3), np.uint8)
+        fname = "img%d.jpg" % i
+        Image.fromarray(arr).save(str(d / fname), quality=95)
+        entries.append((i % 3, fname))
+    return str(d), entries
+
+
+def test_image_iter_batches(jpeg_dir):
+    root, entries = jpeg_dir
+    it = img_mod.ImageIter(batch_size=4, data_shape=(3, 16, 16),
+                           imglist=[[lab, fn] for lab, fn in entries],
+                           path_root=root)
+    batches = list(it)
+    assert len(batches) == 3
+    for b in batches:
+        assert b.data[0].shape == (4, 3, 16, 16)
+        assert b.label[0].shape == (4,)
+    assert batches[-1].pad == 2  # 10 imgs -> 4+4+2(+2 pad)
+
+
+def test_image_iter_batched_tail_matches_per_image(jpeg_dir):
+    """Deterministic augs (center-crop + normalize) must give identical
+    batches whether the tail runs vectorized or per image."""
+    root, entries = jpeg_dir
+    imglist = [[lab, fn] for lab, fn in entries]
+    mean = [100., 110., 120.]
+    std = [50., 60., 70.]
+
+    def make_iter():
+        return img_mod.ImageIter(
+            batch_size=5, data_shape=(3, 16, 16), imglist=imglist,
+            path_root=root,
+            aug_list=[img_mod.CenterCropAug((16, 16)),
+                      img_mod.CastAug(),
+                      img_mod.ColorNormalizeAug(mean, std)])
+
+    it = make_iter()
+    got = next(it).data[0].asnumpy()
+    # hand-rolled per-image pipeline
+    want = []
+    for lab, fn in entries[:5]:
+        im = img_mod.imread(os.path.join(root, fn))
+        im = img_mod.CenterCropAug((16, 16))(im)
+        arr = im.asnumpy().astype(np.float32)
+        want.append((arr - mean) / std)
+    want = np.stack(want).transpose(0, 3, 1, 2)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_image_iter_partition_disjoint(jpeg_dir):
+    root, entries = jpeg_dir
+    imglist = [[lab, fn] for lab, fn in entries]
+    seen = []
+    for part in range(2):
+        it = img_mod.ImageIter(batch_size=5, data_shape=(3, 16, 16),
+                               imglist=imglist, path_root=root,
+                               part_index=part, num_parts=2)
+        seen.append(list(it.seq))
+        assert len(it.seq) == 5
+    assert not set(seen[0]) & set(seen[1])
+
+
+def test_image_iter_rand_aug_shapes(jpeg_dir):
+    root, entries = jpeg_dir
+    it = img_mod.ImageIter(batch_size=4, data_shape=(3, 16, 16),
+                           imglist=[[lab, fn] for lab, fn in entries],
+                           path_root=root, rand_crop=True, rand_mirror=True,
+                           brightness=0.2, contrast=0.2, saturation=0.2,
+                           hue=0.1, pca_noise=0.05, rand_gray=0.2,
+                           mean=True, std=True)
+    b = next(it)
+    assert b.data[0].shape == (4, 3, 16, 16)
+    assert np.isfinite(b.data[0].asnumpy()).all()
+
+
+def test_image_det_iter(jpeg_dir):
+    root, entries = jpeg_dir
+    # detection label: [header_width=2, obj_width=5, cls, x1, y1, x2, y2]
+    rs = np.random.RandomState(1)
+    imglist = []
+    for lab, fn in entries:
+        x1, y1 = rs.uniform(0, 0.4, 2)
+        x2, y2 = x1 + rs.uniform(0.1, 0.5), y1 + rs.uniform(0.1, 0.5)
+        imglist.append([[2, 5, float(lab), x1, y1, min(x2, 1.), min(y2, 1.)],
+                        fn])
+    it = img_mod.ImageDetIter(batch_size=4, data_shape=(3, 16, 16),
+                              imglist=imglist, path_root=root)
+    b = next(it)
+    assert b.data[0].shape == (4, 3, 16, 16)
+    lab = b.label[0].asnumpy()
+    assert lab.ndim == 3 and lab.shape[0] == 4 and lab.shape[2] == 5
+    assert (lab[:, 0, 0] >= 0).all()  # first object is real in every sample
