@@ -376,17 +376,75 @@ def infer_shapes(symbol: Symbol, kwargs, partial=False):
     return arg_shapes, out_shapes, aux_shapes
 
 
+# ops whose output dtype follows a specific (non-first) input: lookup ops
+# emit the dtype of their table, not of their integer indices
+_DTYPE_FOLLOWS_INPUT = {"Embedding": 1, "take": 0, "gather_nd": 0}
+
+# inputs pinned to a fixed dtype regardless of the data dtype: BatchNorm
+# keeps gamma/beta and the moving stats float32 under fp16/bf16 data
+# (reference batch_norm.cc type inference)
+_DTYPE_PINNED_INPUTS = {"BatchNorm": {1: "float32", 2: "float32",
+                                      3: "float32", 4: "float32"}}
+
+
 def infer_types(symbol: Symbol, kwargs):
-    """Type inference given arg dtypes (reference Symbol.infer_type).
-    Shapes unknown → use dummy 1-sized dims where needed is impossible, so
-    we return declared/default types (types flow trivially in this stack:
-    params adopt the data dtype)."""
+    """Type inference given arg dtypes (reference Symbol.infer_type,
+    src/executor/infer_graph_attr_pass.cc).
+
+    Forward dtype propagation through the graph: a node's output dtype is
+    its declared ``dtype`` attr (Cast, creation ops) if present, else the
+    dtype of the input it follows (first input for most ops — the
+    reference's same-type constraint — with a small table for lookup ops
+    like Embedding whose output follows the table, not the indices).
+    Unknown variables encountered as other inputs of the node adopt that
+    same dtype (params follow data), matching the reference's propagation
+    of the data type into weights."""
     prog = GraphProgram(symbol)
-    type_dict = {k: dtype_name(v) for k, v in (kwargs or {}).items()}
-    data_dt = next(iter(type_dict.values()), "float32")
-    arg_types = [np.dtype(type_dict.get(n, data_dt)) for n in prog.arg_names]
-    out_types = [np.dtype(data_dt)] * len(symbol._entries)
-    aux_types = [np.dtype("float32")] * len(prog.aux_names)
+    type_dict = {k: dtype_name(v) for k, v in (kwargs or {}).items()
+                 if v is not None}   # None = "unknown, please infer"
+    default_dt = next(iter(type_dict.values()), "float32")
+    dts: Dict[int, tuple] = {}   # node id -> per-output dtype names
+    for node in prog.nodes:
+        if node.is_var:
+            d = type_dict.get(node.name) or node.attrs.get("__dtype__")
+            dts[id(node)] = (dtype_name(d) if d else None,)
+            continue
+        attrs = node.parsed_attrs()
+        # only a USER-set dtype attr declares the output dtype — parsed
+        # attrs fill schema defaults (topk/argsort carry dtype='float32'
+        # by default while their runtime output follows the input)
+        declared = node.attrs.get("dtype")
+        in_dts = [dts[id(e.node)][e.index] for e in node.inputs]
+        if not node.inputs:
+            # creation op: its (possibly default) dtype param IS the output
+            anchor = dtype_name(attrs.get("dtype") or default_dt)
+        elif node.op.name in _DTYPE_FOLLOWS_INPUT:
+            # lookup op: dtype comes from the table input ONLY — integer
+            # indices must not donate their dtype to an untyped table;
+            # fall back to the op's dtype param (Embedding), never to the
+            # index dtype
+            f = _DTYPE_FOLLOWS_INPUT[node.op.name]
+            anchor = in_dts[f] if f < len(in_dts) and in_dts[f] is not None \
+                else dtype_name(attrs.get("dtype") or "float32")
+        else:
+            anchor = next((d for d in in_dts if d is not None), default_dt)
+        # untyped variable inputs adopt the node's anchor dtype (pinned
+        # inputs — BN params/stats — keep their fixed dtype instead)
+        pinned = _DTYPE_PINNED_INPUTS.get(node.op.name, {})
+        for i, (e, d) in enumerate(zip(node.inputs, in_dts)):
+            if d is None and e.node.is_var:
+                dts[id(e.node)] = (pinned.get(i, anchor),)
+        out_dt = dtype_name(declared) if declared else anchor
+        dts[id(node)] = (out_dt,) * node.op.num_outputs(attrs)
+    def _final(name_nodes):
+        return [np.dtype(dtype_np(dts[id(n)][0] or default_dt))
+                for n in name_nodes]
+    by_name = {n.name: n for n in prog.nodes if n.is_var}
+    arg_types = _final([by_name[n] for n in prog.arg_names])
+    out_types = [np.dtype(dtype_np(dts[id(e.node)][e.index] or default_dt))
+                 for e in symbol._entries]
+    aux_types = [np.dtype(dtype_np(dts[id(by_name[n])][0] or "float32"))
+                 for n in prog.aux_names]
     return arg_types, out_types, aux_types
 
 

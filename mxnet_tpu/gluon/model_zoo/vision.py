@@ -14,7 +14,8 @@ __all__ = ["get_model", "resnet18_v1", "resnet34_v1", "resnet50_v1",
            "densenet161", "densenet169", "densenet201", "mobilenet1_0",
            "mobilenet0_75", "mobilenet0_5", "mobilenet0_25", "get_resnet",
            "get_vgg", "get_mobilenet", "AlexNet", "SqueezeNet", "DenseNet",
-           "MobileNet", "ResNetV1", "ResNetV2", "VGG"]
+           "MobileNet", "ResNetV1", "ResNetV2", "VGG", "Inception3",
+           "inception_v3", "HybridConcurrent"]
 
 
 # ---------------------------------------------------------------------------
@@ -664,6 +665,157 @@ def mobilenet0_25(**kwargs):
     return get_mobilenet(0.25, **kwargs)
 
 
+# ---------------------------------------------------------------------------
+# Inception v3 (reference gluon/model_zoo/vision/inception.py).  Built from
+# a declarative branch table instead of nested builder calls: each mixing
+# block is a list of branches; a branch is an optional pool marker followed
+# by (channels, kernel, stride, pad) conv steps.
+# ---------------------------------------------------------------------------
+
+class HybridConcurrent(HybridBlock):
+    """Parallel branches over the same input, concatenated on `axis`
+    (reference gluon/contrib/nn HybridConcurrent)."""
+
+    def __init__(self, axis=1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def add(self, *blocks):
+        for block in blocks:
+            self.register_child(block)
+
+    def hybrid_forward(self, F, x):
+        outs = [block(x) for block in self._children.values()]
+        return F.concat(*outs, dim=self.axis)
+
+
+def _bn_conv(channels, kernel, stride=1, pad=0):
+    seq = nn.HybridSequential(prefix="")
+    seq.add(nn.Conv2D(channels, kernel_size=kernel, strides=stride,
+                      padding=pad, use_bias=False))
+    seq.add(nn.BatchNorm(epsilon=0.001))
+    seq.add(nn.Activation("relu"))
+    return seq
+
+
+def _inc_branch(steps):
+    seq = nn.HybridSequential(prefix="")
+    for step in steps:
+        if step == "avg":
+            seq.add(nn.AvgPool2D(pool_size=3, strides=1, padding=1))
+        elif step == "max":
+            seq.add(nn.MaxPool2D(pool_size=3, strides=2))
+        else:
+            seq.add(_bn_conv(*step))
+    return seq
+
+
+def _inc_mix(branches, axis=1):
+    cat = HybridConcurrent(axis=axis)
+    for steps in branches:
+        b = _inc_branch(steps) if not isinstance(steps, HybridBlock) \
+            else steps
+        cat.add(b)
+    return cat
+
+
+def _mix_a(pool_features):
+    return _inc_mix([
+        [(64, 1)],
+        [(48, 1), (64, 5, 1, 2)],
+        [(64, 1), (96, 3, 1, 1), (96, 3, 1, 1)],
+        ["avg", (pool_features, 1)],
+    ])
+
+
+def _mix_b():
+    return _inc_mix([
+        [(384, 3, 2)],
+        [(64, 1), (96, 3, 1, 1), (96, 3, 2)],
+        ["max"],
+    ])
+
+
+def _mix_c(c7):
+    return _inc_mix([
+        [(192, 1)],
+        [(c7, 1), (c7, (1, 7), 1, (0, 3)), (192, (7, 1), 1, (3, 0))],
+        [(c7, 1), (c7, (7, 1), 1, (3, 0)), (c7, (1, 7), 1, (0, 3)),
+         (c7, (7, 1), 1, (3, 0)), (192, (1, 7), 1, (0, 3))],
+        ["avg", (192, 1)],
+    ])
+
+
+def _mix_d():
+    return _inc_mix([
+        [(192, 1), (320, 3, 2)],
+        [(192, 1), (192, (1, 7), 1, (0, 3)), (192, (7, 1), 1, (3, 0)),
+         (192, 3, 2)],
+        ["max"],
+    ])
+
+
+def _split_conv(channels):
+    """The E-block 1x3/3x1 fan-out pair."""
+    return _inc_mix([
+        [(channels, (1, 3), 1, (0, 1))],
+        [(channels, (3, 1), 1, (1, 0))],
+    ])
+
+
+def _mix_e():
+    b3 = nn.HybridSequential(prefix="")
+    b3.add(_bn_conv(384, 1))
+    b3.add(_split_conv(384))
+    b3d = nn.HybridSequential(prefix="")
+    b3d.add(_bn_conv(448, 1))
+    b3d.add(_bn_conv(384, 3, 1, 1))
+    b3d.add(_split_conv(384))
+    return _inc_mix([
+        [(320, 1)],
+        b3,
+        b3d,
+        ["avg", (192, 1)],
+    ])
+
+
+class Inception3(HybridBlock):
+    """Inception v3 ("Rethinking the Inception Architecture", 1512.00567;
+    reference inception.py Inception3)."""
+
+    def __init__(self, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        stem = [
+            _bn_conv(32, 3, 2), _bn_conv(32, 3), _bn_conv(64, 3, 1, 1),
+            nn.MaxPool2D(pool_size=3, strides=2),
+            _bn_conv(80, 1), _bn_conv(192, 3),
+            nn.MaxPool2D(pool_size=3, strides=2),
+        ]
+        mixes = [
+            _mix_a(32), _mix_a(64), _mix_a(64),
+            _mix_b(),
+            _mix_c(128), _mix_c(160), _mix_c(160), _mix_c(192),
+            _mix_d(),
+            _mix_e(), _mix_e(),
+        ]
+        self.features = nn.HybridSequential(prefix="")
+        for blk in stem + mixes:
+            self.features.add(blk)
+        self.features.add(nn.AvgPool2D(pool_size=8))
+        self.features.add(nn.Dropout(0.5))
+        self.output = nn.Dense(classes)
+
+    def hybrid_forward(self, F, x):
+        return self.output(self.features(x))
+
+
+def inception_v3(pretrained=False, ctx=None, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights are unavailable in this "
+                           "environment (no network); initialize instead")
+    return Inception3(**kwargs)
+
+
 _models = {
     "resnet18_v1": resnet18_v1, "resnet34_v1": resnet34_v1,
     "resnet50_v1": resnet50_v1, "resnet101_v1": resnet101_v1,
@@ -678,6 +830,7 @@ _models = {
     "densenet169": densenet169, "densenet201": densenet201,
     "mobilenet1.0": mobilenet1_0, "mobilenet0.75": mobilenet0_75,
     "mobilenet0.5": mobilenet0_5, "mobilenet0.25": mobilenet0_25,
+    "inceptionv3": inception_v3,
 }
 
 

@@ -252,6 +252,15 @@ def test_model_zoo_shapes():
         assert out.shape == (2, 10), name
 
 
+def test_model_zoo_inception_v3():
+    """reference gluon/model_zoo/vision/inception.py (299x299 canonical
+    input; the E-block concats land at 2048 channels before the pool)."""
+    net = gluon.model_zoo.get_model("inceptionv3", classes=7)
+    net.initialize(mx.init.Xavier())
+    out = net(nd.random.uniform(shape=(1, 3, 299, 299)))
+    assert out.shape == (1, 7)
+
+
 def test_dataset_dataloader():
     X = np.random.rand(20, 3).astype(np.float32)
     y = np.arange(20, dtype=np.float32)

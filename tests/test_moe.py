@@ -1,0 +1,97 @@
+"""Expert-parallel MoE tests on the 8-device virtual mesh (SURVEY §2.3
+expert parallelism; switch-style top-1 routing with lax.all_to_all)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mxnet_tpu.parallel.mesh import make_mesh
+from mxnet_tpu.parallel.moe import moe_ffn, moe_ffn_dense, top1_gating
+
+
+def _weights(E=8, d=8, h=16, seed=0):
+    rs = np.random.RandomState(seed)
+    wg = rs.normal(0, 1, (d, E)).astype(np.float32)
+    w1 = rs.normal(0, 0.3, (E, d, h)).astype(np.float32)
+    w2 = rs.normal(0, 0.3, (E, h, d)).astype(np.float32)
+    return jnp.asarray(wg), jnp.asarray(w1), jnp.asarray(w2)
+
+
+def test_top1_gating_masks():
+    rs = np.random.RandomState(0)
+    logits = jnp.asarray(rs.normal(0, 1, (16, 4)).astype(np.float32))
+    dispatch, combine, aux = top1_gating(logits, capacity=16)
+    d = np.asarray(dispatch)
+    # with ample capacity every token is dispatched exactly once
+    assert (d.sum(axis=(1, 2)) == 1).all()
+    # combine weight equals the winning softmax prob
+    probs = np.asarray(jax.nn.softmax(logits, -1))
+    np.testing.assert_allclose(np.asarray(combine).sum(axis=(1, 2)),
+                               probs.max(axis=1), rtol=1e-5)
+    assert np.isfinite(float(aux))
+    # capacity 1: at most one token per expert survives
+    d1, _, _ = top1_gating(logits, capacity=1)
+    assert np.asarray(d1).sum(axis=(0, 2)).max() <= 1.0 + 1e-6
+
+
+def test_moe_matches_dense_when_no_drops():
+    mesh = make_mesh((8,), ("ep",))
+    wg, w1, w2 = _weights()
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.normal(0, 1, (64, 8)).astype(np.float32))
+    # capacity_factor = E guarantees capacity >= local tokens: no drops
+    out, aux = moe_ffn(x, wg, w1, w2, mesh, capacity_factor=8.0)
+    want, want_aux = moe_ffn_dense(x, wg, w1, w2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+    # sharded aux is the mean of per-shard losses — same scale, not equal
+    assert np.isfinite(float(aux))
+
+
+def test_moe_capacity_drops_are_zero_rows():
+    mesh = make_mesh((8,), ("ep",))
+    wg, w1, w2 = _weights()
+    rs = np.random.RandomState(2)
+    x = jnp.asarray(rs.normal(0, 1, (64, 8)).astype(np.float32))
+    out_tight, _ = moe_ffn(x, wg, w1, w2, mesh, capacity_factor=0.5)
+    out_ample, _ = moe_ffn(x, wg, w1, w2, mesh, capacity_factor=8.0)
+    o_t, o_a = np.asarray(out_tight), np.asarray(out_ample)
+    # a dropped token's output row is exactly zero; kept rows match ample
+    dropped = np.all(o_t == 0, axis=1)
+    assert dropped.any(), "capacity 0.5 must drop something"
+    np.testing.assert_allclose(o_t[~dropped], o_a[~dropped], rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_moe_differentiable_over_mesh():
+    mesh = make_mesh((8,), ("ep",))
+    wg, w1, w2 = _weights()
+    rs = np.random.RandomState(3)
+    x = jnp.asarray(rs.normal(0, 1, (64, 8)).astype(np.float32))
+
+    def loss(w1_, w2_, wg_):
+        out, aux = moe_ffn(x, wg_, w1_, w2_, mesh, capacity_factor=4.0)
+        return jnp.sum(out ** 2) + 0.01 * aux
+
+    g1, g2, gg = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(w1, w2, wg)
+    assert np.isfinite(np.asarray(g1)).all()
+    assert np.isfinite(np.asarray(g2)).all()
+    assert np.isfinite(np.asarray(gg)).all()
+    assert float(jnp.abs(g1).sum()) > 0 and float(jnp.abs(gg).sum()) > 0
+
+
+def test_moe_single_device_fallback():
+    mesh = make_mesh((1,), ("ep",))
+    wg, w1, w2 = _weights()
+    rs = np.random.RandomState(4)
+    x = jnp.asarray(rs.normal(0, 1, (16, 8)).astype(np.float32))
+    out, aux = moe_ffn(x, wg, w1, w2, mesh)
+    want, _ = moe_ffn_dense(x, wg, w1, w2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), rtol=1e-5)
+
+
+def test_moe_shape_validation():
+    mesh = make_mesh((8,), ("ep",))
+    wg, w1, w2 = _weights()
+    with pytest.raises(ValueError):
+        moe_ffn(jnp.zeros((63, 8)), wg, w1, w2, mesh)

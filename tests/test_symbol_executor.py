@@ -37,6 +37,48 @@ def test_infer_shape():
     assert a2[1] == (16, 50) and o2 == [(32, 10)]
 
 
+def test_infer_type_propagation():
+    """Real dtype propagation (reference infer_graph_attr_pass.cc): Cast
+    switches the downstream dtype; embedding tables stay float under
+    integer indices."""
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=4, name="fc1")
+    net = sym.Cast(net, dtype="float16")
+    net = sym.FullyConnected(net, num_hidden=2, name="fc2")
+    arg_types, out_types, _ = net.infer_type(data="float32")
+    by_name = dict(zip(net.list_arguments(), arg_types))
+    assert by_name["fc1_weight"] == np.dtype("float32")
+    assert by_name["fc2_weight"] == np.dtype("float16")
+    assert out_types == [np.dtype("float16")]
+
+    emb = sym.Embedding(sym.Variable("idx"), input_dim=10, output_dim=4)
+    a, o, _ = emb.infer_type(idx="int32")
+    by_name = dict(zip(emb.list_arguments(), a))
+    assert by_name["idx"] == np.dtype("int32")
+    assert by_name[emb.list_arguments()[1]] == np.dtype("float32")
+    assert o == [np.dtype("float32")]
+
+    # schema-default dtype attrs must NOT override propagation (topk
+    # carries dtype='float32' by default but outputs the input dtype)
+    t = sym.topk(sym.Variable("d"), k=2, ret_typ="value")
+    assert t.infer_type(d="float16")[1] == [np.dtype("float16")]
+
+    # positional None means "infer this arg"
+    fc = sym.FullyConnected(sym.Variable("data"), num_hidden=4, name="fc")
+    a, o, _ = fc.infer_type("float16", None, None)
+    assert all(dt == np.dtype("float16") for dt in a)
+
+    # BN params/aux pinned float32 under low-precision data (reference
+    # batch_norm.cc type inference)
+    b = sym.BatchNorm(sym.Cast(sym.Variable("data"), dtype="float16"),
+                      name="bn")
+    a, o, aux = b.infer_type(data="float32")
+    by_name = dict(zip(b.list_arguments(), a))
+    assert by_name["bn_gamma"] == np.dtype("float32")
+    assert aux == [np.dtype("float32")] * 2
+    assert o == [np.dtype("float16")]
+
+
 def test_infer_shape_partial():
     data = sym.Variable("data")
     out = sym.FullyConnected(data, num_hidden=4, name="fc")
