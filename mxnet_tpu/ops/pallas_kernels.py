@@ -1,0 +1,155 @@
+"""Pallas TPU kernels for the hot paths XLA can't fuse optimally
+(SURVEY.md §7 build plan reserves Pallas for exactly these).
+
+Kernels:
+  * two_bit_compress — fused error-feedback gradient quantization
+    (reference src/kvstore/gradient_compression.cc quantize_2bit): ONE
+    VMEM pass reads grad + residual and writes the {-t, 0, +t} quantized
+    gradient plus the new residual.  XLA would emit this as two
+    elementwise passes over HBM; fusing halves the bandwidth of the
+    kvstore compression hop.
+  * fused_attention — single-chip attention with the (Tq, Tk) score block
+    kept entirely in VMEM: per q-block, scores/softmax/weighted-sum happen
+    on-chip and HBM never holds the (T, T) matrix.  This is the kernel
+    form of parallel/ring.py's `_block_attn`; ring attention composes it
+    across chips.
+
+Both kernels run through the Pallas interpreter when no TPU is present
+(pallas_call(interpret=True)), so the same code path is tested on CPU.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+__all__ = ["two_bit_compress", "fused_attention", "pallas_available"]
+
+
+def _interpret(*arrays) -> bool:
+    """Interpreter mode off-TPU — real lowering on TPU.  Decided by where
+    the INPUTS live, not the default backend: kvstore/host arrays sit on
+    the CPU device even when a TPU is attached."""
+    for a in arrays:
+        if isinstance(a, jax.Array):
+            try:
+                return not all(d.platform == "tpu" for d in a.devices())
+            except Exception:
+                break
+    return jax.default_backend() != "tpu"
+
+
+def pallas_available() -> bool:
+    return True   # interpret mode keeps the path alive everywhere
+
+
+# ---------------------------------------------------------------------------
+# two-bit quantization with error feedback
+# ---------------------------------------------------------------------------
+
+_LANES = 1024          # flattened row width: 8 sublanes x 128 lanes
+
+
+def _two_bit_kernel(g_ref, r_ref, t_ref, q_ref, nr_ref):
+    t = t_ref[0]
+    comp = g_ref[:] + r_ref[:]
+    q = jnp.where(comp >= t, t, jnp.where(comp <= -t, -t, 0.0))
+    q_ref[:] = q.astype(g_ref.dtype)
+    nr_ref[:] = (comp - q).astype(g_ref.dtype)
+
+
+def two_bit_compress(grad: jax.Array, residual: jax.Array,
+                     threshold: float = 0.5):
+    """Fused quantize + residual update.  Any shape/dtype; returns
+    (quantized, new_residual) with grad's shape."""
+    return _two_bit_jit(grad, residual, threshold,
+                        _interpret(grad, residual))
+
+
+@functools.partial(jax.jit, static_argnames=("threshold", "interpret"))
+def _two_bit_jit(grad, residual, threshold, interpret):
+    shape, dtype = grad.shape, grad.dtype
+    n = grad.size
+    rows = -(-n // _LANES)
+    pad = rows * _LANES - n
+    g2 = jnp.pad(grad.reshape(-1).astype(jnp.float32), (0, pad)) \
+        .reshape(rows, _LANES)
+    r2 = jnp.pad(residual.reshape(-1).astype(jnp.float32), (0, pad)) \
+        .reshape(rows, _LANES)
+    t = jnp.asarray([threshold], jnp.float32)
+    q2, nr2 = pl.pallas_call(
+        _two_bit_kernel,
+        out_shape=(jax.ShapeDtypeStruct((rows, _LANES), jnp.float32),
+                   jax.ShapeDtypeStruct((rows, _LANES), jnp.float32)),
+        interpret=interpret,
+    )(g2, r2, t)
+    q = q2.reshape(-1)[:n].reshape(shape).astype(dtype)
+    nr = nr2.reshape(-1)[:n].reshape(shape).astype(dtype)
+    return q, nr
+
+
+# ---------------------------------------------------------------------------
+# fused attention
+# ---------------------------------------------------------------------------
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q):
+    """One (block_q, D) query block vs the full K/V in VMEM."""
+    qb = pl.program_id(1)
+    q = q_ref[:].astype(jnp.float32)          # (Bq, D)
+    k = k_ref[:].astype(jnp.float32)          # (T, D)
+    v = v_ref[:].astype(jnp.float32)
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+    if causal:
+        t_k = k.shape[0]
+        q_idx = qb * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 0)
+        k_idx = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(q_idx >= k_idx, s, -jnp.inf)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o_ref[:] = (jnp.dot(p, v, preferred_element_type=jnp.float32)
+                / l).astype(o_ref.dtype)
+
+
+def fused_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = False, scale=None,
+                    block_q: int = 128) -> jax.Array:
+    """Attention with VMEM-resident score blocks.
+
+    q/k/v: (B, T, H, D) (the parallel/ring.py layout).  Returns (B, T, H,
+    D).  Per (batch*head, q-block) grid cell the (Bq, T) score tile lives
+    only in VMEM — HBM traffic is O(T*D), not O(T^2)."""
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(D))
+    bq = min(block_q, Tq)
+    if Tq % bq:
+        raise ValueError("query length %d must divide block_q %d" % (Tq, bq))
+    # (B*H, T, D) lanes-last layout for the MXU
+    qf = q.transpose(0, 2, 1, 3).reshape(B * H, Tq, D)
+    kf = k.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
+    vf = v.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
+    kern = functools.partial(_attn_kernel, scale=scale, causal=causal,
+                             block_q=bq)
+    # this package runs with jax_enable_x64 on (mxnet int64 parity); grid
+    # index maps would then trace their literals as i64, which Mosaic
+    # cannot legalize — trace the kernel in an x64-off scope
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            kern,
+            grid=(B * H, Tq // bq),
+            in_specs=[
+                pl.BlockSpec((None, bq, D), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((None, bq, D), lambda b, i: (b, i, 0)),
+            out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+            interpret=_interpret(q, k, v),
+        )(qf, kf, vf)
+    return out.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)

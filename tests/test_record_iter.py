@@ -223,3 +223,22 @@ def test_native_partition_edge_cases(rec_file):
         NativeRecordIter(rec_file + ".rec", SHAPE, 2,
                          idx_path=rec_file + ".idx",
                          part_index=3, num_parts=2)
+
+
+def test_rec2idx_rebuilds_index(rec_file, tmp_path):
+    """tools/rec2idx.py (reference tools/rec2idx.py): a rebuilt .idx must
+    let MXIndexedRecordIO random-access the same records."""
+    import sys
+    rebuilt = str(tmp_path / "rebuilt.idx")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "rec2idx.py"),
+         rec_file + ".rec", rebuilt],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 0, r.stderr
+    orig = dict(tuple(l.split("\t")) for l in open(rec_file + ".idx"))
+    new = dict(tuple(l.split("\t")) for l in open(rebuilt))
+    assert orig == new
+    rd = recordio.MXIndexedRecordIO(rebuilt, rec_file + ".rec", "r")
+    hdr, img = recordio.unpack(rd.read_idx(N_IMG - 1))
+    assert hdr.label == float(N_IMG - 1)
+    rd.close()

@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Kill stray distributed training processes.
+
+Reference: tools/kill-mxnet.py (ssh to every host in a hostfile and pkill
+the training program).  Here the launcher (tools/launch.py) already tears
+peers down on failure; this tool is the manual cleanup for anything left
+behind — e.g. after a Ctrl-C that orphaned workers.
+
+Usage:
+  python tools/kill_mxnet.py <prog>              # this host
+  python tools/kill_mxnet.py <prog> -H hostfile  # every host via ssh
+"""
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+
+def _local_pids(pattern: str):
+    """PIDs of distributed workers matching `pattern` (identified by the
+    launcher's DMLC_* env protocol or by command line)."""
+    out = subprocess.run(["pgrep", "-f", pattern], capture_output=True,
+                         text=True)
+    me = os.getpid()
+    return [int(p) for p in out.stdout.split()
+            if p.strip() and int(p) != me]
+
+
+def kill_local(pattern: str) -> int:
+    pids = _local_pids(pattern)
+    for pid in pids:
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+    return len(pids)
+
+
+def main():
+    ap = argparse.ArgumentParser(description="kill stray training workers")
+    ap.add_argument("prog", help="program name/pattern to kill")
+    ap.add_argument("-H", "--hostfile", default=None,
+                    help="one host per line; ssh to each (reference "
+                         "kill-mxnet.py behavior). Without it, local only.")
+    ap.add_argument("-u", "--user", default=None, help="ssh user")
+    args = ap.parse_args()
+    if not args.hostfile:
+        n = kill_local(args.prog)
+        print("killed %d local process(es) matching %r" % (n, args.prog))
+        return
+    with open(args.hostfile) as f:
+        hosts = [h.strip() for h in f if h.strip()]
+    dest = "%s@%%s" % args.user if args.user else "%s"
+    for host in hosts:
+        cmd = ["ssh", "-o", "StrictHostKeyChecking=no", dest % host,
+               "pkill -9 -f %s" % args.prog]
+        r = subprocess.run(cmd, capture_output=True, text=True)
+        print("%s: rc=%d" % (host, r.returncode))
+
+
+if __name__ == "__main__":
+    main()

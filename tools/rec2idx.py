@@ -1,0 +1,48 @@
+#!/usr/bin/env python
+"""Rebuild the .idx file for an existing RecordIO .rec file.
+
+Reference: tools/rec2idx.py (IndexCreator over MXRecordIO).  The index
+maps record key -> byte offset so MXIndexedRecordIO can random-access and
+shuffle; losing the .idx previously meant re-running im2rec.
+
+Usage:  python tools/rec2idx.py data.rec [data.idx]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mxnet_tpu import recordio
+
+
+def build_index(rec_path: str, idx_path: str) -> int:
+    """Scan every record, emitting `key\\toffset` lines keyed 0..N-1 (the
+    im2rec convention).  Returns the record count."""
+    reader = recordio.MXRecordIO(rec_path, "r")
+    n = 0
+    with open(idx_path, "w") as out:
+        while True:
+            offset = reader.tell()
+            if reader.read() is None:
+                break
+            out.write("%d\t%d\n" % (n, offset))
+            n += 1
+    reader.close()
+    return n
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="recreate the .idx for a RecordIO file")
+    ap.add_argument("record", help="path to the .rec file")
+    ap.add_argument("index", nargs="?", default=None,
+                    help="output .idx path (default: alongside the .rec)")
+    args = ap.parse_args()
+    idx = args.index or os.path.splitext(args.record)[0] + ".idx"
+    n = build_index(args.record, idx)
+    print("wrote %s: %d records" % (idx, n))
+
+
+if __name__ == "__main__":
+    main()
