@@ -1,0 +1,321 @@
+/*
+ * mxnet_tpu C++ frontend — header-only RAII wrappers over the C ABI
+ * (capi/mxnet_tpu_c_api.h).
+ *
+ * Role analog of the reference cpp-package (cpp-package/include/mxnet-cpp:
+ * NDArray/Symbol/Executor/Context over c_api.h), designed fresh for this
+ * runtime: handles are shared_ptr-managed, ops are looked up once through
+ * a cached registry map, and errors surface as exceptions carrying
+ * MXGetLastError().
+ *
+ * Usage:
+ *   #include <mxnet_tpu_cpp/mxnet_tpu.hpp>
+ *   using namespace mxtpu;
+ *   auto x = Symbol::Variable("data");
+ *   auto fc = Symbol::Op("FullyConnected", {x}, {{"num_hidden", "64"}});
+ *   ...
+ */
+#ifndef MXNET_TPU_CPP_HPP_
+#define MXNET_TPU_CPP_HPP_
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "mxnet_tpu_c_api.h"
+
+namespace mxtpu {
+
+class Error : public std::runtime_error {
+ public:
+  explicit Error(const std::string &what) : std::runtime_error(what) {}
+};
+
+inline void Check(int rc) {
+  if (rc != 0) throw Error(MXGetLastError());
+}
+
+/* ---- Context -------------------------------------------------------- */
+
+struct Context {
+  int dev_type;
+  int dev_id;
+  static Context Cpu(int id = 0) { return {1, id}; }
+  static Context Gpu(int id = 0) { return {2, id}; }  // alias of the chip
+  static Context Tpu(int id = 0) { return {2, id}; }
+};
+
+/* ---- NDArray -------------------------------------------------------- */
+
+class NDArray {
+ public:
+  NDArray() = default;
+
+  explicit NDArray(NDArrayHandle h) : h_(Wrap(h)) {}
+
+  NDArray(const std::vector<mx_uint> &shape, Context ctx = Context::Cpu()) {
+    NDArrayHandle h;
+    Check(MXNDArrayCreate(shape.data(), (mx_uint)shape.size(), ctx.dev_type,
+                          ctx.dev_id, 0, &h));
+    h_ = Wrap(h);
+  }
+
+  NDArray(const std::vector<float> &data, const std::vector<mx_uint> &shape,
+          Context ctx = Context::Cpu())
+      : NDArray(shape, ctx) {
+    CopyFrom(data);
+  }
+
+  NDArrayHandle handle() const { return h_.get(); }
+  bool IsNone() const { return !h_; }
+
+  std::vector<mx_uint> Shape() const {
+    mx_uint ndim;
+    const mx_uint *dims;
+    Check(MXNDArrayGetShape(h_.get(), &ndim, &dims));
+    return std::vector<mx_uint>(dims, dims + ndim);
+  }
+
+  size_t Size() const {
+    size_t n = 1;
+    for (auto d : Shape()) n *= d;
+    return n;
+  }
+
+  void CopyFrom(const std::vector<float> &data) {
+    Check(MXNDArraySyncCopyFromCPU(h_.get(), data.data(), data.size()));
+  }
+
+  std::vector<float> CopyTo() const {
+    std::vector<float> out(Size());
+    Check(MXNDArraySyncCopyToCPU(h_.get(), out.data(), out.size()));
+    return out;
+  }
+
+  float Scalar() const { return CopyTo().at(0); }
+
+ private:
+  static std::shared_ptr<void> Wrap(NDArrayHandle h) {
+    return std::shared_ptr<void>(h, [](void *p) {
+      if (p) MXNDArrayFree(p);
+    });
+  }
+  std::shared_ptr<void> h_;
+};
+
+/* ---- operator registry ---------------------------------------------- */
+
+using KwArgs = std::map<std::string, std::string>;
+
+inline AtomicSymbolCreator FindOp(const std::string &name) {
+  static std::map<std::string, AtomicSymbolCreator> cache = [] {
+    std::map<std::string, AtomicSymbolCreator> m;
+    mx_uint n;
+    AtomicSymbolCreator *creators;
+    Check(MXSymbolListAtomicSymbolCreators(&n, &creators));
+    for (mx_uint i = 0; i < n; ++i) {
+      const char *cname;
+      Check(MXSymbolGetAtomicSymbolName(creators[i], &cname));
+      m.emplace(cname, creators[i]);
+    }
+    return m;
+  }();
+  auto it = cache.find(name);
+  if (it == cache.end()) throw Error("unknown operator: " + name);
+  return it->second;
+}
+
+/* Imperative op call: outputs created by the runtime. */
+inline std::vector<NDArray> Invoke(const std::string &op,
+                                   const std::vector<NDArray> &inputs,
+                                   const KwArgs &kwargs = {},
+                                   std::vector<NDArray> outputs = {}) {
+  std::vector<NDArrayHandle> in;
+  in.reserve(inputs.size());
+  for (auto &a : inputs) in.push_back(a.handle());
+  std::vector<const char *> keys, vals;
+  for (auto &kv : kwargs) {
+    keys.push_back(kv.first.c_str());
+    vals.push_back(kv.second.c_str());
+  }
+  int n_out = (int)outputs.size();
+  std::vector<NDArrayHandle> out_h;
+  for (auto &o : outputs) out_h.push_back(o.handle());
+  NDArrayHandle *out_ptr = out_h.empty() ? nullptr : out_h.data();
+  Check(MXImperativeInvoke(FindOp(op), (int)in.size(), in.data(), &n_out,
+                           &out_ptr, (int)keys.size(), keys.data(),
+                           vals.data()));
+  // with caller-provided outputs the runtime validates the count and
+  // fills them in place (wrong count -> MXGetLastError via Check above)
+  if (!outputs.empty()) return outputs;
+  std::vector<NDArray> fresh;
+  for (int i = 0; i < n_out; ++i) fresh.emplace_back(out_ptr[i]);
+  return fresh;
+}
+
+/* ---- Symbol --------------------------------------------------------- */
+
+class Symbol {
+ public:
+  Symbol() = default;
+  explicit Symbol(SymbolHandle h) : h_(Wrap(h)) {}
+
+  static Symbol Variable(const std::string &name) {
+    SymbolHandle h;
+    Check(MXSymbolCreateVariable(name.c_str(), &h));
+    return Symbol(h);
+  }
+
+  /* Op(inputs..., kwargs) — positional composition, auto-named. */
+  static Symbol Op(const std::string &op, const std::vector<Symbol> &inputs,
+                   const KwArgs &kwargs = {}, const std::string &name = "") {
+    std::vector<const char *> keys, vals;
+    for (auto &kv : kwargs) {
+      keys.push_back(kv.first.c_str());
+      vals.push_back(kv.second.c_str());
+    }
+    SymbolHandle h;
+    Check(MXSymbolCreateAtomicSymbol(FindOp(op), (mx_uint)keys.size(),
+                                     keys.data(), vals.data(), &h));
+    std::vector<SymbolHandle> in;
+    for (auto &s : inputs) in.push_back(s.handle());
+    Check(MXSymbolCompose(h, name.empty() ? nullptr : name.c_str(),
+                          (mx_uint)in.size(), nullptr, in.data()));
+    return Symbol(h);
+  }
+
+  SymbolHandle handle() const { return h_.get(); }
+
+  std::vector<std::string> ListArguments() const { return List(0); }
+  std::vector<std::string> ListOutputs() const { return List(1); }
+  std::vector<std::string> ListAuxiliaryStates() const { return List(2); }
+
+  /* Infer all argument shapes from the named inputs. */
+  std::map<std::string, std::vector<mx_uint>> InferArgShapes(
+      const std::map<std::string, std::vector<mx_uint>> &known) const {
+    std::vector<const char *> keys;
+    std::vector<mx_uint> csr{0};
+    std::vector<mx_uint> cdata;
+    for (auto &kv : known) {
+      keys.push_back(kv.first.c_str());
+      cdata.insert(cdata.end(), kv.second.begin(), kv.second.end());
+      csr.push_back((mx_uint)cdata.size());
+    }
+    mx_uint in_n, out_n, aux_n;
+    const mx_uint *in_nd, *out_nd, *aux_nd;
+    const mx_uint **in_dims, **out_dims, **aux_dims;
+    int complete;
+    Check(MXSymbolInferShape(h_.get(), (mx_uint)keys.size(), keys.data(),
+                             csr.data(), cdata.data(), &in_n, &in_nd,
+                             &in_dims, &out_n, &out_nd, &out_dims, &aux_n,
+                             &aux_nd, &aux_dims, &complete));
+    if (!complete)
+      throw Error("shape inference incomplete: provide shapes for all "
+                  "graph inputs");
+    auto args = ListArguments();
+    std::map<std::string, std::vector<mx_uint>> out;
+    for (mx_uint i = 0; i < in_n && i < args.size(); ++i)
+      out[args[i]] = std::vector<mx_uint>(in_dims[i], in_dims[i] + in_nd[i]);
+    return out;
+  }
+
+ private:
+  std::vector<std::string> List(int what) const {
+    mx_uint n;
+    const char **names;
+    if (what == 0)
+      Check(MXSymbolListArguments(h_.get(), &n, &names));
+    else if (what == 1)
+      Check(MXSymbolListOutputs(h_.get(), &n, &names));
+    else
+      Check(MXSymbolListAuxiliaryStates(h_.get(), &n, &names));
+    return std::vector<std::string>(names, names + n);
+  }
+  static std::shared_ptr<void> Wrap(SymbolHandle h) {
+    return std::shared_ptr<void>(h, [](void *p) {
+      if (p) MXSymbolFree(p);
+    });
+  }
+  std::shared_ptr<void> h_;
+};
+
+/* ---- Executor ------------------------------------------------------- */
+
+enum class GradReq : mx_uint { kNull = 0, kWrite = 1, kAdd = 3 };
+
+class Executor {
+ public:
+  /* Bind with explicit arg/grad arrays in ListArguments() order. */
+  Executor(const Symbol &sym, Context ctx, std::vector<NDArray> args,
+           std::vector<NDArray> arg_grads, std::vector<GradReq> reqs,
+           std::vector<NDArray> aux = {})
+      : sym_(sym), args_(std::move(args)), grads_(std::move(arg_grads)),
+        aux_(std::move(aux)) {
+    std::vector<NDArrayHandle> in, g, ax;
+    std::vector<mx_uint> r;
+    for (auto &a : args_) in.push_back(a.handle());
+    for (auto &a : grads_) g.push_back(a.IsNone() ? nullptr : a.handle());
+    for (auto &q : reqs) r.push_back((mx_uint)q);
+    for (auto &a : aux_) ax.push_back(a.handle());
+    ExecutorHandle h;
+    Check(MXExecutorBind(sym_.handle(), ctx.dev_type, ctx.dev_id,
+                         (mx_uint)in.size(), in.data(), g.data(), r.data(),
+                         (mx_uint)ax.size(), ax.empty() ? nullptr : ax.data(),
+                         &h));
+    h_ = std::shared_ptr<void>(h, [](void *p) {
+      if (p) MXExecutorFree(p);
+    });
+  }
+
+  void Forward(bool is_train) {
+    Check(MXExecutorForward(h_.get(), is_train ? 1 : 0));
+    RefreshOutputs();
+  }
+
+  /* Backward with default head gradients (ones). */
+  void Backward(const std::vector<NDArray> &head_grads = {}) {
+    std::vector<NDArrayHandle> hg;
+    for (auto &a : head_grads) hg.push_back(a.handle());
+    Check(MXExecutorBackward(h_.get(), (mx_uint)hg.size(),
+                             hg.empty() ? nullptr : hg.data()));
+  }
+
+  const std::vector<NDArray> &Outputs() const { return outputs_; }
+  std::vector<NDArray> &Args() { return args_; }
+  std::vector<NDArray> &Grads() { return grads_; }
+
+ private:
+  void RefreshOutputs() {
+    mx_uint n;
+    NDArrayHandle *outs;
+    Check(MXExecutorOutputs(h_.get(), &n, &outs));
+    outputs_.clear();
+    for (mx_uint i = 0; i < n; ++i) outputs_.emplace_back(outs[i]);
+  }
+  Symbol sym_;
+  std::vector<NDArray> args_, grads_, aux_, outputs_;
+  std::shared_ptr<void> h_;
+};
+
+/* ---- SGD helper (cpp-package Optimizer role) ------------------------ */
+
+class SGDOptimizer {
+ public:
+  explicit SGDOptimizer(float lr, float wd = 0.f) : lr_(lr), wd_(wd) {}
+
+  void Update(NDArray &weight, const NDArray &grad) {
+    Invoke("sgd_update", {weight, grad},
+           {{"lr", std::to_string(lr_)}, {"wd", std::to_string(wd_)}},
+           {weight});
+  }
+
+ private:
+  float lr_, wd_;
+};
+
+}  // namespace mxtpu
+
+#endif  // MXNET_TPU_CPP_HPP_

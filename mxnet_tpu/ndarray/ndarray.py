@@ -447,6 +447,10 @@ def imperative_invoke(op: Operator, inputs: Sequence[NDArray],
     visible = out_nds[:n_vis]
     if out is not None:
         outs = [out] if isinstance(out, NDArray) else list(out)
+        if len(outs) != len(visible):
+            raise MXNetError(
+                "%s produces %d output(s) but %d out array(s) given"
+                % (op.name, len(visible), len(outs)))
         for o, v in zip(outs, visible):
             o._handle = v._handle
             o._autograd_node = v._autograd_node

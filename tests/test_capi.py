@@ -46,6 +46,17 @@ def test_c_lenet_trains(capi_lib):
     assert "C ABI LeNet training: OK" in r.stdout
 
 
+def test_cpp_package_mlp_trains(capi_lib):
+    """The header-only C++ frontend (cpp-package/include/mxnet_tpu_cpp)
+    trains an MLP end-to-end — the reference cpp-package/example/mlp.cpp
+    role."""
+    env = dict(os.environ, MXNET_TPU_HOME=REPO, JAX_PLATFORMS="cpu")
+    r = subprocess.run([os.path.join(CAPI, "build", "train_mlp_cpp")],
+                       capture_output=True, text=True, env=env, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "cpp-package MLP training: OK" in r.stdout
+
+
 def test_ndarray_roundtrip(capi_lib):
     lib = capi_lib
     ver = ctypes.c_int()
