@@ -1,0 +1,118 @@
+"""Elastic (checkpoint-restart) distributed training.
+
+Reference failure model (SURVEY §5.3): a dead worker fails the gang; the
+tracker relaunches and training resumes from the last checkpoint.  This
+script is run via `tools/launch.py --max-restarts 1`:
+
+  incarnation 0: all ranks train with per-epoch checkpoints; rank 1
+    CRASHES mid-training (after the epoch-2 checkpoint exists);
+  incarnation 1 (MXNET_TPU_RESTART_COUNT=1): every rank finds the
+    checkpoint, resumes from it (begin_epoch > 0), finishes, and checks
+    convergence + cross-rank parameter agreement.
+"""
+import glob
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import parallel  # noqa: E402
+
+CKPT_DIR = os.environ.get("ELASTIC_CKPT_DIR", "/tmp/mxt_elastic")
+TOTAL_EPOCHS = 12
+CRASH_AFTER_EPOCH = 2
+
+
+def latest_checkpoint(prefix):
+    """Highest epoch with a saved params file, or None."""
+    eps = []
+    for p in glob.glob(prefix + "-*.params"):
+        try:
+            eps.append(int(p.rsplit("-", 1)[1].split(".")[0]))
+        except ValueError:
+            pass
+    return max(eps) if eps else None
+
+
+def main():
+    parallel.init_distributed()
+    kv = mx.kv.create("dist_sync")
+    rank, nworker = kv.rank, kv.num_workers
+    incarnation = int(os.environ.get("MXNET_TPU_RESTART_COUNT", "0"))
+    prefix = os.path.join(CKPT_DIR, "mlp")
+    if rank == 0 and incarnation == 0:
+        os.makedirs(CKPT_DIR, exist_ok=True)
+        for p in glob.glob(prefix + "-*"):
+            os.remove(p)
+    kv.barrier()
+
+    rs = np.random.RandomState(0)
+    X = rs.randn(256, 16).astype(np.float32)
+    w_true = rs.randn(16).astype(np.float32)
+    y = (X @ w_true > 0).astype(np.float32)
+    shard = slice(rank * 64, (rank + 1) * 64)
+    it = mx.io.NDArrayIter(X[shard], y[shard], batch_size=32, shuffle=False)
+
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=2, name="fc2")
+    net = mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+    begin_epoch = 0
+    arg_params = aux_params = None
+    resumed_from = latest_checkpoint(prefix)
+    if resumed_from is not None:
+        _, arg_params, aux_params = mx.model.load_checkpoint(
+            prefix, resumed_from)
+        begin_epoch = resumed_from
+    if incarnation > 0:
+        assert resumed_from is not None and resumed_from >= CRASH_AFTER_EPOCH, \
+            "restarted incarnation must find the pre-crash checkpoint"
+
+    mod = mx.mod.Module(net, context=mx.cpu())
+
+    def crash_or_checkpoint(epoch, symbol, args_p, aux_p):
+        # rank 0 checkpoints every epoch (shared fs in local mode)
+        if rank == 0:
+            mx.model.save_checkpoint(prefix, epoch + 1, symbol, args_p, aux_p)
+        kv.barrier()   # peers wait until the checkpoint is durable
+        if incarnation == 0 and rank == 1 and epoch + 1 == CRASH_AFTER_EPOCH:
+            print("dist_elastic rank 1 CRASHING after epoch %d" % (epoch + 1),
+                  flush=True)
+            os._exit(17)
+
+    metric = mx.metric.Accuracy()
+    mod.fit(it, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.3},
+            initializer=mx.init.Xavier(),
+            arg_params=arg_params, aux_params=aux_params,
+            begin_epoch=begin_epoch, num_epoch=TOTAL_EPOCHS,
+            eval_metric=metric, kvstore=kv,
+            epoch_end_callback=crash_or_checkpoint)
+
+    # every rank must hold identical parameters after sync training
+    args_p, _ = mod.get_params()
+    for name, arr in sorted(args_p.items()):
+        mine = arr.asnumpy().astype(np.float64)
+        total = np.asarray(parallel.allreduce_array(jax.numpy.asarray(mine)))
+        np.testing.assert_allclose(total, mine * nworker, rtol=1e-5)
+
+    it.reset()
+    metric.reset()
+    mod.score(it, metric)
+    acc = dict(metric.get_name_value())["accuracy"]
+    assert acc > 0.9, "rank %d accuracy %.3f" % (rank, acc)
+    assert incarnation == 1, "must be the restarted incarnation to succeed"
+    assert begin_epoch >= CRASH_AFTER_EPOCH
+    print("dist_elastic rank %d/%d OK resumed_at=%d acc=%.3f"
+          % (rank, nworker, begin_epoch, acc), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -41,6 +41,26 @@ def test_dist_train_mlp_4proc():
     _run_dist("dist_train_mlp.py")
 
 
+def test_dist_elastic_restart_4proc():
+    """Checkpoint-restart elasticity: rank 1 crashes mid-training, the
+    launcher (--max-restarts 1) relaunches the gang, training resumes
+    from the checkpoint and converges (SURVEY §5.3 failure model)."""
+    import tempfile
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    with tempfile.TemporaryDirectory() as d:
+        env["ELASTIC_CKPT_DIR"] = d
+        r = subprocess.run(
+            [sys.executable, LAUNCH, "-n", "4", "--max-restarts", "1",
+             sys.executable,
+             os.path.join(REPO, "tests", "dist", "dist_elastic_train.py")],
+            capture_output=True, text=True, timeout=420, env=env, cwd=REPO)
+    out = r.stdout + r.stderr
+    assert r.returncode == 0, out[-3000:]
+    assert out.count(" OK") == 4, out[-1500:]
+    assert "CRASHING" in out and "restart 1/1" in out
+
+
 def test_dist_async_train_4proc():
     """Module.fit with kvstore('dist_async') over 4 ranks stepping at
     different speeds: no deadlock, per-rank convergence, identical params

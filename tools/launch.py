@@ -20,13 +20,21 @@ mxnet_tpu.parallel.init_distributed):
   MXNET_TPU_DIST_DEVICE=cpu|tpu   (cpu => gloo collectives, for testing
                                    multi-host logic without a pod)
 
-Usage:  python tools/launch.py -n 4 [--dist-device cpu] python script.py
+Elastic mode (--max-restarts N): a crashed rank kills the whole gang (a
+dead peer leaves the others blocked in a collective forever), then the
+launcher relaunches ALL ranks up to N times with a fresh coordinator.
+Recovery is checkpoint-restart (SURVEY §5.3 failure model): workers read
+MXNET_TPU_RESTART_COUNT and resume from their last checkpoint.
+
+Usage:  python tools/launch.py -n 4 [--dist-device cpu]
+            [--max-restarts 2] python script.py
 """
 import argparse
 import os
 import socket
 import subprocess
 import sys
+import time
 
 
 def free_port() -> int:
@@ -37,19 +45,9 @@ def free_port() -> int:
     return port
 
 
-def main():
-    ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("-n", "--num-workers", type=int, required=True)
-    ap.add_argument("--dist-device", default="cpu",
-                    help="device backend for workers (cpu uses gloo "
-                         "collectives; tpu expects a pod runtime)")
-    ap.add_argument("--env", action="append", default=[],
-                    help="extra KEY=VALUE env for workers")
-    ap.add_argument("command", nargs=argparse.REMAINDER)
-    args = ap.parse_args()
-    if not args.command:
-        ap.error("no command given")
-
+def run_gang(args, attempt: int) -> int:
+    """Launch all ranks once; returns the gang's exit code (0 = success,
+    first failing rank's code otherwise)."""
     coordinator = "127.0.0.1:%d" % free_port()
     procs = []
     for rank in range(args.num_workers):
@@ -61,12 +59,12 @@ def main():
             "DMLC_WORKER_ID": str(rank),
             "MXNET_TPU_COORDINATOR": coordinator,
             "MXNET_TPU_DIST_DEVICE": args.dist_device,
+            "MXNET_TPU_RESTART_COUNT": str(attempt),
         })
         procs.append(subprocess.Popen(args.command, env=env))
 
     # poll all ranks: the first failure kills the rest (a crashed rank
     # leaves peers blocked inside a collective forever otherwise)
-    import time
     rc = 0
     alive = list(procs)
     try:
@@ -85,6 +83,40 @@ def main():
         for p in procs:
             if p.poll() is None:
                 p.kill()
+        for p in procs:
+            # reap before (re)launching: a killed rank still holds the
+            # device / coordinator sockets until it is gone
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                pass
+    return rc
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("--dist-device", default="cpu",
+                    help="device backend for workers (cpu uses gloo "
+                         "collectives; tpu expects a pod runtime)")
+    ap.add_argument("--env", action="append", default=[],
+                    help="extra KEY=VALUE env for workers")
+    ap.add_argument("--max-restarts", type=int, default=0,
+                    help="relaunch the whole gang up to N times after a "
+                         "failure (checkpoint-restart elasticity)")
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+    if not args.command:
+        ap.error("no command given")
+
+    rc = 0
+    for attempt in range(args.max_restarts + 1):
+        rc = run_gang(args, attempt)
+        if rc == 0:
+            break
+        if attempt < args.max_restarts:
+            print("[launch] gang failed rc=%d; restart %d/%d"
+                  % (rc, attempt + 1, args.max_restarts), file=sys.stderr)
     sys.exit(rc)
 
 
