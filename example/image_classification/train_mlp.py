@@ -1,0 +1,74 @@
+"""Classic end-to-end training loop (reference
+example/image-classification/train_mnist.py role): Module.fit with an
+NDArrayIter, Xavier init, SGD with momentum, accuracy metric, per-epoch
+checkpointing, and resume.
+"""
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def synthetic_digits(n=512, dim=64, classes=10, seed=0):
+    """Gaussian blobs, one per class — an MNIST stand-in with no download."""
+    rs = np.random.RandomState(seed)
+    centers = rs.normal(0, 3, (classes, dim)).astype(np.float32)
+    y = rs.randint(0, classes, n)
+    x = centers[y] + rs.normal(0, 1, (n, dim)).astype(np.float32)
+    return x, y.astype(np.float32)
+
+
+def build_net(classes=10):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=128, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=64, name="fc2")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=classes, name="fc3")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def main():
+    x, y = synthetic_digits()
+    train = mx.io.NDArrayIter(x[:448], y[:448], batch_size=64, shuffle=True)
+    val = mx.io.NDArrayIter(x[448:], y[448:], batch_size=64)
+
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "mlp")
+        mod = mx.mod.Module(build_net(), context=mx.cpu())
+        mod.fit(train, eval_data=val,
+                optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+                initializer=mx.init.Xavier(),
+                eval_metric="acc",
+                epoch_end_callback=mx.callback.do_checkpoint(prefix),
+                batch_end_callback=mx.callback.Speedometer(64, 5),
+                num_epoch=8)
+
+        metric = mx.metric.Accuracy()
+        mod.score(val, metric)
+        acc = dict(metric.get_name_value())["accuracy"]
+        print("final val accuracy: %.3f" % acc)
+        assert acc > 0.9, acc
+
+        # resume from the checkpoint: same accuracy
+        sym, args, aux = mx.model.load_checkpoint(prefix, 8)
+        mod2 = mx.mod.Module(sym, context=mx.cpu())
+        mod2.bind(data_shapes=val.provide_data,
+                  label_shapes=val.provide_label)
+        mod2.set_params(args, aux)
+        metric.reset()
+        mod2.score(val, metric)
+        acc2 = dict(metric.get_name_value())["accuracy"]
+        assert abs(acc - acc2) < 1e-6
+    print("train_mlp example OK")
+
+
+if __name__ == "__main__":
+    main()

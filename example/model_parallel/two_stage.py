@@ -1,0 +1,56 @@
+"""Manual layer placement across devices with ctx_group (reference
+example/model-parallel role): the first half of an MLP runs on device 0,
+the second half on device 1; the executor segments the graph and chains
+per-segment forward/backward with cross-device transfers.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import jax
+# must happen BEFORE the backend initializes (probing jax.default_backend
+# or jax.devices first would lock in a single CPU device)
+if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+    jax.config.update("jax_num_cpu_devices", 2)
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def main():
+    data = mx.sym.Variable("data")
+    with mx.AttrScope(ctx_group="stage1"):
+        h = mx.sym.FullyConnected(data, num_hidden=64, name="fc1")
+        h = mx.sym.Activation(h, act_type="relu")
+    with mx.AttrScope(ctx_group="stage2"):
+        h = mx.sym.FullyConnected(h, num_hidden=2, name="fc2")
+        net = mx.sym.SoftmaxOutput(h, name="softmax")
+
+    rs = np.random.RandomState(0)
+    x = rs.normal(0, 1, (32, 16)).astype(np.float32)
+    y = (x.sum(1) > 0).astype(np.float32)
+
+    mod = mx.mod.Module(net, context=mx.cpu(0),
+                        group2ctxs={"stage1": mx.cpu(0),
+                                    "stage2": mx.cpu(1)})
+    it = mx.io.NDArrayIter(x, y, batch_size=16, label_name="softmax_label")
+    mod.fit(it, num_epoch=25, initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.5})
+
+    devs = mod._exec_group.execs[0].ctx_group_devices
+    print("segments on devices:", devs)
+    assert devs is not None and len(devs) == 2 and devs[0] is not devs[1]
+
+    metric = mx.metric.Accuracy()
+    mod.score(it, metric)
+    acc = dict(metric.get_name_value())["accuracy"]
+    print("accuracy: %.3f" % acc)
+    assert acc > 0.9, acc
+    print("model_parallel two_stage example OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,68 @@
+"""Profiling + monitoring a training run (reference example/profiler
+role): dump a Chrome trace of op/executor/batch events, then use the
+per-node Monitor to locate a NaN-producing layer.
+"""
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler
+
+
+def main():
+    rs = np.random.RandomState(0)
+    x = rs.rand(64, 16).astype(np.float32)
+    y = rs.randint(0, 2, 64).astype(np.float32)
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, num_hidden=8, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    with tempfile.TemporaryDirectory() as d:
+        trace = os.path.join(d, "profile.json")
+        profiler.set_config(filename=trace)
+        profiler.set_state("run")
+        mod = mx.mod.Module(net, context=mx.cpu())
+        it = mx.io.NDArrayIter(x, y, batch_size=16,
+                               label_name="softmax_label")
+        mod.fit(it, num_epoch=2, optimizer_params={"learning_rate": 0.1})
+        profiler.set_state("stop")
+        profiler.dump_profile()
+        with open(trace) as f:
+            events = json.load(f)["traceEvents"]
+        cats = {e["cat"] for e in events}
+        print("trace: %d events, categories %s" % (len(events), sorted(cats)))
+        assert "batch" in cats and "symbolic" in cats
+
+    # Monitor: find the layer where NaNs are born
+    bad = mx.sym.Variable("data")
+    bad = mx.sym.FullyConnected(bad, num_hidden=4, name="fc1")
+    bad = mx.sym.log(bad, name="badlog")          # negatives -> NaN
+    bad = mx.sym.FullyConnected(bad, num_hidden=2, name="fc2")
+
+    def nan_stat(arr):
+        return mx.nd.array([float(np.isnan(arr.asnumpy()).any())])
+
+    mon = mx.mon.Monitor(interval=1, stat_func=nan_stat, monitor_all=True)
+    ex = bad.simple_bind(mx.cpu(), data=(4, 16))
+    for arr in ex.arg_arrays:
+        arr[:] = mx.nd.array(rs.normal(0, 1, arr.shape))
+    mon.install(ex)
+    mon.tic()
+    ex.forward(is_train=True)
+    nan_layers = [k for _, k, v in mon.toc() if v.strip().startswith("1")]
+    print("NaN first appears at:", nan_layers[0])
+    assert nan_layers[0] == "badlog_output"
+    print("profile_mlp example OK")
+
+
+if __name__ == "__main__":
+    main()

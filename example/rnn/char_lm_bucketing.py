@@ -1,0 +1,74 @@
+"""Variable-length character language model with BucketingModule
+(reference example/rnn/bucketing role): sentences bucketed by length,
+one executor per bucket sharing parameters, LSTM unrolled per bucket.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def synthetic_corpus(n=200, seed=0):
+    """Random 'abab...'-style periodic strings of varying length: the next
+    char is predictable, so a tiny LSTM learns them quickly."""
+    rs = np.random.RandomState(seed)
+    sents = []
+    for _ in range(n):
+        period = rs.randint(2, 4)
+        length = rs.randint(4, 13)
+        motif = list(rs.randint(1, 9, period))
+        s = (motif * (length // period + 1))[:length]
+        sents.append(s)
+    return sents
+
+
+def main():
+    vocab = 16
+    hidden = 32
+    sents = synthetic_corpus()
+    buckets = [4, 8, 12]
+    # the iterator derives next-char labels itself (data shifted left,
+    # invalid_label padding)
+    it = mx.rnn.BucketSentenceIter(sents, batch_size=20, buckets=buckets,
+                                   invalid_label=0)
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        emb = mx.sym.Embedding(data, input_dim=vocab, output_dim=8,
+                               name="emb")
+        cell = mx.rnn.LSTMCell(hidden, prefix="lstm_")
+        outputs, _ = cell.unroll(seq_len, emb, layout="NTC",
+                                 merge_outputs=True)
+        pred = mx.sym.Reshape(outputs, shape=(-1, hidden))
+        pred = mx.sym.FullyConnected(pred, num_hidden=vocab, name="pred")
+        label_f = mx.sym.Reshape(label, shape=(-1,))
+        return mx.sym.SoftmaxOutput(pred, label_f, name="softmax"), \
+            ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen,
+                                 default_bucket_key=max(buckets),
+                                 context=mx.cpu())
+    mod.fit(it, optimizer="adam",
+            optimizer_params={"learning_rate": 0.01},
+            initializer=mx.init.Xavier(),
+            eval_metric=mx.metric.Perplexity(ignore_label=None),
+            num_epoch=10)
+
+    # perplexity over the data after training must beat uniform (16)
+    it.reset()
+    metric = mx.metric.Perplexity(ignore_label=None)
+    mod.score(it, metric)
+    ppl = dict(metric.get_name_value())["perplexity"]
+    print("final perplexity: %.2f (uniform would be %d)" % (ppl, vocab))
+    assert ppl < 8.0, ppl
+    print("char_lm_bucketing example OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -29,7 +29,8 @@ from .symbol.symbol import Node, NodeEntry, Symbol, _topo_order
 from . import rng as _rng
 
 __all__ = ["Executor", "GraphProgram", "infer_shapes", "infer_types",
-           "set_backward_mirror", "backward_mirror_policy"]
+           "set_backward_mirror", "backward_mirror_policy",
+           "apply_backward_mirror"]
 
 
 # ---------------------------------------------------------------------------
@@ -83,6 +84,15 @@ def backward_mirror_policy() -> str:
     if os.environ.get("MXNET_BACKWARD_DO_MIRROR", "0") not in ("0", ""):
         return "dots"
     return "none"
+
+
+def apply_backward_mirror(fn, policy: Optional[str] = None):
+    """Public remat helper for raw-JAX training loops: wrap a pure forward
+    (or loss) function so its activations are rematerialized during
+    backward per `policy` (None = the currently active policy; see
+    set_backward_mirror)."""
+    return _remat_wrap(fn, policy if policy is not None
+                       else backward_mirror_policy())
 
 
 def _remat_wrap(fn, policy: str):
@@ -776,6 +786,15 @@ class Executor:
         return Executor(self._symbol, self._ctx, new_args, args_grad=grads,
                         grad_req=self.grad_req, aux_states=self.aux_dict,
                         program=self._prog)
+
+    @property
+    def ctx_group_devices(self):
+        """Devices of the ctx_group segments, in execution order, or None
+        when the graph runs unsegmented on one device (public view of the
+        placement result — the PlaceDevice pass outcome)."""
+        if self._seg is None:
+            return None
+        return [s.device for s in self._seg.segments]
 
     def set_monitor_callback(self, callback, monitor_all=False):
         """Install a (name, NDArray) callback fired after each forward.

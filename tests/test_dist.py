@@ -15,18 +15,21 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LAUNCH = os.path.join(REPO, "tools", "launch.py")
 
 
-def _run_dist(script, n=4, timeout=420):
+def _run_dist(script, n=4, timeout=420, launch_args=(), extra_env=None):
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)  # workers self-configure cpu+gloo
+    env.update(extra_env or {})
     r = subprocess.run(
-        [sys.executable, LAUNCH, "-n", str(n), sys.executable,
+        [sys.executable, LAUNCH, "-n", str(n), *launch_args, sys.executable,
          os.path.join(REPO, "tests", "dist", script)],
         capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO)
-    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    out = r.stdout + r.stderr
+    assert r.returncode == 0, out[-3000:]
     # count occurrences, not lines: ranks finishing simultaneously can
     # interleave their stdout writes onto one line
-    n_ok = (r.stdout + r.stderr).count(" OK")
-    assert n_ok == n, (n_ok, r.stdout[-1000:], r.stderr[-500:])
+    n_ok = out.count(" OK")
+    assert n_ok == n, (n_ok, out[-1500:])
+    return out
 
 
 def test_dist_sync_kvstore_4proc():
@@ -41,23 +44,13 @@ def test_dist_train_mlp_4proc():
     _run_dist("dist_train_mlp.py")
 
 
-def test_dist_elastic_restart_4proc():
+def test_dist_elastic_restart_4proc(tmp_path):
     """Checkpoint-restart elasticity: rank 1 crashes mid-training, the
     launcher (--max-restarts 1) relaunches the gang, training resumes
     from the checkpoint and converges (SURVEY §5.3 failure model)."""
-    import tempfile
-    env = dict(os.environ)
-    env.pop("JAX_PLATFORMS", None)
-    with tempfile.TemporaryDirectory() as d:
-        env["ELASTIC_CKPT_DIR"] = d
-        r = subprocess.run(
-            [sys.executable, LAUNCH, "-n", "4", "--max-restarts", "1",
-             sys.executable,
-             os.path.join(REPO, "tests", "dist", "dist_elastic_train.py")],
-            capture_output=True, text=True, timeout=420, env=env, cwd=REPO)
-    out = r.stdout + r.stderr
-    assert r.returncode == 0, out[-3000:]
-    assert out.count(" OK") == 4, out[-1500:]
+    out = _run_dist("dist_elastic_train.py",
+                    launch_args=("--max-restarts", "1"),
+                    extra_env={"ELASTIC_CKPT_DIR": str(tmp_path)})
     assert "CRASHING" in out and "restart 1/1" in out
 
 

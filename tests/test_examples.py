@@ -1,0 +1,32 @@
+"""Smoke-run every example script (the reference keeps examples working
+via nightly runs; here they are part of CI).  Each runs in its own
+process on the CPU backend and must print its final 'OK' line."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+EXAMPLES = [
+    ("image_classification/train_mlp.py", "train_mlp example OK"),
+    ("rnn/char_lm_bucketing.py", "char_lm_bucketing example OK"),
+    ("long_context/ring_transformer.py", "ring_transformer example OK"),
+    ("moe/switch_ffn.py", "switch_ffn example OK"),
+    ("sparse/linear_classification.py",
+     "sparse linear_classification example OK"),
+    ("model_parallel/two_stage.py", "model_parallel two_stage example OK"),
+    ("profiler/profile_mlp.py", "profile_mlp example OK"),
+]
+
+
+@pytest.mark.parametrize("script,ok_line",
+                         EXAMPLES, ids=[s for s, _ in EXAMPLES])
+def test_example_runs(script, ok_line):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "example", script)],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert ok_line in r.stdout, r.stdout[-1000:]

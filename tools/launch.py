@@ -108,6 +108,8 @@ def main():
     args = ap.parse_args()
     if not args.command:
         ap.error("no command given")
+    if args.max_restarts < 0:
+        ap.error("--max-restarts must be >= 0")
 
     rc = 0
     for attempt in range(args.max_restarts + 1):
