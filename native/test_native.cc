@@ -1,0 +1,119 @@
+// Native-plane unit tests (the reference's tests/cpp tier, assert-based —
+// no gtest in this image).  Covers the RecordIO container (framing,
+// alignment, random access, index parsing) and the resize kernel.
+//
+// Build & run:  make -C native build/test_native && ./native/build/test_native
+#undef NDEBUG   // the asserts ARE the test; never compile them away
+#include <cassert>
+#include <cstdlib>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "image_decode.h"
+#include "recordio.h"
+
+static std::string TmpPath(const char* name) {
+  const char* dir = getenv("TMPDIR");
+  return std::string(dir ? dir : "/tmp") + "/" + name;
+}
+
+static void TestHeaderLayout() {
+  // binary compatibility: IRHeader is 24 packed bytes
+  static_assert(sizeof(mxt::IRHeader) == 24, "IRHeader must pack to 24B");
+}
+
+static void TestRecordRoundtrip() {
+  std::string path = TmpPath("mxt_test_rec.rec");
+  // sizes hitting every 4-byte alignment phase, incl. empty
+  std::vector<std::vector<uint8_t>> payloads;
+  for (size_t len : {0u, 1u, 2u, 3u, 4u, 5u, 127u, 4096u}) {
+    std::vector<uint8_t> p(len);
+    for (size_t i = 0; i < len; ++i) p[i] = (uint8_t)(i * 31 + len);
+    payloads.push_back(p);
+  }
+  std::vector<uint64_t> offsets;
+  {
+    mxt::RecordWriter w(path);
+    assert(w.ok());
+    for (auto& p : payloads)
+      offsets.push_back(w.Write(p.data(), p.size()));
+  }
+  {
+    mxt::RecordReader r(path);
+    assert(r.ok());
+    std::vector<uint8_t> buf;
+    for (auto& want : payloads) {
+      assert(r.Next(&buf));
+      assert(buf == want);
+    }
+    assert(!r.Next(&buf));   // EOF
+    // random access via recorded offsets, reverse order
+    for (int i = (int)payloads.size() - 1; i >= 0; --i) {
+      r.Seek(offsets[i]);
+      assert(r.Next(&buf));
+      assert(buf == payloads[i]);
+    }
+    r.Reset();
+    assert(r.Next(&buf) && buf == payloads[0]);
+  }
+  std::remove(path.c_str());
+}
+
+static void TestCorruptMagicRejected() {
+  std::string path = TmpPath("mxt_test_bad.rec");
+  FILE* f = fopen(path.c_str(), "wb");
+  uint32_t bad = 0xdeadbeef, len = 4, body = 0;
+  fwrite(&bad, 4, 1, f);
+  fwrite(&len, 4, 1, f);
+  fwrite(&body, 4, 1, f);
+  fclose(f);
+  mxt::RecordReader r(path);
+  std::vector<uint8_t> buf;
+  assert(!r.Next(&buf));   // bad magic must read as end-of-stream, not data
+  std::remove(path.c_str());
+}
+
+static void TestLoadIndex() {
+  std::string path = TmpPath("mxt_test.idx");
+  FILE* f = fopen(path.c_str(), "w");
+  fprintf(f, "0\t0\n7\t128\n42\t4096\n");
+  fclose(f);
+  std::vector<uint64_t> keys, offs;
+  assert(mxt::LoadIndex(path, &keys, &offs));
+  assert(keys.size() == 3 && offs.size() == 3);
+  assert(keys[1] == 7 && offs[1] == 128);
+  assert(keys[2] == 42 && offs[2] == 4096);
+  std::remove(path.c_str());
+}
+
+static void TestResizeBilinear() {
+  // constant image stays constant at any scale
+  std::vector<uint8_t> src(8 * 6 * 3, 77), dst(16 * 12 * 3, 0);
+  mxt::ResizeBilinear(src.data(), 8, 6, 3, dst.data(), 16, 12);
+  for (uint8_t v : dst) assert(v == 77);
+  // identity resize is a copy
+  std::vector<uint8_t> ramp(4 * 4 * 3), same(4 * 4 * 3);
+  for (size_t i = 0; i < ramp.size(); ++i) ramp[i] = (uint8_t)i;
+  mxt::ResizeBilinear(ramp.data(), 4, 4, 3, same.data(), 4, 4);
+  assert(same == ramp);
+}
+
+static void TestDecodeGarbageFails() {
+  std::vector<uint8_t> junk(64, 0x5a);
+  std::vector<uint8_t> out;
+  int h, w, c;
+  assert(!mxt::DecodeJPEG(junk.data(), junk.size(), &out, &h, &w, &c));
+}
+
+int main() {
+  TestHeaderLayout();
+  TestRecordRoundtrip();
+  TestCorruptMagicRejected();
+  TestLoadIndex();
+  TestResizeBilinear();
+  TestDecodeGarbageFails();
+  printf("native unit tests: OK\n");
+  return 0;
+}

@@ -242,3 +242,21 @@ def test_rec2idx_rebuilds_index(rec_file, tmp_path):
     hdr, img = recordio.unpack(rd.read_idx(N_IMG - 1))
     assert hdr.label == float(N_IMG - 1)
     rd.close()
+
+
+def test_native_cpp_unit_tests():
+    """The native-plane C++ unit-test binary (tests/cpp tier analog):
+    RecordIO framing/alignment/random-access, index parsing, resize
+    kernel, and decode failure paths."""
+    import shutil
+    if shutil.which("g++") is None or shutil.which("make") is None:
+        pytest.skip("no C++ toolchain")
+    r = subprocess.run(["make", "-C", os.path.join(REPO, "native"),
+                        "build/test_native"], capture_output=True, text=True)
+    # a broken test build is a FAILURE, not a skip — only environments
+    # without the toolchain may skip
+    assert r.returncode == 0, "native test build failed: " + r.stderr[-600:]
+    r = subprocess.run([os.path.join(REPO, "native", "build", "test_native")],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "native unit tests: OK" in r.stdout
