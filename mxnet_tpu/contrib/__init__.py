@@ -3,3 +3,4 @@ from . import ndarray
 from . import symbol
 from . import autograd
 from . import tensorboard
+from . import text

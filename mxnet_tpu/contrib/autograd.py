@@ -1,3 +1,78 @@
-"""mx.contrib.autograd (reference contrib/autograd.py) — re-export."""
-from ..autograd import *  # noqa: F401,F403
-from ..autograd import grad, backward, record, pause  # noqa: F401
+"""mx.contrib.autograd — the OLD experimental autograd API (reference
+contrib/autograd.py: train_section/test_section scopes, mark_variables,
+compute_gradient, grad_and_loss, grad), implemented over the modern tape
+in mxnet_tpu.autograd.  Ported user code keeps working:
+
+    with autograd.train_section():
+        y = net(x)
+        autograd.compute_gradient([y])
+"""
+import functools
+
+from .. import autograd as _ag
+from ..autograd import mark_variables  # noqa: F401  (same contract)
+
+__all__ = ["set_is_training", "train_section", "test_section",
+           "mark_variables", "backward", "compute_gradient",
+           "grad_and_loss", "grad"]
+
+
+def set_is_training(state):
+    """reference contrib/autograd.py:32.  The legacy flag maps onto the
+    modern (recording, training) pair; the return value is that pair, and
+    passing it back restores BOTH modes exactly:
+
+        prev = set_is_training(True)
+        ...
+        set_is_training(prev)
+    """
+    rec, train = state if isinstance(state, tuple) else (state, state)
+    return (_ag.set_recording(bool(rec)), _ag.set_training(bool(train)))
+
+
+def train_section():
+    """Record with train-mode ops (dropout active)."""
+    return _ag.record(train_mode=True)
+
+
+def test_section():
+    """Record with inference-mode ops inside a train section."""
+    return _ag.record(train_mode=False)
+
+
+def backward(outputs, out_grads=None, retain_graph=False):
+    """reference contrib/autograd.py:123."""
+    _ag.backward(outputs, head_grads=out_grads, retain_graph=retain_graph)
+
+
+def compute_gradient(outputs):
+    """reference contrib/autograd.py:158."""
+    backward(outputs)
+
+
+def grad_and_loss(func, argnum=None):
+    """Decorate func -> (grad_of_inputs, loss) (reference :163)."""
+    @functools.wraps(func)
+    def wrapped(*args):
+        from ..ndarray.ndarray import NDArray, zeros as nd_zeros
+        inputs = list(args) if argnum is None else \
+            [args[i] for i in ([argnum] if isinstance(argnum, int)
+                               else argnum)]
+        grads = [nd_zeros(x.shape, dtype=x.dtype) for x in inputs]
+        mark_variables(inputs, grads)
+        with train_section():
+            outputs = func(*args)
+            compute_gradient([outputs] if isinstance(outputs, NDArray)
+                             else outputs)
+        return grads, outputs
+    return wrapped
+
+
+def grad(func, argnum=None):
+    """Decorate func -> grad_of_inputs (reference :195)."""
+    wrapped = grad_and_loss(func, argnum)
+
+    @functools.wraps(func)
+    def only_grads(*args):
+        return wrapped(*args)[0]
+    return only_grads

@@ -1,0 +1,6 @@
+"""Text utilities: token counting, vocabulary indexing, token embeddings
+(reference python/mxnet/contrib/text/)."""
+from . import embedding
+from . import utils
+from . import vocab
+from .vocab import Vocabulary

@@ -1,0 +1,136 @@
+"""contrib.text tests (reference tests/python/unittest/test_contrib_text.py
+scenarios: counting, vocabulary indexing rules, embedding loading,
+composite embeddings)."""
+import collections
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import text
+
+
+def test_count_tokens_from_str():
+    c = text.utils.count_tokens_from_str(" Life is great! \n life is good .\n")
+    assert c["is"] == 2 and c["Life"] == 1 and c["life"] == 1
+    c2 = text.utils.count_tokens_from_str("Life is great! \n life is good .",
+                                          to_lower=True)
+    assert c2["life"] == 2
+    base = collections.Counter({"is": 10})
+    c3 = text.utils.count_tokens_from_str("is it", counter_to_update=base)
+    assert c3 is base and c3["is"] == 11 and c3["it"] == 1
+
+
+def test_vocabulary_indexing_rules():
+    counter = collections.Counter(
+        {"a": 5, "b": 5, "c": 3, "d": 2, "rare": 1})
+    v = text.Vocabulary(counter, most_freq_count=None, min_freq=2,
+                        unknown_token="<unk>", reserved_tokens=["<pad>"])
+    # unknown first, reserved next, then freq desc / token asc
+    assert v.idx_to_token[:2] == ["<unk>", "<pad>"]
+    assert v.idx_to_token[2:] == ["a", "b", "c", "d"]   # rare dropped
+    assert v.to_indices("a") == 2
+    assert v.to_indices(["zzz", "b"]) == [0, 3]
+    assert v.to_tokens([0, 2]) == ["<unk>", "a"]
+    with pytest.raises(ValueError):
+        v.to_tokens(99)
+    # most_freq_count caps INCLUDING specials
+    v2 = text.Vocabulary(counter, most_freq_count=4, min_freq=1,
+                         reserved_tokens=["<pad>"])
+    assert len(v2) == 4 and v2.idx_to_token == ["<unk>", "<pad>", "a", "b"]
+    with pytest.raises(ValueError):
+        text.Vocabulary(counter, reserved_tokens=["<unk>"])
+
+
+@pytest.fixture()
+def emb_file(tmp_path):
+    p = tmp_path / "emb.txt"
+    p.write_text("hello 1 2 3\nworld 4 5 6\nhello 9 9 9\n")
+    return str(p)
+
+
+def test_custom_embedding(emb_file):
+    emb = text.embedding.CustomEmbedding(emb_file)
+    assert emb.vec_len == 3
+    assert len(emb) == 3   # <unk> + 2 tokens (duplicate 'hello' skipped)
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("hello").asnumpy(), [1, 2, 3])
+    got = emb.get_vecs_by_tokens(["world", "nope", "Hello"])
+    np.testing.assert_allclose(got.asnumpy(),
+                               [[4, 5, 6], [0, 0, 0], [0, 0, 0]])
+    got = emb.get_vecs_by_tokens(["Hello"], lower_case_backup=True)
+    np.testing.assert_allclose(got.asnumpy(), [[1, 2, 3]])
+    emb.update_token_vectors("world", mx.nd.array([7.0, 7, 7]))
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("world").asnumpy(), [7, 7, 7])
+    with pytest.raises(ValueError):
+        emb.update_token_vectors("nope", mx.nd.array([1.0, 1, 1]))
+
+
+def test_embedding_registry(emb_file):
+    emb = text.embedding.create("customembedding",
+                                pretrained_file_path=emb_file)
+    assert emb.vec_len == 3
+    names = text.embedding.get_pretrained_file_names()
+    assert "glove" in names and any("840B" in n for n in names["glove"])
+    with pytest.raises(RuntimeError):
+        text.embedding.GloVe()   # no network: must demand a local path
+    with pytest.raises(KeyError):
+        text.embedding.create("nosuch")
+
+
+def test_composite_embedding(tmp_path):
+    p1 = tmp_path / "a.txt"
+    p1.write_text("x 1 1\ny 2 2\n")
+    p2 = tmp_path / "b.txt"
+    p2.write_text("x 3\nz 4\n")
+    e1 = text.embedding.CustomEmbedding(str(p1))
+    e2 = text.embedding.CustomEmbedding(str(p2))
+    vocab = text.Vocabulary(collections.Counter({"x": 2, "y": 1, "z": 1}))
+    comp = text.embedding.CompositeEmbedding(vocab, [e1, e2])
+    assert comp.vec_len == 3
+    np.testing.assert_allclose(
+        comp.get_vecs_by_tokens("x").asnumpy(), [1, 1, 3])
+    # y only in e1, z only in e2 — the other half is the unknown vector
+    np.testing.assert_allclose(
+        comp.get_vecs_by_tokens("y").asnumpy(), [2, 2, 0])
+    np.testing.assert_allclose(
+        comp.get_vecs_by_tokens("z").asnumpy(), [0, 0, 4])
+
+
+def test_fasttext_header_skipped(tmp_path):
+    p = tmp_path / "ft.vec"
+    p.write_text("2 3\ncat 1 2 3\ndog 4 5 6\n")
+    emb = text.embedding.FastText(pretrained_file_path=str(p))
+    assert emb.vec_len == 3 and len(emb) == 3
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("dog").asnumpy(), [4, 5, 6])
+
+
+def test_contrib_autograd_legacy_api():
+    """The OLD experimental autograd API (reference contrib/autograd.py):
+    train_section + compute_gradient, and the grad/grad_and_loss
+    decorators."""
+    from mxnet_tpu.contrib import autograd as cag
+    from mxnet_tpu import nd
+
+    x = nd.array([1.0, 2.0, 3.0])
+    gx = nd.zeros((3,))
+    cag.mark_variables([x], [gx])
+    with cag.train_section():
+        y = x * x
+        cag.compute_gradient([y])
+    np.testing.assert_allclose(gx.asnumpy(), [2, 4, 6], rtol=1e-6)
+
+    def f(a, b):
+        return a * b + a
+
+    g = cag.grad(f)
+    ga, gb = g(nd.array([2.0]), nd.array([5.0]))
+    np.testing.assert_allclose(ga.asnumpy(), [6.0])   # b + 1
+    np.testing.assert_allclose(gb.asnumpy(), [2.0])   # a
+
+    gl = cag.grad_and_loss(f, argnum=0)
+    grads, loss = gl(nd.array([2.0]), nd.array([5.0]))
+    np.testing.assert_allclose(grads[0].asnumpy(), [6.0])
+    np.testing.assert_allclose(loss.asnumpy(), [12.0])
