@@ -36,8 +36,9 @@ def train_section():
 
 
 def test_section():
-    """Record with inference-mode ops inside a train section."""
-    return _ag.record(train_mode=False)
+    """Inference scope: recording OFF, inference-mode ops (the legacy
+    set_is_training(False) semantics — no tape is built)."""
+    return _ag.pause(train_mode=False)
 
 
 def backward(outputs, out_grads=None, retain_graph=False):

@@ -60,6 +60,7 @@ class TokenEmbedding(_vocab.Vocabulary):
     def _load_file(self, path, elem_delim=" ", encoding="utf-8",
                    skip_header=False):
         tokens, vecs = [], []
+        loaded_unknown = None
         with open(path, encoding=encoding) as f:
             for lineno, line in enumerate(f):
                 parts = line.rstrip("\n").split(elem_delim)
@@ -80,6 +81,11 @@ class TokenEmbedding(_vocab.Vocabulary):
                     raise ValueError(
                         "inconsistent vector length at line %d (%d != %d)"
                         % (lineno + 1, len(v), self._vec_len))
+                if token == self._unknown_token:
+                    # a trained unknown vector in the file wins over the
+                    # init_unknown_vec default (reference behavior)
+                    loaded_unknown = v
+                    continue
                 if token in self._token_to_idx:
                     continue   # first occurrence wins (reference behavior)
                 self._token_to_idx[token] = len(self._idx_to_token)
@@ -88,7 +94,8 @@ class TokenEmbedding(_vocab.Vocabulary):
                 vecs.append(v)
         table = np.zeros((len(self._idx_to_token), self._vec_len),
                          np.float32)
-        table[0] = self._init_unknown_vec(self._vec_len)
+        table[0] = loaded_unknown if loaded_unknown is not None \
+            else self._init_unknown_vec(self._vec_len)
         if vecs:
             table[len(table) - len(vecs):] = np.stack(vecs)
         self._idx_to_vec = nd_array(table)
@@ -112,10 +119,10 @@ class TokenEmbedding(_vocab.Vocabulary):
                 t, self._token_to_idx.get(t.lower(), 0)) for t in toks]
         else:
             idxs = [self._token_to_idx.get(t, 0) for t in toks]
-        import jax.numpy as jnp
-        out = NDArray(jnp.take(self._idx_to_vec._handle,
-                               jnp.asarray(idxs, jnp.int32), axis=0))
-        return NDArray(out._handle[0]) if single else out
+        # NDArray-key indexing dispatches the registered `take` op — one
+        # gather through the supported op layer
+        out = self._idx_to_vec[nd_array(np.asarray(idxs, np.int32))]
+        return out[0] if single else out
 
     def update_token_vectors(self, tokens, new_vectors):
         toks = [tokens] if isinstance(tokens, str) else tokens

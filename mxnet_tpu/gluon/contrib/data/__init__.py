@@ -1,0 +1,2 @@
+"""Contrib data utilities (reference gluon/contrib/data)."""
+from .sampler import IntervalSampler

@@ -672,21 +672,7 @@ def mobilenet0_25(**kwargs):
 # by (channels, kernel, stride, pad) conv steps.
 # ---------------------------------------------------------------------------
 
-class HybridConcurrent(HybridBlock):
-    """Parallel branches over the same input, concatenated on `axis`
-    (reference gluon/contrib/nn HybridConcurrent)."""
-
-    def __init__(self, axis=1, prefix=None, params=None):
-        super().__init__(prefix=prefix, params=params)
-        self.axis = axis
-
-    def add(self, *blocks):
-        for block in blocks:
-            self.register_child(block)
-
-    def hybrid_forward(self, F, x):
-        outs = [block(x) for block in self._children.values()]
-        return F.concat(*outs, dim=self.axis)
+from ..contrib.nn import HybridConcurrent  # noqa: E402  (canonical home)
 
 
 def _bn_conv(channels, kernel, stride=1, pad=0):

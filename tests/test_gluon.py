@@ -303,3 +303,22 @@ def test_symbol_block():
     blk.collect_params().initialize()
     out = blk(nd.ones((2, 4)))
     assert out.shape == (2, 6)
+
+
+def test_gluon_contrib_layers_and_sampler():
+    """gluon.contrib.nn Concurrent/HybridConcurrent/Identity + contrib.data
+    IntervalSampler (reference gluon/contrib)."""
+    from mxnet_tpu.gluon import contrib as gcontrib
+    cat = gcontrib.nn.HybridConcurrent(axis=1)
+    cat.add(gluon.nn.Dense(3), gcontrib.nn.Identity(), gluon.nn.Dense(2))
+    cat.initialize(mx.init.Xavier())
+    x = nd.random.uniform(shape=(4, 5))
+    out = cat(x)
+    assert out.shape == (4, 3 + 5 + 2)
+    np.testing.assert_allclose(out.asnumpy()[:, 3:8], x.asnumpy(), rtol=1e-6)
+
+    s = gcontrib.data.IntervalSampler(13, interval=3)
+    assert list(s) == [0, 3, 6, 9, 12, 1, 4, 7, 10, 2, 5, 8, 11]
+    assert len(s) == 13
+    s2 = gcontrib.data.IntervalSampler(13, interval=3, rollover=False)
+    assert list(s2) == [0, 3, 6, 9, 12] and len(s2) == 5
