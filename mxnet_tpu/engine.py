@@ -1,0 +1,42 @@
+"""Engine controls (reference python/mxnet/engine.py).
+
+The reference's bulk execution bundles small engine ops to cut dispatch
+overhead (MXEngineSetBulkSize).  In this stack the XLA compiler already
+fuses whole traced programs, and eager ops go through cached jitted
+closures — there is no engine queue to bundle.  The API is kept so
+`with mx.engine.bulk(n):` scopes in ported scripts run unchanged; the
+size is recorded (visible via current_bulk_size) and is advisory.
+"""
+__all__ = ["set_bulk_size", "bulk", "current_bulk_size"]
+
+_bulk_size = 15   # the reference default (MXNET_ENGINE_BULK_SIZE)
+
+
+def set_bulk_size(size):
+    """Record the bulk-size hint; returns the previous value (reference
+    engine.py:26).  Advisory here: XLA fusion replaces engine bulking."""
+    global _bulk_size
+    prev, _bulk_size = _bulk_size, int(size)
+    return prev
+
+
+def current_bulk_size():
+    return _bulk_size
+
+
+class _BulkScope:
+    def __init__(self, size):
+        self._size = size
+        self._old = None
+
+    def __enter__(self):
+        self._old = set_bulk_size(self._size)
+        return self
+
+    def __exit__(self, *a):
+        set_bulk_size(self._old)
+
+
+def bulk(size):
+    """Scope form: `with mx.engine.bulk(16): ...` (reference engine.py:63)."""
+    return _BulkScope(size)

@@ -1,0 +1,36 @@
+"""Library locator + version (reference python/mxnet/libinfo.py).
+
+find_lib_path() locates the native C-ABI library (capi/build/
+libmxnet_tpu.so — the libmxnet.so analog) for ctypes consumers and
+embedding hosts; MXNET_TPU_LIBRARY_PATH overrides the search.
+"""
+import os
+
+__all__ = ["find_lib_path", "__version__"]
+
+__version__ = "1.1.0-tpu"
+
+
+def find_lib_path():
+    """Candidate paths to the built C ABI library, existing ones only.
+
+    Raises RuntimeError when none is found (matching the reference's
+    contract), with the build instruction in the message."""
+    env = os.environ.get("MXNET_TPU_LIBRARY_PATH")
+    if env and not os.path.isfile(env):
+        # an explicit override must not silently fall through to a stale
+        # repo build
+        raise RuntimeError(
+            "MXNET_TPU_LIBRARY_PATH=%r is not a file" % env)
+    here = os.path.dirname(os.path.abspath(__file__))
+    repo = os.path.dirname(here)
+    candidates = ([env] if env else []) + [
+        os.path.join(repo, "capi", "build", "libmxnet_tpu.so"),
+        os.path.join(here, "libmxnet_tpu.so"),
+    ]
+    found = [p for p in candidates if p and os.path.isfile(p)]
+    if not found:
+        raise RuntimeError(
+            "cannot find libmxnet_tpu.so; build it with `make -C capi` or "
+            "set MXNET_TPU_LIBRARY_PATH (searched: %s)" % candidates)
+    return found

@@ -1,0 +1,69 @@
+"""Logging helpers (reference python/mxnet/log.py): a level-colored
+formatter when the stream is a TTY, and get_logger() with one-time
+handler installation."""
+import logging
+import sys
+
+__all__ = ["get_logger", "getLogger",
+           "DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL", "NOTSET"]
+
+DEBUG = logging.DEBUG
+INFO = logging.INFO
+WARNING = logging.WARNING
+ERROR = logging.ERROR
+CRITICAL = logging.CRITICAL
+NOTSET = logging.NOTSET
+
+_COLORS = {logging.WARNING: "\x1b[0;33m", logging.ERROR: "\x1b[0;31m",
+           logging.CRITICAL: "\x1b[0;35m", logging.DEBUG: "\x1b[0;32m"}
+_LABELS = {logging.DEBUG: "D", logging.INFO: "I", logging.WARNING: "W",
+           logging.ERROR: "E", logging.CRITICAL: "C"}
+
+
+class _Formatter(logging.Formatter):
+    """Per-level colored '[L ts label] msg' lines on TTYs, plain
+    otherwise (reference log.py:37)."""
+
+    def __init__(self, colored=True):
+        super().__init__(datefmt="%m%d %H:%M:%S")
+        self._colored = colored
+
+    def format(self, record):
+        label = _LABELS.get(record.levelno, "U")
+        fmt = "%(asctime)s %(name)s:%(lineno)d: %(message)s"
+        if self._colored and record.levelno in _COLORS:
+            head = _COLORS[record.levelno] + label + "\x1b[0m "
+        else:
+            head = label + " "
+        self._style._fmt = head + fmt
+        return super().format(record)
+
+
+def get_logger(name=None, filename=None, filemode=None, level=WARNING):
+    """A logger with ONE handler installed on first call (reference
+    log.py:90): file handler when `filename` given, colored stream
+    handler otherwise."""
+    logger = logging.getLogger(name)
+    if getattr(logger, "_mxt_handler_installed", False):
+        if level != WARNING:   # only an explicit level overrides
+            logger.setLevel(level)
+        return logger
+    if filename:
+        handler = logging.FileHandler(filename, filemode or "a")
+        handler.setFormatter(_Formatter(colored=False))
+    else:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(_Formatter(
+            colored=hasattr(sys.stderr, "isatty") and sys.stderr.isatty()))
+    logger.addHandler(handler)
+    logger.setLevel(level)
+    if name:
+        # named loggers own their output; don't double-emit through root
+        logger.propagate = False
+    logger._mxt_handler_installed = True
+    return logger
+
+
+def getLogger(name=None, filename=None, filemode=None, level=WARNING):
+    """Deprecated reference alias of get_logger."""
+    return get_logger(name, filename, filemode, level)

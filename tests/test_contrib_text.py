@@ -134,3 +134,17 @@ def test_contrib_autograd_legacy_api():
     grads, loss = gl(nd.array([2.0]), nd.array([5.0]))
     np.testing.assert_allclose(grads[0].asnumpy(), [6.0])
     np.testing.assert_allclose(loss.asnumpy(), [12.0])
+
+
+def test_engine_libinfo_log_modules():
+    """Small top-level modules: engine bulk scopes (advisory under XLA
+    fusion), libinfo lib location/version, log helpers (reference
+    engine.py / libinfo.py / log.py)."""
+    prev = mx.engine.current_bulk_size()
+    with mx.engine.bulk(10):
+        assert mx.engine.current_bulk_size() == 10
+    assert mx.engine.current_bulk_size() == prev
+    assert mx.__version__ == mx.libinfo.__version__
+    lg = mx.log.get_logger("mxt_test_logger")
+    mx.log.get_logger("mxt_test_logger")
+    assert len(lg.handlers) == 1   # one handler regardless of call count
