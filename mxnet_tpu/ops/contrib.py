@@ -511,3 +511,135 @@ def _psroi_pooling(attrs, data, rois):
         return jnp.transpose(cells, (2, 0, 1))
 
     return jax.vmap(one_roi)(rois).astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Box utility ops (reference src/operator/contrib/bounding_box.cc)
+# ---------------------------------------------------------------------------
+
+def _to_corner(b):
+    """center (x, y, w, h) -> corner (xmin, ymin, xmax, ymax)."""
+    x, y, w, h = b[..., 0], b[..., 1], b[..., 2], b[..., 3]
+    return jnp.stack([x - w / 2, y - h / 2, x + w / 2, y + h / 2], axis=-1)
+
+
+def _to_center(b):
+    """corner (xmin, ymin, xmax, ymax) -> center (x, y, w, h)."""
+    x0, y0, x1, y1 = b[..., 0], b[..., 1], b[..., 2], b[..., 3]
+    return jnp.stack([(x0 + x1) / 2, (y0 + y1) / 2, x1 - x0, y1 - y0],
+                     axis=-1)
+
+
+@register("_contrib_box_iou", inputs=("lhs", "rhs"),
+          params=dict(format=attr_str("corner")),
+          aliases=("box_iou",))
+def _contrib_box_iou(attrs, lhs, rhs):
+    """Pairwise IoU with OUTER batch semantics: lhs (..., 4) x rhs
+    (..., 4) -> lhs.shape[:-1] + rhs.shape[:-1] — every lhs box against
+    every rhs box (reference bounding_box.cc box_iou)."""
+    if attrs.format == "center":
+        lhs, rhs = _to_corner(lhs), _to_corner(rhs)
+    out = _box_iou(lhs.reshape(-1, 4), rhs.reshape(-1, 4))
+    return out.reshape(lhs.shape[:-1] + rhs.shape[:-1])
+
+
+@register("_contrib_bipartite_matching", inputs=("data",),
+          params=dict(is_ascend=attr_bool(False),
+                      threshold=attr_float(required=True),
+                      topk=attr_int(-1)),
+          num_outputs=2, aliases=("bipartite_matching",))
+def _contrib_bipartite_matching(attrs, data):
+    """Greedy bipartite matching on a (..., N, M) score matrix: repeatedly
+    take the globally best remaining pair (reference bounding_box.cc
+    BipartiteMatching).  Outputs: row->col assignment (N,), col->row
+    assignment (M,); -1 = unmatched."""
+    sign = -1.0 if attrs.is_ascend else 1.0
+    thr = attrs.threshold
+
+    def one(mat):
+        N, M = mat.shape
+        k = min(N, M) if attrs.topk <= 0 else min(attrs.topk, min(N, M))
+        s = mat * sign   # maximize s
+
+        def body(_, state):
+            row_as, col_as, avail = state
+            masked = jnp.where(avail, s, -jnp.inf)
+            flat = jnp.argmax(masked)
+            i, j = flat // M, flat % M
+            # threshold applies in the ORIGINAL ordering sense: scores must
+            # beat it when descending, stay under it when ascending
+            ok = jnp.where(sign > 0, mat[i, j] >= thr, mat[i, j] <= thr) \
+                & jnp.isfinite(masked[i, j])
+            row_as = jnp.where(ok, row_as.at[i].set(j), row_as)
+            col_as = jnp.where(ok, col_as.at[j].set(i), col_as)
+            avail = jnp.where(ok, avail.at[i, :].set(False)
+                              .at[:, j].set(False), avail)
+            return row_as, col_as, avail
+
+        row0 = jnp.full((N,), -1.0, mat.dtype)
+        col0 = jnp.full((M,), -1.0, mat.dtype)
+        avail0 = jnp.ones((N, M), bool)
+        row_as, col_as, _ = jax.lax.fori_loop(0, k, body,
+                                              (row0, col0, avail0))
+        return row_as, col_as
+
+    flat = data.reshape((-1,) + data.shape[-2:])
+    rows, cols = jax.vmap(one)(flat)
+    return (rows.reshape(data.shape[:-1]),
+            cols.reshape(data.shape[:-2] + (data.shape[-1],)))
+
+
+@register("_contrib_box_nms", inputs=("data",),
+          params=dict(overlap_thresh=attr_float(0.5),
+                      valid_thresh=attr_float(0.0), topk=attr_int(-1),
+                      coord_start=attr_int(2), score_index=attr_int(1),
+                      id_index=attr_int(-1), background_id=attr_int(-1),
+                      force_suppress=attr_bool(False),
+                      in_format=attr_str("corner"),
+                      out_format=attr_str("corner")),
+          aliases=("box_nms",))
+def _contrib_box_nms(attrs, data):
+    """Non-maximum suppression over (..., N, K) detections (reference
+    bounding_box.cc box_nms): descending-score sort, greedy suppression
+    at overlap_thresh (per class unless force_suppress; background_id
+    rows ignored), suppressed rows set to -1, surviving coordinates
+    emitted in out_format."""
+    cs, si, ii = attrs.coord_start, attrs.score_index, attrs.id_index
+
+    def one(mat):
+        n = mat.shape[0]
+        scores = mat[:, si]
+        order = jnp.argsort(-scores)
+        mat_s = mat[order]
+        boxes = mat_s[:, cs:cs + 4]
+        if attrs.in_format == "center":
+            boxes = _to_corner(boxes)
+        valid = mat_s[:, si] > attrs.valid_thresh
+        if ii >= 0 and attrs.background_id >= 0:
+            valid = valid & (mat_s[:, ii] != attrs.background_id)
+        if attrs.topk > 0:
+            valid = valid & (jnp.arange(n) < attrs.topk)
+        iou = _box_iou(boxes, boxes)
+        same_class = jnp.ones((n, n), bool)
+        if not attrs.force_suppress and ii >= 0:
+            ids = mat_s[:, ii]
+            same_class = ids[:, None] == ids[None, :]
+
+        def body(i, keep):
+            sup = (iou[i] > attrs.overlap_thresh) & same_class[i] \
+                & (jnp.arange(n) > i) & keep[i] & valid[i]
+            return keep & ~sup
+
+        keep = jax.lax.fori_loop(0, n, body, jnp.ones(n, bool)) & valid
+        out_boxes = mat_s[:, cs:cs + 4]
+        if attrs.in_format != attrs.out_format:
+            out_boxes = boxes if attrs.out_format == "corner" else \
+                _to_center(out_boxes)
+            out = mat_s.at[:, cs:cs + 4].set(out_boxes)
+        else:
+            out = mat_s
+        return jnp.where(keep[:, None], out, -jnp.ones_like(out))
+
+    flat = data.reshape((-1,) + data.shape[-2:])
+    out = jax.vmap(one)(flat)
+    return out.reshape(data.shape)

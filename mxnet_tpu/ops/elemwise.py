@@ -239,3 +239,21 @@ def _where(attrs, cond, x, y):
         # 1-D condition selects rows (reference control_flow_op.h)
         cond = cond.reshape((-1,) + (1,) * (x.ndim - 1))
     return jnp.where(cond != 0, x, y)
+
+
+@register("round", inputs=("data",))
+def _round(attrs, x):
+    """reference mshadow_op.h round: ties away from zero (NOT the IEEE
+    bankers' rounding of jnp.round)."""
+    return jnp.sign(x) * jnp.floor(jnp.abs(x) + 0.5)
+
+
+@register("add_n", variadic=True, inputs=("args",),
+          params=dict(num_args=attr_int(required=True)),
+          aliases=("ElementWiseSum", "_sum_n"))
+def _add_n(attrs, *xs):
+    """reference elemwise_sum.cc: sum of N arrays in one op."""
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out

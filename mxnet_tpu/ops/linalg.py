@@ -147,3 +147,14 @@ def _makediag(attrs, a):
     if attrs.offset >= 0:
         return base.at[..., idx, idx + attrs.offset].set(a)
     return base.at[..., idx - attrs.offset, idx].set(a)
+
+
+@register("_linalg_syevd", inputs=("A",), num_outputs=2,
+          aliases=("linalg_syevd",))
+def _linalg_syevd(attrs, a):
+    """Symmetric eigendecomposition A = U^T diag(L) U with eigenvector
+    ROWS in U (reference la_op.cc:554 syevd; jnp.linalg.eigh returns
+    column eigenvectors, hence the transpose)."""
+    w, v = jnp.linalg.eigh(a)
+    u = jnp.swapaxes(v, -1, -2)
+    return u, w

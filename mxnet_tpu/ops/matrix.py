@@ -117,11 +117,7 @@ def _swapaxes(attrs, x):
                       step=attr_shape(())),
           aliases=("crop",))
 def _slice(attrs, x):
-    idx = []
-    step = attrs.step or (None,) * len(attrs.begin)
-    for b, e, s in zip(attrs.begin, attrs.end, step):
-        idx.append(slice(b, e, s))
-    return x[tuple(idx)]
+    return x[_slice_tuple(attrs, x.ndim)]
 
 
 @register("slice_axis", inputs=("data",),
@@ -471,3 +467,47 @@ def _fill_element_0index(attrs, lhs, mhs, rhs):
     out = lhs with out[i, rhs[i]] = mhs[i]."""
     rows = jnp.arange(lhs.shape[0])
     return lhs.at[rows, rhs.astype(jnp.int32)].set(mhs)
+
+
+@register("reshape_like", inputs=("lhs", "rhs"))
+def _reshape_like(attrs, lhs, rhs):
+    """reference elemwise_unary_op.cc reshape_like: lhs data, rhs shape."""
+    return lhs.reshape(rhs.shape)
+
+
+def _slice_tuple(attrs, ndim):
+    step = attrs.step or (None,) * len(attrs.begin)
+    idx = [slice(b, e, s) for b, e, s in zip(attrs.begin, attrs.end, step)]
+    return tuple(idx) + (slice(None),) * (ndim - len(idx))
+
+
+@register("_slice_assign", inputs=("lhs", "rhs"),
+          params=dict(begin=attr_shape(required=True),
+                      end=attr_shape(required=True),
+                      step=attr_shape(())),
+          aliases=("_crop_assign",))
+def _slice_assign(attrs, lhs, rhs):
+    """reference matrix_op.cc _slice_assign (the x[a:b] = y kernel)."""
+    return lhs.at[_slice_tuple(attrs, lhs.ndim)].set(rhs)
+
+
+@register("_slice_assign_scalar", inputs=("data",),
+          params=dict(scalar=attr_float(0.0),
+                      begin=attr_shape(required=True),
+                      end=attr_shape(required=True),
+                      step=attr_shape(())),
+          aliases=("_crop_assign_scalar",))
+def _slice_assign_scalar(attrs, data):
+    """reference matrix_op.cc _slice_assign_scalar (x[a:b] = c)."""
+    return data.at[_slice_tuple(attrs, data.ndim)].set(
+        jnp.asarray(attrs.scalar, data.dtype))
+
+
+@register("_scatter_set_nd", inputs=("lhs", "rhs", "indices"),
+          params=dict(shape=attr_shape(())))
+def _scatter_set_nd(attrs, lhs, rhs, indices):
+    """reference indexing_op.cc _scatter_set_nd: write rhs into lhs at
+    gather_nd-style indices (the advanced-indexing assignment kernel)."""
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    return lhs.at[tuple(idx[i] for i in range(m))].set(rhs)

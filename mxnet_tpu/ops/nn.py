@@ -579,3 +579,44 @@ def _ctc_loss(attrs, data, label):
     last2 = jnp.take_along_axis(alpha, (ext_len - 2)[:, None], axis=1)[:, 0]
     ll = jnp.logaddexp(last, last2)
     return -ll
+
+
+@register("softmax_cross_entropy", inputs=("data", "label"))
+def _softmax_cross_entropy(attrs, data, label):
+    """Total softmax CE loss as a length-1 array (reference
+    src/operator/loss_binary_op.cc)."""
+    logp = jax.nn.log_softmax(data, axis=-1)
+    picked = jnp.take_along_axis(
+        logp, label.astype(jnp.int32)[:, None], axis=-1)[:, 0]
+    return -jnp.sum(picked)[None]
+
+
+@register("IdentityAttachKLSparseReg", inputs=("data",),
+          params=dict(sparseness_target=attr_float(0.1),
+                      penalty=attr_float(0.001), momentum=attr_float(0.9)))
+def _identity_attach_kl_sparse_reg(attrs, x):
+    """Identity forward with a KL-sparseness penalty on the gradient
+    (reference src/operator/identity_attach_KL_sparse_reg-inl.h): the
+    backward adds penalty * (-rho/rho_hat + (1-rho)/(1-rho_hat)) where
+    rho_hat is the batch mean activation (sigmoid-range data assumed).
+    Stateless analog: rho_hat comes from the CURRENT batch (the reference
+    keeps a momentum-smoothed aux copy for logging; the gradient uses the
+    batch value the same way)."""
+    rho = attrs.sparseness_target
+    penalty = attrs.penalty
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def fwd(x):
+        return x, x
+
+    def bwd(saved, g):
+        rho_hat = jnp.clip(jnp.mean(saved, axis=0, keepdims=True),
+                           1e-6, 1 - 1e-6)
+        reg = penalty * (-rho / rho_hat + (1 - rho) / (1 - rho_hat))
+        return (g + reg.astype(g.dtype),)
+
+    f.defvjp(fwd, bwd)
+    return f(x)

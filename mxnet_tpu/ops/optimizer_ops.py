@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from ..base import attr_bool, attr_float
+from ..base import attr_bool, attr_float, attr_int
 from .registry import register
 
 _COMMON = dict(lr=attr_float(required=True), wd=attr_float(0.0),
@@ -269,3 +269,25 @@ def _multi_mp_sgd_mom_update(attrs, *args):
         ms.append(m2)
         w32s.append(new32)
     return tuple(ws + ms + w32s)
+
+
+@register("ftml_update", inputs=("weight", "grad", "d", "v", "z"),
+          params=dict(lr=attr_float(required=True), beta1=attr_float(0.6),
+                      beta2=attr_float(0.999), epsilon=attr_float(1e-8),
+                      t=attr_int(required=True), wd=attr_float(0.0),
+                      rescale_grad=attr_float(1.0),
+                      clip_grad=attr_float(-1.0)),
+          num_outputs=4, num_visible_outputs=1,
+          writeback={0: 0, 2: 1, 3: 2, 4: 3})
+def _ftml_update(attrs, weight, grad, d, v, z):
+    """FTML optimizer step (reference optimizer_op-inl.h:633 FTMLKernel)."""
+    g = attrs.rescale_grad * grad + attrs.wd * weight
+    if attrs.clip_grad >= 0:
+        g = jnp.clip(g, -attrs.clip_grad, attrs.clip_grad)
+    b1, b2, t = attrs.beta1, attrs.beta2, float(attrs.t)
+    v_new = b2 * v + (1 - b2) * jnp.square(g)
+    d_t = (1 - b1 ** t) / attrs.lr * (
+        jnp.sqrt(v_new / (1 - b2 ** t)) + attrs.epsilon)
+    z_new = b1 * z + (1 - b1) * g - (d_t - b1 * d) * weight
+    w_new = -z_new / d_t
+    return w_new, d_t, v_new, z_new

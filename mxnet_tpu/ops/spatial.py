@@ -15,7 +15,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..base import MXNetError, attr_bool, attr_int, attr_shape, attr_str
+from ..base import (MXNetError, attr_bool, attr_float_tuple,
+                    attr_int, attr_shape, attr_str)
 from .registry import register
 
 
@@ -199,3 +200,34 @@ def _crop(attrs, data, *rest):
     if y0 + th > h or x0 + tw > w:
         raise MXNetError("Crop: offset out of range")
     return data[:, :, y0:y0 + th, x0:x0 + tw]
+
+
+# ---------------------------------------------------------------------------
+# Image transform ops (reference src/operator/image/image_random.cc
+# _image_to_tensor / _image_normalize — the gluon transforms backend)
+# ---------------------------------------------------------------------------
+
+@register("_image_to_tensor", inputs=("data",), aliases=("image_to_tensor",))
+def _image_to_tensor(attrs, x):
+    """HWC (or NHWC) uint8 [0,255] -> CHW (NCHW) float32 [0,1]."""
+    out = x.astype(jnp.float32) / 255.0
+    if out.ndim == 3:
+        return jnp.transpose(out, (2, 0, 1))
+    return jnp.transpose(out, (0, 3, 1, 2))
+
+
+@register("_image_normalize", inputs=("data",),
+          params=dict(mean=attr_float_tuple(None),
+                      std=attr_float_tuple(None)),
+          aliases=("image_normalize",))
+def _image_normalize(attrs, x):
+    """Per-channel (x - mean) / std on CHW (or NCHW) float input."""
+    c_axis = 0 if x.ndim == 3 else 1
+    shape = [1] * x.ndim
+    shape[c_axis] = -1
+    out = x
+    if attrs.mean is not None:
+        out = out - jnp.asarray(attrs.mean, x.dtype).reshape(shape)
+    if attrs.std is not None:
+        out = out / jnp.asarray(attrs.std, x.dtype).reshape(shape)
+    return out

@@ -386,6 +386,37 @@ SPECS.update({
     "signsgd_update": S([_any(4), _any(4)], dict(lr=0.1)),
     "signum_update": S([_any(4), _any(4), _any(4)],
                        dict(lr=0.1, momentum=0.9)),
+    # round-3 completeness sweep (reference registrations diff)
+    "round": S([_farz(2, 3)],
+               ref=lambda a: np.sign(a) * np.floor(np.abs(a) + 0.5)),
+    "add_n": S([_any(2, 3), _any(2, 3), _any(2, 3)],
+               ref=lambda a, b, c: a + b + c),
+    "reshape_like": S([_any(2, 6), _any(3, 4)], out_shape=(3, 4)),
+    "softmax_cross_entropy": S(
+        [_any(4, 5), np.array([0, 1, 2, 3], np.float32)], out_shape=(1,)),
+    "ftml_update": S([_any(4), _any(4), np.ones(4, np.float32),
+                      _pos(4), _any(4)], dict(lr=0.1, t=1)),
+    "_linalg_syevd": S([(lambda m: (m + m.T) / 2)(_any(4, 4))],
+                       out_shape=(4, 4)),
+    "IdentityAttachKLSparseReg": S([_pos(4, 3)], grad=True,
+                                   ref=lambda a: a),
+    "_image_to_tensor": S(
+        [(_pos(5, 6, 3) * 255).astype(np.uint8)], out_shape=(3, 5, 6),
+        ref=lambda a: a.astype(np.float32).transpose(2, 0, 1) / 255.0),
+    "_image_normalize": S([_pos(3, 5, 6)],
+                          dict(mean=(0.5, 0.5, 0.5), std=(2.0, 2.0, 2.0)),
+                          ref=lambda a, **kw: (a - 0.5) / 2.0),
+    "_contrib_box_iou": S([_pos(3, 4).cumsum(-1), _pos(2, 4).cumsum(-1)],
+                          out_shape=(3, 2)),
+    "_contrib_box_nms": S([np.array([[1, 0.9, 0, 0, 1, 1],
+                                     [1, 0.8, 0, 0, 1, 1],
+                                     [0, 0.7, 2, 2, 3, 3]], np.float32)],
+                          dict(overlap_thresh=0.5, coord_start=2,
+                               score_index=1, id_index=0),
+                          out_shape=(3, 6)),
+    "_contrib_bipartite_matching": S(
+        [np.array([[0.9, 0.1], [0.2, 0.8]], np.float32)],
+        dict(threshold=0.05), out_shape=None),
 })
 
 # Ops whose coverage lives in a dedicated test file (kept explicit so the
@@ -478,3 +509,29 @@ def test_correlation_subtract_mode():
     out2 = mx.nd.Correlation(a, a, kernel_size=1, max_displacement=0,
                              is_multiply=False)
     np.testing.assert_allclose(out2.asnumpy(), np.zeros((1, 1, 3, 3)))
+
+
+def test_box_iou_outer_batch_semantics():
+    """reference bounding_box.cc: output is lhs.shape[:-1]+rhs.shape[:-1]."""
+    rs = np.random.RandomState(0)
+    lhs = mx.nd.array(np.abs(rs.rand(2, 3, 4)).cumsum(-1).astype(np.float32))
+    rhs = mx.nd.array(np.abs(rs.rand(5, 4)).cumsum(-1).astype(np.float32))
+    out = mx.nd.contrib.box_iou(lhs, rhs)
+    assert out.shape == (2, 3, 5)
+    same = mx.nd.contrib.box_iou(rhs, rhs).asnumpy()
+    np.testing.assert_allclose(np.diag(same), np.ones(5), rtol=1e-5)
+
+
+def test_box_nms_background_and_format():
+    data = np.array([
+        [0, 0.9, 0.5, 0.5, 1.0, 1.0],    # background (id 0)
+        [1, 0.8, 0.5, 0.5, 1.0, 1.0],    # kept (center format)
+        [1, 0.7, 0.5, 0.5, 1.0, 1.0],    # suppressed by the one above
+    ], np.float32)
+    out = mx.nd.contrib.box_nms(
+        mx.nd.array(data), overlap_thresh=0.5, coord_start=2, score_index=1,
+        id_index=0, background_id=0, in_format="center",
+        out_format="corner").asnumpy()
+    assert (out[0] == -1).all()          # background dropped
+    assert (out[2] == -1).all()          # duplicate suppressed
+    np.testing.assert_allclose(out[1, 2:], [0, 0, 1, 1], atol=1e-6)
