@@ -4,7 +4,9 @@ from . import lenet
 from . import mlp
 from . import alexnet
 from . import vgg
+from . import transformer
 
 get_resnet = resnet.get_symbol
 get_lenet = lenet.get_symbol
 get_mlp = mlp.get_symbol
+get_transformer = transformer.get_symbol

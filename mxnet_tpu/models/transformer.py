@@ -1,0 +1,62 @@
+"""Decoder-only transformer language model (beyond-reference: the
+reference predates attention, SURVEY §5.7 — this is the TPU-era model
+family built on the stack's own pieces: Embedding, the Pallas
+fused-attention op, LayerNorm, and SoftmaxOutput).
+
+Layout: tokens (N, T) -> Embedding (N, T, D) + learned positions ->
+L x [pre-LN causal self-attention + pre-LN GELU FFN, residuals] ->
+LN -> vocab head -> per-token SoftmaxOutput against labels (N, T).
+"""
+from .. import symbol as sym
+
+
+def _block(x, hidden, heads, seq_len, idx):
+    p = "l%d_" % idx
+    head_dim = hidden // heads
+    # attention (pre-norm)
+    a = sym.LayerNorm(x, name=p + "ln1")
+    q = sym.FullyConnected(a, num_hidden=hidden, flatten=False,
+                           name=p + "q")
+    k = sym.FullyConnected(a, num_hidden=hidden, flatten=False,
+                           name=p + "k")
+    v = sym.FullyConnected(a, num_hidden=hidden, flatten=False,
+                           name=p + "v")
+    shape4 = (-1, seq_len, heads, head_dim)
+    att = sym.contrib.fused_attention(
+        sym.Reshape(q, shape=shape4), sym.Reshape(k, shape=shape4),
+        sym.Reshape(v, shape=shape4), causal=True, name=p + "attn")
+    att = sym.Reshape(att, shape=(-1, seq_len, hidden))
+    att = sym.FullyConnected(att, num_hidden=hidden, flatten=False,
+                             name=p + "proj")
+    x = x + att
+    # FFN (pre-norm)
+    f = sym.LayerNorm(x, name=p + "ln2")
+    f = sym.FullyConnected(f, num_hidden=hidden * 4, flatten=False,
+                           name=p + "ff1")
+    f = sym.Activation(f, act_type="gelu", name=p + "act")
+    f = sym.FullyConnected(f, num_hidden=hidden, flatten=False,
+                           name=p + "ff2")
+    return x + f
+
+
+def get_symbol(vocab_size=1000, seq_len=32, num_layers=2, hidden=64,
+               heads=4, **kwargs):
+    """Returns a SoftmaxOutput-headed LM symbol.
+
+    data: (N, T) token ids; softmax_label: (N, T) next-token ids.  The
+    head flattens to (N*T, vocab) so the standard per-row softmax head
+    and Perplexity metric apply unchanged."""
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    pos = sym.Variable("pos_embed", shape=(seq_len, hidden))
+    tok = sym.Embedding(data, input_dim=vocab_size, output_dim=hidden,
+                        name="tok_embed")
+    x = sym.broadcast_add(tok, sym.expand_dims(pos, axis=0))
+    for i in range(num_layers):
+        x = _block(x, hidden, heads, seq_len, i)
+    x = sym.LayerNorm(x, name="ln_f")
+    logits = sym.FullyConnected(x, num_hidden=vocab_size, flatten=False,
+                                name="head")
+    logits = sym.Reshape(logits, shape=(-1, vocab_size))
+    label_f = sym.Reshape(label, shape=(-1,))
+    return sym.SoftmaxOutput(logits, label_f, name="softmax")

@@ -193,6 +193,9 @@ def _activation(attrs, x):
         "tanh": jnp.tanh,
         "softrelu": jax.nn.softplus,
         "softsign": jax.nn.soft_sign,
+        # TPU-era extension (later-reference LeakyReLU gelu mode);
+        # exact erf formulation, matching the reference GELU
+        "gelu": lambda v: jax.nn.gelu(v, approximate=False),
     }[attrs.act_type](x)
 
 
@@ -222,6 +225,9 @@ def _leaky_relu(attrs, key, x, gamma=None):
         else:
             slope = (attrs.lower_bound + attrs.upper_bound) / 2.0
         return jnp.where(x >= 0, x, slope * x)
+    if t == "gelu":
+        # the later-reference spelling LeakyReLU(act_type='gelu'); exact erf
+        return jax.nn.gelu(x, approximate=False)
     raise ValueError("unknown act_type %s" % t)
 
 
@@ -620,3 +626,54 @@ def _identity_attach_kl_sparse_reg(attrs, x):
 
     f.defvjp(fwd, bwd)
     return f(x)
+
+
+# ---------------------------------------------------------------------------
+# Fused attention (Pallas kernel as a graph op) — beyond-reference: the
+# reference predates attention (SURVEY §5.7); this exposes
+# ops/pallas_kernels.fused_attention to Symbol/Gluon models.
+# ---------------------------------------------------------------------------
+
+@register("_contrib_fused_attention", inputs=("query", "key", "value"),
+          params=dict(causal=attr_bool(False), scale=attr_float(0.0),
+                      block_q=attr_int(128)),
+          aliases=("fused_attention",))
+def _contrib_fused_attention(attrs, q, k, v):
+    """Attention over (B, T, H, D) with the VMEM-resident-score Pallas
+    kernel as the forward; the backward differentiates the reference
+    einsum formulation (numerically identical), so the op trains while
+    the hot forward path never materializes (T, T) in HBM."""
+    scale = attrs.scale if attrs.scale > 0 else 1.0 / float(q.shape[-1]) ** 0.5
+    causal = attrs.causal
+    block_q = attrs.block_q
+    if block_q < 1:
+        raise MXNetError("fused_attention: block_q must be >= 1, got %d"
+                         % block_q)
+
+    def naive(q, k, v):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        if causal:
+            Tq, Tk = q.shape[1], k.shape[1]
+            mask = jnp.tril(jnp.ones((Tq, Tk), bool))
+            s = jnp.where(mask, s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+    @jax.custom_vjp
+    def attn(q, k, v):
+        from .pallas_kernels import fused_attention
+        bq = block_q
+        while q.shape[1] % bq:
+            bq //= 2   # clamp to a divisor of T
+        return fused_attention(q, k, v, causal=causal, scale=scale,
+                               block_q=max(bq, 1))
+
+    def fwd(q, k, v):
+        return attn(q, k, v), (q, k, v)
+
+    def bwd(res, g):
+        _, vjp = jax.vjp(naive, *res)
+        return vjp(g)
+
+    attn.defvjp(fwd, bwd)
+    return attn(q, k, v)

@@ -424,6 +424,8 @@ SPECS.update({
 KNOWN_ELSEWHERE = {
     "RNN": "tests/test_rnn.py (cells, fused layers, bucketing)",
     "Custom": "tests/test_custom_op.py (frontend-defined ops)",
+    "_contrib_fused_attention":
+        "tests/test_transformer.py (naive parity + custom-vjp gradients)",
 }
 
 

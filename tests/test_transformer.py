@@ -1,0 +1,70 @@
+"""Transformer LM model family + fused-attention graph op (beyond the
+attention-less reference; SURVEY §5.7 long-context pillar)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.models.transformer import get_symbol
+
+
+def test_fused_attention_op_matches_naive_and_trains():
+    rs = np.random.RandomState(0)
+    B, T, H, D = 2, 16, 2, 8
+    q = nd.array(rs.normal(0, 1, (B, T, H, D)).astype(np.float32))
+    k = nd.array(rs.normal(0, 1, (B, T, H, D)).astype(np.float32))
+    v = nd.array(rs.normal(0, 1, (B, T, H, D)).astype(np.float32))
+    out = nd.contrib.fused_attention(q, k, v, causal=True).asnumpy()
+    # naive reference
+    s = np.einsum("bqhd,bkhd->bhqk", q.asnumpy(), k.asnumpy()) / np.sqrt(D)
+    mask = np.tril(np.ones((T, T), bool))
+    s = np.where(mask, s, -np.inf)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    want = np.einsum("bhqk,bkhd->bqhd", p, v.asnumpy())
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+    # gradients flow through the custom vjp
+    gq = nd.zeros((B, T, H, D))
+    mx.autograd.mark_variables([q], [gq])
+    with mx.autograd.record():
+        o = nd.contrib.fused_attention(q, k, v, causal=True)
+        mx.autograd.backward([o])
+    assert np.isfinite(gq.asnumpy()).all() and np.abs(gq.asnumpy()).sum() > 0
+
+
+def test_transformer_lm_learns_periodic_sequences():
+    """Next-token prediction on period-2 token streams: a 1-layer causal
+    transformer must beat the uniform-perplexity floor decisively."""
+    vocab, T = 12, 8
+    rs = np.random.RandomState(0)
+    n = 64
+    X = np.zeros((n, T), np.float32)
+    for i in range(n):
+        a, b = rs.randint(1, vocab, 2)
+        X[i] = [a if t % 2 == 0 else b for t in range(T)]
+    Y = np.roll(X, -1, axis=1)
+    Y[:, -1] = 0
+
+    net = get_symbol(vocab_size=vocab, seq_len=T, num_layers=1,
+                     hidden=32, heads=2)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    it = mx.io.NDArrayIter(X, Y, batch_size=16, label_name="softmax_label")
+    metric = mx.metric.Perplexity(ignore_label=None)
+    mod.fit(it, num_epoch=15, initializer=mx.init.Xavier(),
+            optimizer="adam", optimizer_params={"learning_rate": 0.01},
+            eval_metric=metric)
+    it.reset()
+    metric.reset()
+    mod.score(it, metric)
+    ppl = dict(metric.get_name_value())["perplexity"]
+    assert ppl < 6.0, ppl   # uniform would be 12
+
+
+def test_transformer_symbol_shapes():
+    net = get_symbol(vocab_size=20, seq_len=16, num_layers=2, hidden=32,
+                     heads=4)
+    args = net.list_arguments()
+    assert "pos_embed" in args and "tok_embed_weight" in args
+    arg_shapes, out_shapes, _ = net.infer_shape(
+        data=(4, 16), softmax_label=(4, 16))
+    assert out_shapes == [(64, 20)]   # (N*T, vocab)
