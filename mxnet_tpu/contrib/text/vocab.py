@@ -2,8 +2,8 @@
 
 Indexing rules (reference :79-139): the unknown token takes index 0,
 reserved tokens follow, then counter keys by descending frequency with
-ties broken lexically; `most_freq_count` caps the total size INCLUDING
-unknown+reserved; tokens under `min_freq` are dropped.
+ties broken lexically; `most_freq_count` caps how many COUNTER tokens are
+kept (specials are on top of it); tokens under `min_freq` are dropped.
 """
 from __future__ import annotations
 
@@ -26,8 +26,7 @@ class Vocabulary:
         self._idx_to_token = [unknown_token] + (self._reserved_tokens or [])
         if counter is not None:
             special = set(self._idx_to_token)
-            budget = None if most_freq_count is None \
-                else most_freq_count - len(self._idx_to_token)
+            budget = most_freq_count
             # stable order: frequency desc, then token asc
             ranked = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
             for token, freq in ranked:

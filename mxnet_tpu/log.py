@@ -39,13 +39,15 @@ class _Formatter(logging.Formatter):
         return super().format(record)
 
 
-def get_logger(name=None, filename=None, filemode=None, level=WARNING):
+def get_logger(name=None, filename=None, filemode=None, level=None):
     """A logger with ONE handler installed on first call (reference
     log.py:90): file handler when `filename` given, colored stream
-    handler otherwise."""
+    handler otherwise.  `level=None` (the default sentinel) means
+    WARNING on first install and no-change on re-calls, so an explicit
+    level — including WARNING — always applies."""
     logger = logging.getLogger(name)
     if getattr(logger, "_mxt_handler_installed", False):
-        if level != WARNING:   # only an explicit level overrides
+        if level is not None:
             logger.setLevel(level)
         return logger
     if filename:
@@ -56,7 +58,7 @@ def get_logger(name=None, filename=None, filemode=None, level=WARNING):
         handler.setFormatter(_Formatter(
             colored=hasattr(sys.stderr, "isatty") and sys.stderr.isatty()))
     logger.addHandler(handler)
-    logger.setLevel(level)
+    logger.setLevel(WARNING if level is None else level)
     if name:
         # named loggers own their output; don't double-emit through root
         logger.propagate = False
@@ -64,6 +66,6 @@ def get_logger(name=None, filename=None, filemode=None, level=WARNING):
     return logger
 
 
-def getLogger(name=None, filename=None, filemode=None, level=WARNING):
+def getLogger(name=None, filename=None, filemode=None, level=None):
     """Deprecated reference alias of get_logger."""
     return get_logger(name, filename, filemode, level)

@@ -566,9 +566,10 @@ def _contrib_bipartite_matching(attrs, data):
             masked = jnp.where(avail, s, -jnp.inf)
             flat = jnp.argmax(masked)
             i, j = flat // M, flat % M
-            # threshold applies in the ORIGINAL ordering sense: scores must
-            # beat it when descending, stay under it when ascending
-            ok = jnp.where(sign > 0, mat[i, j] >= thr, mat[i, j] <= thr) \
+            # threshold applies in the ORIGINAL ordering sense, strictly
+            # (reference bounding_box-inl.h:636): scores must beat it when
+            # descending, stay strictly under it when ascending
+            ok = jnp.where(sign > 0, mat[i, j] > thr, mat[i, j] < thr) \
                 & jnp.isfinite(masked[i, j])
             row_as = jnp.where(ok, row_as.at[i].set(j), row_as)
             col_as = jnp.where(ok, col_as.at[j].set(i), col_as)

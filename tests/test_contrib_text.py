@@ -34,10 +34,11 @@ def test_vocabulary_indexing_rules():
     assert v.to_tokens([0, 2]) == ["<unk>", "a"]
     with pytest.raises(ValueError):
         v.to_tokens(99)
-    # most_freq_count caps INCLUDING specials
-    v2 = text.Vocabulary(counter, most_freq_count=4, min_freq=1,
+    # most_freq_count caps counter tokens only; specials come on top
+    v2 = text.Vocabulary(counter, most_freq_count=3, min_freq=1,
                          reserved_tokens=["<pad>"])
-    assert len(v2) == 4 and v2.idx_to_token == ["<unk>", "<pad>", "a", "b"]
+    assert len(v2) == 5
+    assert v2.idx_to_token == ["<unk>", "<pad>", "a", "b", "c"]
     with pytest.raises(ValueError):
         text.Vocabulary(counter, reserved_tokens=["<unk>"])
 
