@@ -1,108 +1,139 @@
-"""Training callbacks (reference python/mxnet/callback.py)."""
+"""Training-loop hooks.
+
+The fit loop (module/base_module.py, model.py) invokes two kinds of hook:
+
+* epoch hooks   — ``f(epoch, symbol, arg_params, aux_params)`` after each
+  epoch; used for checkpointing.
+* batch hooks   — ``f(BatchEndParam)`` after each batch (and at eval end);
+  used for throughput logging, metric printing, progress display.
+
+Everything here is a plain callable, so users can mix these with their own
+closures.  API surface mirrors reference python/mxnet/callback.py (cited
+per hook); the implementations are TPU-stack-local — note in particular
+that under XLA async dispatch a wall-clock speedometer measures *dispatch*
+rate unless the step result is fetched, which the fit loop does when it
+updates the metric, so the numbers here are honest.
+"""
 from __future__ import annotations
 
 import logging
-import math
 import time
 
 __all__ = ["module_checkpoint", "do_checkpoint", "log_train_metric",
            "Speedometer", "ProgressBar", "LogValidationMetricsCallback"]
 
 
-def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
-    """reference callback.py:28"""
-    period = int(max(1, period))
+def _metric_pairs(metric):
+    """name/value pairs of a metric, or () when there is no metric."""
+    return tuple(metric.get_name_value()) if metric is not None else ()
 
-    def _callback(iter_no, sym=None, arg=None, aux=None):
-        if (iter_no + 1) % period == 0:
-            mod.save_checkpoint(prefix, iter_no + 1, save_optimizer_states)
 
-    return _callback
+def _epoch_gate(period):
+    """True on epochs 0-indexed e where (e+1) is a multiple of period."""
+    period = max(1, int(period))
+    return lambda epoch: (epoch + 1) % period == 0
 
 
 def do_checkpoint(prefix, period=1):
-    """reference callback.py:55 — save symbol+params each `period` epochs."""
+    """Epoch hook: write ``prefix-symbol.json`` / ``prefix-NNNN.params``
+    every `period` epochs (reference callback.py:55)."""
     from .model import save_checkpoint
-    period = int(max(1, period))
+    hit = _epoch_gate(period)
 
-    def _callback(iter_no, sym, arg, aux):
-        if (iter_no + 1) % period == 0:
-            save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
+    def hook(epoch, sym, arg, aux):
+        if hit(epoch):
+            save_checkpoint(prefix, epoch + 1, sym, arg, aux)
+    return hook
 
-    return _callback
+
+def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
+    """Epoch hook bound to a Module: checkpoint through the module so
+    optimizer state can ride along (reference callback.py:28)."""
+    hit = _epoch_gate(period)
+
+    def hook(epoch, sym=None, arg=None, aux=None):
+        if hit(epoch):
+            mod.save_checkpoint(prefix, epoch + 1, save_optimizer_states)
+    return hook
 
 
 def log_train_metric(period, auto_reset=False):
-    """reference callback.py:93"""
+    """Batch hook: print the running training metric every `period`
+    batches (reference callback.py:93)."""
 
-    def _callback(param):
-        if param.nbatch % period == 0 and param.eval_metric is not None:
-            name_value = param.eval_metric.get_name_value()
-            for name, value in name_value:
-                logging.info("Iter[%d] Batch[%d] Train-%s=%f",
-                             param.epoch, param.nbatch, name, value)
-            if auto_reset:
-                param.eval_metric.reset()
-
-    return _callback
+    def hook(param):
+        if param.nbatch % period:
+            return
+        for name, value in _metric_pairs(param.eval_metric):
+            logging.info("Iter[%d] Batch[%d] Train-%s=%f",
+                         param.epoch, param.nbatch, name, value)
+        if auto_reset and param.eval_metric is not None:
+            param.eval_metric.reset()
+    return hook
 
 
 class Speedometer:
-    """samples/sec logger (reference callback.py:120)."""
+    """Batch hook: samples/sec over each window of `frequent` batches,
+    plus the running metric (reference callback.py:120).
+
+    The clock starts at the first batch seen (so compile time of the
+    first step is excluded from the first window) and restarts whenever
+    `nbatch` goes backwards, i.e. at every new epoch.
+    """
 
     def __init__(self, batch_size, frequent=50, auto_reset=True):
         self.batch_size = batch_size
         self.frequent = frequent
-        self.init = False
-        self.tic = 0
-        self.last_count = 0
         self.auto_reset = auto_reset
+        self._window_start = None   # wall-clock at window open, or None
+        self._prev_nbatch = 0
 
     def __call__(self, param):
-        count = param.nbatch
-        if self.last_count > count:
-            self.init = False
-        self.last_count = count
-        if self.init:
-            if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / (time.time() - self.tic)
-                if param.eval_metric is not None:
-                    name_value = param.eval_metric.get_name_value()
-                    if self.auto_reset:
-                        param.eval_metric.reset()
-                    msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec"
-                    msg += "\t%s=%f" * len(name_value)
-                    logging.info(msg, param.epoch, count, speed,
-                                 *sum(name_value, ()))
-                else:
-                    logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                                 param.epoch, count, speed)
-                self.tic = time.time()
+        n = param.nbatch
+        if n < self._prev_nbatch:          # epoch rolled over
+            self._window_start = None
+        self._prev_nbatch = n
+        if self._window_start is None:
+            self._window_start = time.time()
+            return
+        if n % self.frequent:
+            return
+        elapsed = time.time() - self._window_start
+        rate = self.frequent * self.batch_size / max(elapsed, 1e-12)
+        pairs = _metric_pairs(param.eval_metric)
+        if pairs:
+            if self.auto_reset:
+                param.eval_metric.reset()
+            tail = "".join("\t%s=%f" % kv for kv in pairs)
+            logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec%s",
+                         param.epoch, n, rate, tail)
         else:
-            self.init = True
-            self.tic = time.time()
+            logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
+                         param.epoch, n, rate)
+        self._window_start = time.time()
 
 
 class ProgressBar:
-    """reference callback.py:187"""
+    """Batch hook: render an ASCII completion bar sized to `total`
+    batches (reference callback.py:187)."""
 
     def __init__(self, total, length=80):
-        self.bar_len = length
         self.total = total
+        self.bar_len = length
 
     def __call__(self, param):
-        count = param.nbatch
-        filled_len = int(round(self.bar_len * count / float(self.total)))
-        percents = math.ceil(100.0 * count / float(self.total))
-        prog_bar = "=" * filled_len + "-" * (self.bar_len - filled_len)
-        logging.info("[%s] %s%s\r", prog_bar, percents, "%")
+        frac = param.nbatch / float(self.total)
+        ticks = int(round(self.bar_len * frac))
+        pct = int(-(-100.0 * frac // 1))     # ceil without math import
+        logging.info("[%s] %s%%\r",
+                     "=" * ticks + "-" * (self.bar_len - ticks), pct)
 
 
 class LogValidationMetricsCallback:
-    """reference callback.py:211"""
+    """Eval-end hook: print each validation metric for the epoch
+    (reference callback.py:211)."""
 
     def __call__(self, param):
-        if not param.eval_metric:
-            return
-        for name, value in param.eval_metric.get_name_value():
-            logging.info("Epoch[%d] Validation-%s=%f", param.epoch, name, value)
+        for name, value in _metric_pairs(param.eval_metric):
+            logging.info("Epoch[%d] Validation-%s=%f",
+                         param.epoch, name, value)

@@ -1,10 +1,18 @@
-"""Gluon vision model zoo (reference
-python/mxnet/gluon/model_zoo/vision/{resnet,vgg,alexnet,squeezenet,densenet,
-mobilenet}.py) — architectures rebuilt on the HybridBlock layers."""
+"""Gluon vision model zoo.
+
+Reference analog: python/mxnet/gluon/model_zoo/vision/{resnet,vgg,
+alexnet,squeezenet,densenet,mobilenet,inception}.py.  Rebuilt here in a
+single declarative style: every family is a data table (stage widths,
+repeat counts, fire/branch specs) consumed by a handful of builders —
+``_cba`` (conv[+BN][+act]), ``_stack``, residual units, and the
+Inception branch DSL.  No pretrained weights ship in this environment;
+``pretrained=True`` raises.
+"""
 from __future__ import annotations
 
 from .. import nn
 from ..block import HybridBlock
+from ..contrib.nn import HybridConcurrent
 
 __all__ = ["get_model", "resnet18_v1", "resnet34_v1", "resnet50_v1",
            "resnet101_v1", "resnet152_v1", "resnet18_v2", "resnet34_v2",
@@ -18,281 +26,223 @@ __all__ = ["get_model", "resnet18_v1", "resnet34_v1", "resnet50_v1",
            "inception_v3", "HybridConcurrent"]
 
 
-# ---------------------------------------------------------------------------
-# ResNet
-# ---------------------------------------------------------------------------
+# -- shared builders --------------------------------------------------------
 
-def _conv3x3(channels, stride, in_channels):
-    return nn.Conv2D(channels, kernel_size=3, strides=stride, padding=1,
-                     use_bias=False, in_channels=in_channels)
-
-
-class BasicBlockV1(HybridBlock):
-    def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
-        super().__init__(**kwargs)
-        self.body = nn.HybridSequential(prefix="")
-        self.body.add(_conv3x3(channels, stride, in_channels))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(_conv3x3(channels, 1, channels))
-        self.body.add(nn.BatchNorm())
-        if downsample:
-            self.downsample = nn.HybridSequential(prefix="")
-            self.downsample.add(nn.Conv2D(channels, kernel_size=1,
-                                          strides=stride, use_bias=False,
-                                          in_channels=in_channels))
-            self.downsample.add(nn.BatchNorm())
-        else:
-            self.downsample = None
-
-    def hybrid_forward(self, F, x):
-        residual = x
-        x = self.body(x)
-        if self.downsample:
-            residual = self.downsample(residual)
-        return F.Activation(residual + x, act_type="relu")
+def _stack(*parts):
+    seq = nn.HybridSequential(prefix="")
+    for p in parts:
+        seq.add(p)
+    return seq
 
 
-class BottleneckV1(HybridBlock):
-    def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
-        super().__init__(**kwargs)
-        self.body = nn.HybridSequential(prefix="")
-        self.body.add(nn.Conv2D(channels // 4, kernel_size=1, strides=stride))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(_conv3x3(channels // 4, 1, channels // 4))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(channels, kernel_size=1, strides=1))
-        self.body.add(nn.BatchNorm())
-        if downsample:
-            self.downsample = nn.HybridSequential(prefix="")
-            self.downsample.add(nn.Conv2D(channels, kernel_size=1,
-                                          strides=stride, use_bias=False,
-                                          in_channels=in_channels))
-            self.downsample.add(nn.BatchNorm())
-        else:
-            self.downsample = None
-
-    def hybrid_forward(self, F, x):
-        residual = x
-        x = self.body(x)
-        if self.downsample:
-            residual = self.downsample(residual)
-        return F.Activation(x + residual, act_type="relu")
+def _cba(channels, kernel=1, stride=1, pad=0, groups=1, act="relu",
+         bn=True, bias=None, bn_eps=1e-5):
+    """conv [+ BatchNorm] [+ activation]; bias defaults to not-bn."""
+    seq = nn.HybridSequential(prefix="")
+    seq.add(nn.Conv2D(channels, kernel_size=kernel, strides=stride,
+                      padding=pad, groups=groups,
+                      use_bias=not bn if bias is None else bias))
+    if bn:
+        seq.add(nn.BatchNorm(epsilon=bn_eps))
+    if act:
+        seq.add(nn.Activation(act))
+    return seq
 
 
-class BasicBlockV2(HybridBlock):
-    def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
-        super().__init__(**kwargs)
-        self.bn1 = nn.BatchNorm()
-        self.conv1 = _conv3x3(channels, stride, in_channels)
-        self.bn2 = nn.BatchNorm()
-        self.conv2 = _conv3x3(channels, 1, channels)
-        if downsample:
-            self.downsample = nn.Conv2D(channels, 1, stride, use_bias=False,
-                                        in_channels=in_channels)
-        else:
-            self.downsample = None
-
-    def hybrid_forward(self, F, x):
-        residual = x
-        x = self.bn1(x)
-        x = F.Activation(x, act_type="relu")
-        if self.downsample:
-            residual = self.downsample(x)
-        x = self.conv1(x)
-        x = self.bn2(x)
-        x = F.Activation(x, act_type="relu")
-        x = self.conv2(x)
-        return x + residual
+def _no_pretrained(flag):
+    if flag:
+        raise RuntimeError("pretrained weights are unavailable in this "
+                           "environment (no network); initialize instead")
 
 
-class BottleneckV2(HybridBlock):
-    def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
-        super().__init__(**kwargs)
-        self.bn1 = nn.BatchNorm()
-        self.conv1 = nn.Conv2D(channels // 4, kernel_size=1, strides=1,
-                               use_bias=False)
-        self.bn2 = nn.BatchNorm()
-        self.conv2 = _conv3x3(channels // 4, stride, channels // 4)
-        self.bn3 = nn.BatchNorm()
-        self.conv3 = nn.Conv2D(channels, kernel_size=1, strides=1,
-                               use_bias=False)
-        if downsample:
-            self.downsample = nn.Conv2D(channels, 1, stride, use_bias=False,
-                                        in_channels=in_channels)
-        else:
-            self.downsample = None
+# -- ResNet -----------------------------------------------------------------
+#
+# Depth table: repeats per stage, stage output widths, bottleneck?.
+# The unit plans are (channels, kernel, stride, pad) conv steps; v1 units
+# are post-activation (conv-bn-relu body, relu after the add), v2 units
+# are pre-activation (bn-relu before every conv, clean add).
 
-    def hybrid_forward(self, F, x):
-        residual = x
-        x = self.bn1(x)
-        x = F.Activation(x, act_type="relu")
-        if self.downsample:
-            residual = self.downsample(x)
-        x = self.conv1(x)
-        x = self.bn2(x)
-        x = F.Activation(x, act_type="relu")
-        x = self.conv2(x)
-        x = self.bn3(x)
-        x = F.Activation(x, act_type="relu")
-        x = self.conv3(x)
-        return x + residual
-
-
-class ResNetV1(HybridBlock):
-    def __init__(self, block, layers, channels, classes=1000,
-                 thumbnail=False, **kwargs):
-        super().__init__(**kwargs)
-        assert len(layers) == len(channels) - 1
-        with self.name_scope():
-            self.features = nn.HybridSequential(prefix="")
-            if thumbnail:
-                self.features.add(_conv3x3(channels[0], 1, 0))
-            else:
-                self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
-                                            use_bias=False))
-                self.features.add(nn.BatchNorm())
-                self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(3, 2, 1))
-            for i, num_layer in enumerate(layers):
-                stride = 1 if i == 0 else 2
-                self.features.add(self._make_layer(
-                    block, num_layer, channels[i + 1], stride, i + 1,
-                    in_channels=channels[i]))
-            self.features.add(nn.GlobalAvgPool2D())
-            self.output = nn.Dense(classes, in_units=channels[-1])
-
-    def _make_layer(self, block, layers, channels, stride, stage_index,
-                    in_channels=0):
-        layer = nn.HybridSequential(prefix="stage%d_" % stage_index)
-        with layer.name_scope():
-            layer.add(block(channels, stride, channels != in_channels,
-                            in_channels=in_channels, prefix=""))
-            for _ in range(layers - 1):
-                layer.add(block(channels, 1, False, in_channels=channels,
-                                prefix=""))
-        return layer
-
-    def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
-
-
-class ResNetV2(HybridBlock):
-    def __init__(self, block, layers, channels, classes=1000,
-                 thumbnail=False, **kwargs):
-        super().__init__(**kwargs)
-        assert len(layers) == len(channels) - 1
-        with self.name_scope():
-            self.features = nn.HybridSequential(prefix="")
-            self.features.add(nn.BatchNorm(scale=False, center=False))
-            if thumbnail:
-                self.features.add(_conv3x3(channels[0], 1, 0))
-            else:
-                self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
-                                            use_bias=False))
-                self.features.add(nn.BatchNorm())
-                self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(3, 2, 1))
-            in_channels = channels[0]
-            for i, num_layer in enumerate(layers):
-                stride = 1 if i == 0 else 2
-                self.features.add(self._make_layer(
-                    block, num_layer, channels[i + 1], stride, i + 1,
-                    in_channels=in_channels))
-                in_channels = channels[i + 1]
-            self.features.add(nn.BatchNorm())
-            self.features.add(nn.Activation("relu"))
-            self.features.add(nn.GlobalAvgPool2D())
-            self.features.add(nn.Flatten())
-            self.output = nn.Dense(classes, in_units=in_channels)
-
-    _make_layer = ResNetV1._make_layer
-
-    def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
-
-
-resnet_spec = {
-    18: ("basic_block", [2, 2, 2, 2], [64, 64, 128, 256, 512]),
-    34: ("basic_block", [3, 4, 6, 3], [64, 64, 128, 256, 512]),
-    50: ("bottle_neck", [3, 4, 6, 3], [64, 256, 512, 1024, 2048]),
-    101: ("bottle_neck", [3, 4, 23, 3], [64, 256, 512, 1024, 2048]),
-    152: ("bottle_neck", [3, 8, 36, 3], [64, 256, 512, 1024, 2048]),
+_RESNET_DEPTHS = {
+    18:  ([2, 2, 2, 2],  [64, 64, 128, 256, 512],     False),
+    34:  ([3, 4, 6, 3],  [64, 64, 128, 256, 512],     False),
+    50:  ([3, 4, 6, 3],  [64, 256, 512, 1024, 2048],  True),
+    101: ([3, 4, 23, 3], [64, 256, 512, 1024, 2048],  True),
+    152: ([3, 8, 36, 3], [64, 256, 512, 1024, 2048],  True),
 }
-resnet_net_versions = [ResNetV1, ResNetV2]
-resnet_block_versions = [
-    {"basic_block": BasicBlockV1, "bottle_neck": BottleneckV1},
-    {"basic_block": BasicBlockV2, "bottle_neck": BottleneckV2},
-]
+
+
+def _unit_plan(width, stride, bottleneck, preact):
+    if not bottleneck:
+        return [(width, 3, stride, 1), (width, 3, 1, 1)]
+    mid = width // 4
+    if preact:     # v2 strides on the middle 3x3
+        return [(mid, 1, 1, 0), (mid, 3, stride, 1), (width, 1, 1, 0)]
+    return [(mid, 1, stride, 0), (mid, 3, 1, 1), (width, 1, 1, 0)]
+
+
+class _UnitV1(HybridBlock):
+    """Post-activation residual unit (He et al. 2015)."""
+
+    def __init__(self, width, stride, bottleneck, rewire, in_width,
+                 **kwargs):
+        super().__init__(**kwargs)
+        plan = _unit_plan(width, stride, bottleneck, preact=False)
+        self.body = _stack(*[
+            _cba(c, k, s, p, act="relu" if i + 1 < len(plan) else None)
+            for i, (c, k, s, p) in enumerate(plan)])
+        self.skip = _cba(width, 1, stride, act=None) if rewire else None
+
+    def hybrid_forward(self, F, x):
+        route = x if self.skip is None else self.skip(x)
+        return F.Activation(self.body(x) + route, act_type="relu")
+
+
+class _UnitV2(HybridBlock):
+    """Pre-activation residual unit (He et al. 2016): bn-relu precedes
+    each conv, and the first pre-activation also feeds the shortcut."""
+
+    def __init__(self, width, stride, bottleneck, rewire, in_width,
+                 **kwargs):
+        super().__init__(**kwargs)
+        plan = _unit_plan(width, stride, bottleneck, preact=True)
+        self._n = len(plan)
+        for i, (c, k, s, p) in enumerate(plan):
+            setattr(self, "norm%d" % i, nn.BatchNorm())
+            setattr(self, "conv%d" % i,
+                    nn.Conv2D(c, kernel_size=k, strides=s, padding=p,
+                              use_bias=False))
+        self.skip = (nn.Conv2D(width, 1, stride, use_bias=False)
+                     if rewire else None)
+
+    def hybrid_forward(self, F, x):
+        pre = F.Activation(self.norm0(x), act_type="relu")
+        route = x if self.skip is None else self.skip(pre)
+        y = self.conv0(pre)
+        for i in range(1, self._n):
+            y = F.Activation(getattr(self, "norm%d" % i)(y),
+                             act_type="relu")
+            y = getattr(self, "conv%d" % i)(y)
+        return y + route
+
+
+class _ResNetBase(HybridBlock):
+    _unit = None       # set by subclass
+    _preact_stem = False
+
+    def __init__(self, depth_spec, classes=1000, thumbnail=False, **kwargs):
+        super().__init__(**kwargs)
+        repeats, widths, bottleneck = depth_spec
+        with self.name_scope():
+            feats = nn.HybridSequential(prefix="")
+            if self._preact_stem:
+                feats.add(nn.BatchNorm(scale=False, center=False))
+            if thumbnail:
+                feats.add(_cba(widths[0], 3, 1, 1, act=None, bn=False,
+                               bias=False))
+            else:
+                feats.add(_cba(widths[0], 7, 2, 3, bias=False,
+                               act=None if self._preact_stem else "relu",
+                               bn=not self._preact_stem))
+                if self._preact_stem:
+                    # v2 stem still normalizes before pooling
+                    feats.add(nn.BatchNorm())
+                    feats.add(nn.Activation("relu"))
+                feats.add(nn.MaxPool2D(3, 2, 1))
+            carry = widths[0]
+            for stage, (n, width) in enumerate(zip(repeats, widths[1:]), 1):
+                block = nn.HybridSequential(prefix="stage%d_" % stage)
+                with block.name_scope():
+                    block.add(self._unit(width, 1 if stage == 1 else 2,
+                                         bottleneck, rewire=width != carry,
+                                         in_width=carry, prefix=""))
+                    for _ in range(n - 1):
+                        block.add(self._unit(width, 1, bottleneck,
+                                             rewire=False, in_width=width,
+                                             prefix=""))
+                feats.add(block)
+                carry = width
+            if self._preact_stem:
+                feats.add(nn.BatchNorm())
+                feats.add(nn.Activation("relu"))
+            feats.add(nn.GlobalAvgPool2D())
+            if self._preact_stem:
+                feats.add(nn.Flatten())
+            self.features = feats
+            self.output = nn.Dense(classes, in_units=carry)
+
+    def hybrid_forward(self, F, x):
+        return self.output(self.features(x))
+
+
+def _is_bottleneck(block, channels):
+    """Honor a legacy block argument when its name tells us the unit
+    kind; otherwise infer from the stage-width table."""
+    name = getattr(block, "__name__", "").lower()
+    if "bottle" in name:
+        return True
+    if "basic" in name:
+        return False
+    return channels[1] != channels[0]
+
+
+class ResNetV1(_ResNetBase):
+    _unit = _UnitV1
+
+    def __init__(self, block, layers, channels, classes=1000,
+                 thumbnail=False, **kwargs):
+        # legacy (block, layers, channels) signature kept for parity
+        super().__init__((layers, channels, _is_bottleneck(block, channels)),
+                         classes=classes, thumbnail=thumbnail, **kwargs)
+
+
+class ResNetV2(_ResNetBase):
+    _unit = _UnitV2
+    _preact_stem = True
+
+    def __init__(self, block, layers, channels, classes=1000,
+                 thumbnail=False, **kwargs):
+        super().__init__((layers, channels, _is_bottleneck(block, channels)),
+                         classes=classes, thumbnail=thumbnail, **kwargs)
 
 
 def get_resnet(version, num_layers, pretrained=False, ctx=None, **kwargs):
-    assert num_layers in resnet_spec, \
-        "Invalid number of layers: %d. Options are %s" % (
-            num_layers, str(resnet_spec.keys()))
-    block_type, layers, channels = resnet_spec[num_layers]
-    assert version >= 1 and version <= 2
-    resnet_class = resnet_net_versions[version - 1]
-    block_class = resnet_block_versions[version - 1][block_type]
-    net = resnet_class(block_class, layers, channels, **kwargs)
-    if pretrained:
-        raise RuntimeError("pretrained weights are not available offline")
-    return net
+    if num_layers not in _RESNET_DEPTHS:
+        raise ValueError("no resnet-%s; depths: %s"
+                         % (num_layers, sorted(_RESNET_DEPTHS)))
+    if version not in (1, 2):
+        raise ValueError("resnet version must be 1 or 2")
+    _no_pretrained(pretrained)
+    repeats, widths, _ = _RESNET_DEPTHS[num_layers]
+    cls = ResNetV1 if version == 1 else ResNetV2
+    return cls(None, repeats, widths, **kwargs)
 
 
-def resnet18_v1(**kwargs):
-    return get_resnet(1, 18, **kwargs)
+def _resnet_factory(version, depth):
+    def build(**kwargs):
+        return get_resnet(version, depth, **kwargs)
+    build.__name__ = "resnet%d_v%d" % (depth, version)
+    return build
 
 
-def resnet34_v1(**kwargs):
-    return get_resnet(1, 34, **kwargs)
+resnet18_v1 = _resnet_factory(1, 18)
+resnet34_v1 = _resnet_factory(1, 34)
+resnet50_v1 = _resnet_factory(1, 50)
+resnet101_v1 = _resnet_factory(1, 101)
+resnet152_v1 = _resnet_factory(1, 152)
+resnet18_v2 = _resnet_factory(2, 18)
+resnet34_v2 = _resnet_factory(2, 34)
+resnet50_v2 = _resnet_factory(2, 50)
+resnet101_v2 = _resnet_factory(2, 101)
+resnet152_v2 = _resnet_factory(2, 152)
 
 
-def resnet50_v1(**kwargs):
-    return get_resnet(1, 50, **kwargs)
+# -- VGG --------------------------------------------------------------------
+# Stage widths are fixed; depth only changes per-stage conv counts.
 
+_VGG_WIDTHS = [64, 128, 256, 512, 512]
+_VGG_COUNTS = {11: [1, 1, 2, 2, 2], 13: [2, 2, 2, 2, 2],
+               16: [2, 2, 3, 3, 3], 19: [2, 2, 4, 4, 4]}
 
-def resnet101_v1(**kwargs):
-    return get_resnet(1, 101, **kwargs)
-
-
-def resnet152_v1(**kwargs):
-    return get_resnet(1, 152, **kwargs)
-
-
-def resnet18_v2(**kwargs):
-    return get_resnet(2, 18, **kwargs)
-
-
-def resnet34_v2(**kwargs):
-    return get_resnet(2, 34, **kwargs)
-
-
-def resnet50_v2(**kwargs):
-    return get_resnet(2, 50, **kwargs)
-
-
-def resnet101_v2(**kwargs):
-    return get_resnet(2, 101, **kwargs)
-
-
-def resnet152_v2(**kwargs):
-    return get_resnet(2, 152, **kwargs)
-
-
-# ---------------------------------------------------------------------------
-# VGG
-# ---------------------------------------------------------------------------
 
 class VGG(HybridBlock):
     def __init__(self, layers, filters, classes=1000, batch_norm=False,
@@ -300,249 +250,154 @@ class VGG(HybridBlock):
         super().__init__(**kwargs)
         assert len(layers) == len(filters)
         with self.name_scope():
-            self.features = self._make_features(layers, filters, batch_norm)
-            self.features.add(nn.Dense(4096, activation="relu",
-                                       weight_initializer="normal"))
-            self.features.add(nn.Dropout(rate=0.5))
-            self.features.add(nn.Dense(4096, activation="relu",
-                                       weight_initializer="normal"))
-            self.features.add(nn.Dropout(rate=0.5))
+            feats = nn.HybridSequential(prefix="")
+            for count, width in zip(layers, filters):
+                for _ in range(count):
+                    feats.add(_cba(width, 3, 1, 1, bn=batch_norm, bias=True))
+                feats.add(nn.MaxPool2D(strides=2))
+            for _ in range(2):
+                feats.add(nn.Dense(4096, activation="relu",
+                                   weight_initializer="normal"))
+                feats.add(nn.Dropout(rate=0.5))
+            self.features = feats
             self.output = nn.Dense(classes, weight_initializer="normal")
 
-    def _make_features(self, layers, filters, batch_norm):
-        featurizer = nn.HybridSequential(prefix="")
-        for i, num in enumerate(layers):
-            for _ in range(num):
-                featurizer.add(nn.Conv2D(filters[i], kernel_size=3,
-                                         padding=1))
-                if batch_norm:
-                    featurizer.add(nn.BatchNorm())
-                featurizer.add(nn.Activation("relu"))
-            featurizer.add(nn.MaxPool2D(strides=2))
-        return featurizer
-
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
-
-
-vgg_spec = {11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
-            13: ([2, 2, 2, 2, 2], [64, 128, 256, 512, 512]),
-            16: ([2, 2, 3, 3, 3], [64, 128, 256, 512, 512]),
-            19: ([2, 2, 4, 4, 4], [64, 128, 256, 512, 512])}
+        return self.output(self.features(x))
 
 
 def get_vgg(num_layers, pretrained=False, **kwargs):
-    layers, filters = vgg_spec[num_layers]
-    net = VGG(layers, filters, **kwargs)
-    if pretrained:
-        raise RuntimeError("pretrained weights are not available offline")
-    return net
+    _no_pretrained(pretrained)
+    return VGG(_VGG_COUNTS[num_layers], _VGG_WIDTHS, **kwargs)
 
 
-def vgg11(**kwargs):
-    return get_vgg(11, **kwargs)
+def _vgg_factory(depth, bn):
+    def build(**kwargs):
+        if bn:
+            kwargs["batch_norm"] = True
+        return get_vgg(depth, **kwargs)
+    build.__name__ = "vgg%d%s" % (depth, "_bn" if bn else "")
+    return build
 
 
-def vgg13(**kwargs):
-    return get_vgg(13, **kwargs)
+vgg11, vgg13, vgg16, vgg19 = (_vgg_factory(d, False)
+                              for d in (11, 13, 16, 19))
+vgg11_bn, vgg13_bn, vgg16_bn, vgg19_bn = (_vgg_factory(d, True)
+                                          for d in (11, 13, 16, 19))
 
 
-def vgg16(**kwargs):
-    return get_vgg(16, **kwargs)
+# -- AlexNet ----------------------------------------------------------------
 
+_ALEX_CONVS = [(64, 11, 4, 2, True), (192, 5, 1, 2, True),
+               (384, 3, 1, 1, False), (256, 3, 1, 1, False),
+               (256, 3, 1, 1, True)]
 
-def vgg19(**kwargs):
-    return get_vgg(19, **kwargs)
-
-
-def vgg11_bn(**kwargs):
-    kwargs["batch_norm"] = True
-    return get_vgg(11, **kwargs)
-
-
-def vgg13_bn(**kwargs):
-    kwargs["batch_norm"] = True
-    return get_vgg(13, **kwargs)
-
-
-def vgg16_bn(**kwargs):
-    kwargs["batch_norm"] = True
-    return get_vgg(16, **kwargs)
-
-
-def vgg19_bn(**kwargs):
-    kwargs["batch_norm"] = True
-    return get_vgg(19, **kwargs)
-
-
-# ---------------------------------------------------------------------------
-# AlexNet
-# ---------------------------------------------------------------------------
 
 class AlexNet(HybridBlock):
     def __init__(self, classes=1000, **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
-            self.features = nn.HybridSequential(prefix="")
-            with self.features.name_scope():
-                self.features.add(nn.Conv2D(64, kernel_size=11, strides=4,
-                                            padding=2, activation="relu"))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-                self.features.add(nn.Conv2D(192, kernel_size=5, padding=2,
-                                            activation="relu"))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-                self.features.add(nn.Conv2D(384, kernel_size=3, padding=1,
-                                            activation="relu"))
-                self.features.add(nn.Conv2D(256, kernel_size=3, padding=1,
-                                            activation="relu"))
-                self.features.add(nn.Conv2D(256, kernel_size=3, padding=1,
-                                            activation="relu"))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-                self.features.add(nn.Flatten())
-                self.features.add(nn.Dense(4096, activation="relu"))
-                self.features.add(nn.Dropout(0.5))
-                self.features.add(nn.Dense(4096, activation="relu"))
-                self.features.add(nn.Dropout(0.5))
+            feats = nn.HybridSequential(prefix="")
+            with feats.name_scope():
+                for width, k, s, p, pool in _ALEX_CONVS:
+                    feats.add(_cba(width, k, s, p, bn=False, bias=True))
+                    if pool:
+                        feats.add(nn.MaxPool2D(pool_size=3, strides=2))
+                feats.add(nn.Flatten())
+                for _ in range(2):
+                    feats.add(nn.Dense(4096, activation="relu"))
+                    feats.add(nn.Dropout(0.5))
+            self.features = feats
             self.output = nn.Dense(classes)
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
 def alexnet(pretrained=False, **kwargs):
-    net = AlexNet(**kwargs)
-    if pretrained:
-        raise RuntimeError("pretrained weights are not available offline")
-    return net
+    _no_pretrained(pretrained)
+    return AlexNet(**kwargs)
 
 
-# ---------------------------------------------------------------------------
-# SqueezeNet
-# ---------------------------------------------------------------------------
+# -- SqueezeNet -------------------------------------------------------------
+# Layout tables: "P" = 3x2 ceil maxpool, tuples are fire modules
+# (squeeze, expand1x1, expand3x3).
 
-def _make_fire(squeeze_channels, expand1x1_channels, expand3x3_channels):
-    out = nn.HybridSequential(prefix="")
-    out.add(_make_fire_conv(squeeze_channels, 1))
-    paths = _FireExpand(expand1x1_channels, expand3x3_channels)
-    out.add(paths)
-    return out
-
-
-def _make_fire_conv(channels, kernel_size, padding=0):
-    out = nn.HybridSequential(prefix="")
-    out.add(nn.Conv2D(channels, kernel_size, padding=padding))
-    out.add(nn.Activation("relu"))
-    return out
+_SQUEEZE_LAYOUTS = {
+    "1.0": [(96, 7, 2), "P", (16, 64, 64), (16, 64, 64), (32, 128, 128),
+            "P", (32, 128, 128), (48, 192, 192), (48, 192, 192),
+            (64, 256, 256), "P", (64, 256, 256)],
+    "1.1": [(64, 3, 2), "P", (16, 64, 64), (16, 64, 64), "P",
+            (32, 128, 128), (32, 128, 128), "P", (48, 192, 192),
+            (48, 192, 192), (64, 256, 256), (64, 256, 256)],
+}
 
 
-class _FireExpand(HybridBlock):
-    def __init__(self, e1, e3, **kwargs):
-        super().__init__(**kwargs)
-        self.p1 = _make_fire_conv(e1, 1)
-        self.p3 = _make_fire_conv(e3, 3, 1)
-
-    def hybrid_forward(self, F, x):
-        return F.Concat(self.p1(x), self.p3(x), dim=1)
+def _fire(squeeze, e1, e3):
+    expand = HybridConcurrent(axis=1)
+    expand.add(_cba(e1, 1, bn=False, bias=True))
+    expand.add(_cba(e3, 3, pad=1, bn=False, bias=True))
+    return _stack(_cba(squeeze, 1, bn=False, bias=True), expand)
 
 
 class SqueezeNet(HybridBlock):
     def __init__(self, version, classes=1000, **kwargs):
         super().__init__(**kwargs)
-        assert version in ["1.0", "1.1"]
+        if version not in _SQUEEZE_LAYOUTS:
+            raise ValueError("squeezenet version must be '1.0' or '1.1'")
         with self.name_scope():
-            self.features = nn.HybridSequential(prefix="")
-            if version == "1.0":
-                self.features.add(nn.Conv2D(96, kernel_size=7, strides=2))
-                self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(64, 256, 256))
-                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
-                self.features.add(_make_fire(64, 256, 256))
-            else:
-                self.features.add(nn.Conv2D(64, kernel_size=3, strides=2))
-                self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(64, 256, 256))
-                self.features.add(_make_fire(64, 256, 256))
-            self.features.add(nn.Dropout(0.5))
-            self.output = nn.HybridSequential(prefix="")
-            self.output.add(nn.Conv2D(classes, kernel_size=1))
-            self.output.add(nn.Activation("relu"))
-            self.output.add(nn.GlobalAvgPool2D())
-            self.output.add(nn.Flatten())
+            feats = nn.HybridSequential(prefix="")
+            for i, part in enumerate(_SQUEEZE_LAYOUTS[version]):
+                if part == "P":
+                    feats.add(nn.MaxPool2D(3, 2, ceil_mode=True))
+                elif i == 0:     # the stem conv: (channels, kernel, stride)
+                    feats.add(_cba(part[0], part[1], part[2],
+                                   bn=False, bias=True))
+                else:
+                    feats.add(_fire(*part))
+            feats.add(nn.Dropout(0.5))
+            self.features = feats
+            self.output = _stack(
+                _cba(classes, 1, bn=False, bias=True),
+                nn.GlobalAvgPool2D(), nn.Flatten())
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
-def squeezenet1_0(**kwargs):
+def squeezenet1_0(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
     return SqueezeNet("1.0", **kwargs)
 
 
-def squeezenet1_1(**kwargs):
+def squeezenet1_1(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
     return SqueezeNet("1.1", **kwargs)
 
 
-# ---------------------------------------------------------------------------
-# DenseNet
-# ---------------------------------------------------------------------------
+# -- DenseNet ---------------------------------------------------------------
 
-class _DenseLayer(HybridBlock):
-    def __init__(self, growth_rate, bn_size, dropout, **kwargs):
+_DENSE_CONFIGS = {121: (64, 32, [6, 12, 24, 16]),
+                  161: (96, 48, [6, 12, 36, 24]),
+                  169: (64, 32, [6, 12, 32, 32]),
+                  201: (64, 32, [6, 12, 48, 32])}
+
+
+class _DenseUnit(HybridBlock):
+    """BN-relu-1x1 then BN-relu-3x3, concatenated onto the input."""
+
+    def __init__(self, growth, bn_size, dropout, **kwargs):
         super().__init__(**kwargs)
-        self.body = nn.HybridSequential(prefix="")
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(bn_size * growth_rate, kernel_size=1,
-                                use_bias=False))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(growth_rate, kernel_size=3, padding=1,
-                                use_bias=False))
-        if dropout:
-            self.body.add(nn.Dropout(dropout))
+        tail = [nn.Dropout(dropout)] if dropout else []
+        self.body = _stack(
+            nn.BatchNorm(), nn.Activation("relu"),
+            nn.Conv2D(bn_size * growth, kernel_size=1, use_bias=False),
+            nn.BatchNorm(), nn.Activation("relu"),
+            nn.Conv2D(growth, kernel_size=3, padding=1, use_bias=False),
+            *tail)
 
     def hybrid_forward(self, F, x):
-        out = self.body(x)
-        return F.Concat(x, out, dim=1)
-
-
-def _make_dense_block(num_layers, bn_size, growth_rate, dropout, stage_index):
-    out = nn.HybridSequential(prefix="stage%d_" % stage_index)
-    with out.name_scope():
-        for _ in range(num_layers):
-            out.add(_DenseLayer(growth_rate, bn_size, dropout))
-    return out
-
-
-def _make_transition(num_output_features):
-    out = nn.HybridSequential(prefix="")
-    out.add(nn.BatchNorm())
-    out.add(nn.Activation("relu"))
-    out.add(nn.Conv2D(num_output_features, kernel_size=1, use_bias=False))
-    out.add(nn.AvgPool2D(pool_size=2, strides=2))
-    return out
+        return F.Concat(x, self.body(x), dim=1)
 
 
 class DenseNet(HybridBlock):
@@ -550,138 +405,109 @@ class DenseNet(HybridBlock):
                  bn_size=4, dropout=0, classes=1000, **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
-            self.features = nn.HybridSequential(prefix="")
-            self.features.add(nn.Conv2D(num_init_features, kernel_size=7,
-                                        strides=2, padding=3, use_bias=False))
-            self.features.add(nn.BatchNorm())
-            self.features.add(nn.Activation("relu"))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2, padding=1))
-            num_features = num_init_features
-            for i, num_layers in enumerate(block_config):
-                self.features.add(_make_dense_block(
-                    num_layers, bn_size, growth_rate, dropout, i + 1))
-                num_features = num_features + num_layers * growth_rate
-                if i != len(block_config) - 1:
-                    num_features = num_features // 2
-                    self.features.add(_make_transition(num_features))
-            self.features.add(nn.BatchNorm())
-            self.features.add(nn.Activation("relu"))
-            self.features.add(nn.GlobalAvgPool2D())
-            self.features.add(nn.Flatten())
+            feats = _stack(
+                nn.Conv2D(num_init_features, kernel_size=7, strides=2,
+                          padding=3, use_bias=False),
+                nn.BatchNorm(), nn.Activation("relu"),
+                nn.MaxPool2D(pool_size=3, strides=2, padding=1))
+            width = num_init_features
+            for stage, n in enumerate(block_config, 1):
+                block = nn.HybridSequential(prefix="stage%d_" % stage)
+                with block.name_scope():
+                    for _ in range(n):
+                        block.add(_DenseUnit(growth_rate, bn_size, dropout))
+                feats.add(block)
+                width += n * growth_rate
+                if stage < len(block_config):
+                    width //= 2     # transition halves channels + spatial
+                    feats.add(_stack(
+                        nn.BatchNorm(), nn.Activation("relu"),
+                        nn.Conv2D(width, kernel_size=1, use_bias=False),
+                        nn.AvgPool2D(pool_size=2, strides=2)))
+            feats.add(nn.BatchNorm())
+            feats.add(nn.Activation("relu"))
+            feats.add(nn.GlobalAvgPool2D())
+            feats.add(nn.Flatten())
+            self.features = feats
             self.output = nn.Dense(classes)
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
-densenet_spec = {121: (64, 32, [6, 12, 24, 16]),
-                 161: (96, 48, [6, 12, 36, 24]),
-                 169: (64, 32, [6, 12, 32, 32]),
-                 201: (64, 32, [6, 12, 48, 32])}
+def _densenet_factory(depth):
+    def build(pretrained=False, **kwargs):
+        _no_pretrained(pretrained)
+        return DenseNet(*_DENSE_CONFIGS[depth], **kwargs)
+    build.__name__ = "densenet%d" % depth
+    return build
 
 
-def get_densenet(num_layers, **kwargs):
-    num_init_features, growth_rate, block_config = densenet_spec[num_layers]
-    return DenseNet(num_init_features, growth_rate, block_config, **kwargs)
+densenet121 = _densenet_factory(121)
+densenet161 = _densenet_factory(161)
+densenet169 = _densenet_factory(169)
+densenet201 = _densenet_factory(201)
 
 
-def densenet121(**kwargs):
-    return get_densenet(121, **kwargs)
+# -- MobileNet (v1) ---------------------------------------------------------
+# Each row: (separable-out-channels, stride); depthwise width = previous
+# row's output.
 
+_MOBILENET_ROWS = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1),
+                   (512, 2), (512, 1), (512, 1), (512, 1), (512, 1),
+                   (512, 1), (1024, 2), (1024, 1)]
 
-def densenet161(**kwargs):
-    return get_densenet(161, **kwargs)
-
-
-def densenet169(**kwargs):
-    return get_densenet(169, **kwargs)
-
-
-def densenet201(**kwargs):
-    return get_densenet(201, **kwargs)
-
-
-# ---------------------------------------------------------------------------
-# MobileNet
-# ---------------------------------------------------------------------------
 
 class MobileNet(HybridBlock):
     def __init__(self, multiplier=1.0, classes=1000, **kwargs):
         super().__init__(**kwargs)
+        scale = lambda c: int(c * multiplier)   # noqa: E731
         with self.name_scope():
-            self.features = nn.HybridSequential(prefix="")
-            with self.features.name_scope():
-                self._add_conv(int(32 * multiplier), kernel=3, stride=2,
-                               pad=1)
-                dw_channels = [int(x * multiplier) for x in
-                               [32, 64] + [128] * 2 + [256] * 2 +
-                               [512] * 6 + [1024]]
-                channels = [int(x * multiplier) for x in
-                            [64] + [128] * 2 + [256] * 2 + [512] * 6 +
-                            [1024] * 2]
-                strides = [1, 2, 1, 2, 1, 2, 1, 1, 1, 1, 1, 2, 1]
-                for dwc, c, s in zip(dw_channels, channels, strides):
-                    self._add_conv_dw(dw_channels=dwc, channels=c, stride=s)
-                self.features.add(nn.GlobalAvgPool2D())
-                self.features.add(nn.Flatten())
+            feats = nn.HybridSequential(prefix="")
+            with feats.name_scope():
+                feats.add(_cba(scale(32), 3, 2, 1))
+                carry = 32
+                for out, stride in _MOBILENET_ROWS:
+                    # depthwise 3x3 at the incoming width...
+                    feats.add(_cba(scale(carry), 3, stride, 1,
+                                   groups=scale(carry)))
+                    # ...then pointwise up to the row width
+                    feats.add(_cba(scale(out)))
+                    carry = out
+                feats.add(nn.GlobalAvgPool2D())
+                feats.add(nn.Flatten())
+            self.features = feats
             self.output = nn.Dense(classes)
 
-    def _add_conv(self, channels, kernel=1, stride=1, pad=0, num_group=1):
-        self.features.add(nn.Conv2D(channels, kernel, stride, pad,
-                                    groups=num_group, use_bias=False))
-        self.features.add(nn.BatchNorm())
-        self.features.add(nn.Activation("relu"))
-
-    def _add_conv_dw(self, dw_channels, channels, stride):
-        self._add_conv(dw_channels, kernel=3, stride=stride, pad=1,
-                       num_group=dw_channels)
-        self._add_conv(channels)
-
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
-def get_mobilenet(multiplier, **kwargs):
+def get_mobilenet(multiplier, pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
     return MobileNet(multiplier, **kwargs)
 
 
-def mobilenet1_0(**kwargs):
-    return get_mobilenet(1.0, **kwargs)
+def _mobilenet_factory(multiplier, tag):
+    def build(**kwargs):
+        return get_mobilenet(multiplier, **kwargs)
+    build.__name__ = "mobilenet" + tag
+    return build
 
 
-def mobilenet0_75(**kwargs):
-    return get_mobilenet(0.75, **kwargs)
+mobilenet1_0 = _mobilenet_factory(1.0, "1_0")
+mobilenet0_75 = _mobilenet_factory(0.75, "0_75")
+mobilenet0_5 = _mobilenet_factory(0.5, "0_5")
+mobilenet0_25 = _mobilenet_factory(0.25, "0_25")
 
 
-def mobilenet0_5(**kwargs):
-    return get_mobilenet(0.5, **kwargs)
-
-
-def mobilenet0_25(**kwargs):
-    return get_mobilenet(0.25, **kwargs)
-
-
-# ---------------------------------------------------------------------------
-# Inception v3 (reference gluon/model_zoo/vision/inception.py).  Built from
-# a declarative branch table instead of nested builder calls: each mixing
-# block is a list of branches; a branch is an optional pool marker followed
-# by (channels, kernel, stride, pad) conv steps.
-# ---------------------------------------------------------------------------
-
-from ..contrib.nn import HybridConcurrent  # noqa: E402  (canonical home)
-
+# -- Inception v3 -----------------------------------------------------------
+# Built from a declarative branch table: each mixing block is a list of
+# branches; a branch is an optional pool marker followed by
+# (channels, kernel, stride, pad) conv steps.
 
 def _bn_conv(channels, kernel, stride=1, pad=0):
-    seq = nn.HybridSequential(prefix="")
-    seq.add(nn.Conv2D(channels, kernel_size=kernel, strides=stride,
-                      padding=pad, use_bias=False))
-    seq.add(nn.BatchNorm(epsilon=0.001))
-    seq.add(nn.Activation("relu"))
-    return seq
+    return _cba(channels, kernel, stride, pad, bn_eps=0.001)
 
 
 def _inc_branch(steps):
@@ -699,9 +525,8 @@ def _inc_branch(steps):
 def _inc_mix(branches, axis=1):
     cat = HybridConcurrent(axis=axis)
     for steps in branches:
-        b = _inc_branch(steps) if not isinstance(steps, HybridBlock) \
-            else steps
-        cat.add(b)
+        cat.add(steps if isinstance(steps, HybridBlock)
+                else _inc_branch(steps))
     return cat
 
 
@@ -750,13 +575,9 @@ def _split_conv(channels):
 
 
 def _mix_e():
-    b3 = nn.HybridSequential(prefix="")
-    b3.add(_bn_conv(384, 1))
-    b3.add(_split_conv(384))
-    b3d = nn.HybridSequential(prefix="")
-    b3d.add(_bn_conv(448, 1))
-    b3d.add(_bn_conv(384, 3, 1, 1))
-    b3d.add(_split_conv(384))
+    b3 = _stack(_bn_conv(384, 1), _split_conv(384))
+    b3d = _stack(_bn_conv(448, 1), _bn_conv(384, 3, 1, 1),
+                 _split_conv(384))
     return _inc_mix([
         [(320, 1)],
         b3,
@@ -784,9 +605,7 @@ class Inception3(HybridBlock):
             _mix_d(),
             _mix_e(), _mix_e(),
         ]
-        self.features = nn.HybridSequential(prefix="")
-        for blk in stem + mixes:
-            self.features.add(blk)
+        self.features = _stack(*(stem + mixes))
         self.features.add(nn.AvgPool2D(pool_size=8))
         self.features.add(nn.Dropout(0.5))
         self.output = nn.Dense(classes)
@@ -796,34 +615,32 @@ class Inception3(HybridBlock):
 
 
 def inception_v3(pretrained=False, ctx=None, **kwargs):
-    if pretrained:
-        raise RuntimeError("pretrained weights are unavailable in this "
-                           "environment (no network); initialize instead")
+    _no_pretrained(pretrained)
     return Inception3(**kwargs)
 
 
-_models = {
-    "resnet18_v1": resnet18_v1, "resnet34_v1": resnet34_v1,
-    "resnet50_v1": resnet50_v1, "resnet101_v1": resnet101_v1,
-    "resnet152_v1": resnet152_v1, "resnet18_v2": resnet18_v2,
-    "resnet34_v2": resnet34_v2, "resnet50_v2": resnet50_v2,
-    "resnet101_v2": resnet101_v2, "resnet152_v2": resnet152_v2,
-    "vgg11": vgg11, "vgg13": vgg13, "vgg16": vgg16, "vgg19": vgg19,
-    "vgg11_bn": vgg11_bn, "vgg13_bn": vgg13_bn, "vgg16_bn": vgg16_bn,
-    "vgg19_bn": vgg19_bn, "alexnet": alexnet,
-    "squeezenet1.0": squeezenet1_0, "squeezenet1.1": squeezenet1_1,
-    "densenet121": densenet121, "densenet161": densenet161,
-    "densenet169": densenet169, "densenet201": densenet201,
-    "mobilenet1.0": mobilenet1_0, "mobilenet0.75": mobilenet0_75,
-    "mobilenet0.5": mobilenet0_5, "mobilenet0.25": mobilenet0_25,
-    "inceptionv3": inception_v3,
-}
+# -- registry ---------------------------------------------------------------
+
+_models = {}
+for _fn in (resnet18_v1, resnet34_v1, resnet50_v1, resnet101_v1,
+            resnet152_v1, resnet18_v2, resnet34_v2, resnet50_v2,
+            resnet101_v2, resnet152_v2, vgg11, vgg13, vgg16, vgg19,
+            vgg11_bn, vgg13_bn, vgg16_bn, vgg19_bn, alexnet,
+            densenet121, densenet161, densenet169, densenet201,
+            inception_v3):
+    _models[_fn.__name__] = _models[_fn.__name__.replace("_v3", "v3")] = _fn
+for _tag, _fn in (("1.0", squeezenet1_0), ("1.1", squeezenet1_1)):
+    _models["squeezenet" + _tag] = _fn
+for _tag, _fn in (("1.0", mobilenet1_0), ("0.75", mobilenet0_75),
+                  ("0.5", mobilenet0_5), ("0.25", mobilenet0_25)):
+    _models["mobilenet" + _tag] = _fn
 
 
 def get_model(name, **kwargs):
-    """reference model_zoo/__init__.py get_model."""
-    name = name.lower()
-    if name not in _models:
-        raise ValueError("Model %s is not supported. Available options are\n"
-                         "\t%s" % (name, "\n\t".join(sorted(_models))))
-    return _models[name](**kwargs)
+    """Look a model builder up by zoo name (reference
+    model_zoo/__init__.py get_model)."""
+    key = name.lower()
+    if key not in _models:
+        raise ValueError("Model %s is not supported. Available options "
+                         "are\n\t%s" % (name, "\n\t".join(sorted(_models))))
+    return _models[key](**kwargs)

@@ -1,76 +1,87 @@
-"""Gluon Trainer (reference python/mxnet/gluon/trainer.py:27)."""
+"""Gluon Trainer: drives an Optimizer over a Block's Parameters.
+
+Reference analog: python/mxnet/gluon/trainer.py:27.  The sync machinery
+is much simpler here than in the reference because there is no multi-GPU
+copy fan-out on a TPU host: each Parameter holds ONE array (globally
+sharded when a mesh is active), so "allreduce" degenerates to a kvstore
+push/pull hop that is only taken when a kvstore is actually configured —
+under a sharded mesh the gradient psum already happened inside the XLA
+step (see parallel/trainer.py), and distributed multi-host sync rides
+the kvstore's collective path.
+"""
 from __future__ import annotations
 
-from .. import kvstore as kvs
 from .. import optimizer as opt
 from ..model import _create_kvstore
 from .parameter import Parameter
 
 
-class Trainer:
-    """Applies an Optimizer to a set of Parameters (reference trainer.py).
+def _as_param_list(params):
+    """Accept a ParameterDict / dict / list / tuple of Parameters."""
+    if hasattr(params, "values"):
+        params = list(params.values())
+    if not isinstance(params, (list, tuple)):
+        raise ValueError(
+            "Trainer needs a list or dict of Parameters to manage; "
+            "got a %s" % type(params))
+    for p in params:
+        if not isinstance(p, Parameter):
+            raise ValueError(
+                "Trainer needs Parameters to manage; the collection "
+                "contains a %s" % type(p))
+    return list(params)
 
-    step() = reduce grads (kvstore / mesh psum when distributed) + fused
-    optimizer update per parameter.
+
+class Trainer:
+    """Applies `optimizer` to `params` each `step(batch_size)`.
+
+    The kvstore binding is lazy: nothing is created until the first
+    step/update call, so Trainers are cheap to construct and the
+    distributed environment only needs to exist once training starts.
     """
 
     def __init__(self, params, optimizer, optimizer_params=None,
                  kvstore="device", compression_params=None,
                  update_on_kvstore=None):
-        if isinstance(params, (dict,)) or hasattr(params, "values"):
-            params = list(params.values())
-        if not isinstance(params, (list, tuple)):
-            raise ValueError(
-                "First argument must be a list or dict of Parameters, got %s."
-                % type(params))
-        self._params = []
-        for param in params:
-            if not isinstance(param, Parameter):
-                raise ValueError(
-                    "First argument must be a list or dict of Parameters, "
-                    "got list of %s." % type(param))
-            self._params.append(param)
+        self._params = _as_param_list(params)
         self._compression_params = compression_params
-        optimizer_params = optimizer_params if optimizer_params else {}
-        self._scale = float(optimizer_params.get("rescale_grad", 1.0))
-        self._init_optimizer(optimizer, optimizer_params)
-        self._kv_type = kvstore
-        self._kvstore = None
-        self._update_on_kvstore = update_on_kvstore
-        self._kv_initialized = False
-
-    def _init_optimizer(self, optimizer, optimizer_params):
-        param_dict = {i: param for i, param in enumerate(self._params)}
+        kwargs = dict(optimizer_params or {})
+        self._scale = float(kwargs.get("rescale_grad", 1.0))
         if isinstance(optimizer, opt.Optimizer):
-            assert not optimizer_params, \
-                "optimizer_params must be None if optimizer is an Optimizer " \
-                "instance"
+            if kwargs:
+                raise ValueError("pass optimizer_params only with a "
+                                 "string optimizer name, not an instance")
             self._optimizer = optimizer
-            self._optimizer.param_dict = param_dict
         else:
-            self._optimizer = opt.create(optimizer, param_dict=param_dict,
-                                         **optimizer_params)
+            self._optimizer = opt.create(optimizer, **kwargs)
+        self._optimizer.param_dict = dict(enumerate(self._params))
         self._updaters = opt.get_updater(self._optimizer)
+        self._kv_request = (kvstore, update_on_kvstore)
+        self._sync = None    # resolved lazily: (kvstore|None, on_kv: bool)
 
-    def _init_kvstore(self):
-        arg_arrays = {param.name: param.data() for param in self._params}
-        kvstore, update_on_kvstore = _create_kvstore(self._kv_type, 1,
-                                                     arg_arrays)
-        if self._update_on_kvstore is not None:
-            update_on_kvstore = self._update_on_kvstore
-        if kvstore:
+    # -- lazy kvstore resolution ------------------------------------------
+
+    def _resolve_sync(self):
+        want, on_kv_override = self._kv_request
+        store, on_kv = _create_kvstore(
+            want, 1, {p.name: p.data() for p in self._params})
+        if on_kv_override is not None:
+            on_kv = on_kv_override
+        if store is not None:
             if self._compression_params:
-                kvstore.set_gradient_compression(self._compression_params)
-            if update_on_kvstore:
-                kvstore.set_optimizer(self._optimizer)
-            for i, param in enumerate(self._params):
-                kvstore.init(i, param.data())
-            self._kvstore = kvstore
-            self._update_on_kvstore = update_on_kvstore
-        else:
-            self._kvstore = None
-            self._update_on_kvstore = False
-        self._kv_initialized = True
+                store.set_gradient_compression(self._compression_params)
+            if on_kv:
+                store.set_optimizer(self._optimizer)
+            for idx, p in enumerate(self._params):
+                store.init(idx, p.data())
+        self._sync = (store, bool(store) and on_kv)
+        return self._sync
+
+    @property
+    def _ready(self):
+        return self._sync if self._sync is not None else self._resolve_sync()
+
+    # -- public knobs ------------------------------------------------------
 
     @property
     def learning_rate(self):
@@ -79,65 +90,66 @@ class Trainer:
     def set_learning_rate(self, lr):
         self._optimizer.set_learning_rate(lr)
 
+    # -- the step ----------------------------------------------------------
+
     def step(self, batch_size, ignore_stale_grad=False):
-        """reference trainer.py:156 — push grads / pull weights or local
-        fused update."""
-        if not self._kv_initialized:
-            self._init_kvstore()
+        """Reduce gradients (kvstore hop, when one exists) then apply the
+        optimizer — reference trainer.py:156."""
+        store, on_kv = self._ready
         self._optimizer.rescale_grad = self._scale / batch_size
-        self._allreduce_grads()
-        self._update(ignore_stale_grad)
+        if not on_kv:
+            self._reduce(store)
+        self._apply(store, on_kv)
 
     def allreduce_grads(self):
-        if not self._kv_initialized:
-            self._init_kvstore()
-        self._allreduce_grads()
-
-    def _allreduce_grads(self):
-        if self._kvstore is None or self._update_on_kvstore:
-            return
-        for i, param in enumerate(self._params):
-            if param.grad_req != "null":
-                self._kvstore.push(i, param.list_grad(), priority=-i)
-                self._kvstore.pull(i, param.list_grad(), priority=-i)
+        store, on_kv = self._ready
+        if not on_kv:
+            self._reduce(store)
 
     def update(self, batch_size, ignore_stale_grad=False):
-        if not self._kv_initialized:
-            self._init_kvstore()
-        assert not (self._kvstore and self._update_on_kvstore), \
-            "update() when parameters are updated on kvstore " \
-            "is not supported. Try setting `update_on_kvstore` to False."
+        store, on_kv = self._ready
+        if on_kv:
+            raise RuntimeError(
+                "update() is only meaningful when the optimizer runs "
+                "locally; this Trainer updates on the kvstore — pass "
+                "update_on_kvstore=False to split reduce from update")
         self._optimizer.rescale_grad = self._scale / batch_size
-        self._update(ignore_stale_grad)
+        self._apply(store, on_kv)
 
-    def _update(self, ignore_stale_grad=False):
-        for i, param in enumerate(self._params):
-            if param.grad_req == "null":
+    def _reduce(self, store):
+        if store is None:
+            return
+        for idx, p in enumerate(self._params):
+            if p.grad_req != "null":
+                store.push(idx, p.list_grad(), priority=-idx)
+                store.pull(idx, p.list_grad(), priority=-idx)
+
+    def _apply(self, store, on_kv):
+        for idx, p in enumerate(self._params):
+            if p.grad_req == "null":
                 continue
-            if self._kvstore and self._update_on_kvstore:
-                self._kvstore.push(i, param.list_grad(), priority=-i)
-                self._kvstore.pull(i, param.list_data(), priority=-i)
-                continue
-            self._updaters(i, param.grad(), param.data())
+            if on_kv:
+                store.push(idx, p.list_grad(), priority=-idx)
+                store.pull(idx, p.list_data(), priority=-idx)
+            else:
+                self._updaters(idx, p.grad(), p.data())
+
+    # -- optimizer-state checkpointing ------------------------------------
 
     def save_states(self, fname):
-        assert self._optimizer is not None
-        if not self._kv_initialized:
-            self._init_kvstore()
-        if self._update_on_kvstore:
-            self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
+        store, on_kv = self._ready
+        if on_kv:
+            store.save_optimizer_states(fname, dump_optimizer=True)
         else:
-            with open(fname, "wb") as fout:
-                fout.write(self._updaters.get_states(dump_optimizer=True))
+            with open(fname, "wb") as f:
+                f.write(self._updaters.get_states(dump_optimizer=True))
 
     def load_states(self, fname):
-        if not self._kv_initialized:
-            self._init_kvstore()
-        if self._update_on_kvstore:
-            self._kvstore.load_optimizer_states(fname)
-            self._optimizer = self._kvstore._updater.optimizer
+        store, on_kv = self._ready
+        if on_kv:
+            store.load_optimizer_states(fname)
+            self._optimizer = store._updater.optimizer
         else:
             with open(fname, "rb") as f:
-                states = f.read()
-            self._updaters.set_states(states)
+                self._updaters.set_states(f.read())
             self._updaters.optimizer = self._optimizer

@@ -1,6 +1,23 @@
-"""Learning-rate schedulers (reference python/mxnet/lr_scheduler.py)."""
+"""Learning-rate schedules.
+
+Schedules here are PURE functions of the global update count: every class
+computes ``lr(t)`` directly from ``t`` instead of mutating an internal
+learning rate as calls arrive (the reference lr_scheduler.py design).
+That choice is deliberate for this stack:
+
+* a pure ``lr(t)`` can be evaluated inside a jitted update step or
+  re-evaluated after checkpoint-resume at any ``t`` without replaying
+  the whole call history;
+* ``base_lr`` stays what the user set — it is the schedule's *anchor*,
+  not a running value — so optimizer serialization round-trips.
+
+API parity with reference python/mxnet/lr_scheduler.py (class and kwarg
+names, decay boundary semantics); Cosine/Warmup are beyond-reference
+additions standard in TPU training recipes.
+"""
 from __future__ import annotations
 
+import bisect
 import logging
 import math
 
@@ -9,117 +26,123 @@ __all__ = ["LRScheduler", "FactorScheduler", "MultiFactorScheduler",
 
 
 class LRScheduler:
+    """Base: callable mapping update count -> learning rate."""
+
+    # discrete schedules announce decay events; continuous ones (poly,
+    # cosine, warmup ramps) change every update and stay quiet
+    _announce_changes = False
+
     def __init__(self, base_lr=0.01):
         self.base_lr = base_lr
+        self._announced = None   # last lr logged, to report changes once
 
-    def __call__(self, num_update: int) -> float:
+    def _rate(self, t):
         raise NotImplementedError()
+
+    def __call__(self, num_update):
+        lr = self._rate(int(num_update))
+        if self._announce_changes and self._announced is not None \
+                and lr != self._announced:
+            logging.info("Update[%d]: learning rate is now %0.5e",
+                         num_update, lr)
+        self._announced = lr
+        return lr
+
+
+def _check_decay_factor(factor):
+    if factor > 1.0:
+        raise ValueError("decay factor %g would grow the learning rate; "
+                         "it must be <= 1" % factor)
 
 
 class FactorScheduler(LRScheduler):
-    """lr *= factor every `step` updates (reference FactorScheduler)."""
+    """Geometric decay: ``lr(t) = base_lr * factor**floor((t-1)/step)``,
+    floored at `stop_factor_lr`.  Boundary matches the reference
+    FactorScheduler: the k-th decay lands at update ``k*step + 1``."""
+
+    _announce_changes = True
 
     def __init__(self, step, factor=1, stop_factor_lr=1e-8):
         super().__init__()
         if step < 1:
-            raise ValueError("Schedule step must be greater or equal than 1")
-        if factor > 1.0:
-            raise ValueError("Factor must be no more than 1 to make lr reduce")
+            raise ValueError("step must be a positive update count")
+        _check_decay_factor(factor)
         self.step = step
         self.factor = factor
         self.stop_factor_lr = stop_factor_lr
-        self.count = 0
 
-    def __call__(self, num_update):
-        while num_update > self.count + self.step:
-            self.count += self.step
-            self.base_lr *= self.factor
-            if self.base_lr < self.stop_factor_lr:
-                self.base_lr = self.stop_factor_lr
-                logging.info("Update[%d]: now learning rate arrived at %0.5e, "
-                             "will not change in the future", num_update,
-                             self.base_lr)
-            else:
-                logging.info("Update[%d]: Change learning rate to %0.5e",
-                             num_update, self.base_lr)
-        return self.base_lr
+    def _rate(self, t):
+        n_decays = max(0, t - 1) // self.step
+        return max(self.base_lr * self.factor ** n_decays,
+                   self.stop_factor_lr)
 
 
 class MultiFactorScheduler(LRScheduler):
-    """lr *= factor at each step in `step` list."""
+    """Decay by `factor` as `t` passes each boundary in the sorted list
+    `step` (reference MultiFactorScheduler boundaries: decay k applies
+    for ``t > step[k-1]``)."""
+
+    _announce_changes = True
 
     def __init__(self, step, factor=1):
         super().__init__()
-        assert isinstance(step, list) and len(step) >= 1
-        for i, _step in enumerate(step):
-            if i != 0 and step[i] <= step[i - 1]:
-                raise ValueError("Schedule step must be an increasing list")
-            if _step < 1:
-                raise ValueError("Schedule step must be greater or equal than 1")
-        if factor > 1.0:
-            raise ValueError("Factor must be no more than 1 to make lr reduce")
+        if not isinstance(step, list) or not step:
+            raise ValueError("step must be a non-empty list of boundaries")
+        if any(s < 1 for s in step):
+            raise ValueError("boundaries must be positive update counts")
+        if any(b <= a for a, b in zip(step, step[1:])):
+            raise ValueError("boundaries must be strictly increasing")
+        _check_decay_factor(factor)
         self.step = step
-        self.cur_step_ind = 0
         self.factor = factor
-        self.count = 0
 
-    def __call__(self, num_update):
-        while self.cur_step_ind <= len(self.step) - 1:
-            if num_update > self.step[self.cur_step_ind]:
-                self.count = self.step[self.cur_step_ind]
-                self.cur_step_ind += 1
-                self.base_lr *= self.factor
-                logging.info("Update[%d]: Change learning rate to %0.5e",
-                             num_update, self.base_lr)
-            else:
-                return self.base_lr
-        return self.base_lr
+    def _rate(self, t):
+        # number of boundaries strictly below t  ==  decays applied
+        n_decays = bisect.bisect_left(self.step, t)
+        return self.base_lr * self.factor ** n_decays
 
 
 class PolyScheduler(LRScheduler):
-    """Polynomial decay to zero at max_update (reference PolyScheduler)."""
+    """``lr(t) = base_lr * (1 - t/max_update)**pwr`` until `max_update`,
+    then 0 (reference PolyScheduler)."""
 
     def __init__(self, max_update, base_lr=0.01, pwr=2):
         super().__init__(base_lr)
-        assert isinstance(max_update, int)
-        if max_update < 1:
-            raise ValueError("maximum number of updates must be strictly positive")
-        self.base_lr_orig = self.base_lr
+        if not isinstance(max_update, int) or max_update < 1:
+            raise ValueError("max_update must be a positive int")
         self.max_update = max_update
         self.power = pwr
 
-    def __call__(self, num_update):
-        if num_update <= self.max_update:
-            self.base_lr = self.base_lr_orig * pow(
-                1.0 - float(num_update) / float(self.max_update), self.power)
-        return self.base_lr
+    def _rate(self, t):
+        frac = min(t, self.max_update) / float(self.max_update)
+        return self.base_lr * (1.0 - frac) ** self.power
 
 
 class CosineScheduler(LRScheduler):
-    """Cosine decay (beyond-reference convenience; standard on TPU recipes)."""
+    """Half-cosine from `base_lr` down to `final_lr` over `max_update`
+    steps (beyond-reference; the standard TPU recipe)."""
 
     def __init__(self, max_update, base_lr=0.01, final_lr=0.0):
         super().__init__(base_lr)
         self.max_update = max_update
         self.final_lr = final_lr
-        self.base_lr_orig = base_lr
 
-    def __call__(self, num_update):
-        if num_update <= self.max_update:
-            self.base_lr = self.final_lr + (self.base_lr_orig - self.final_lr) * \
-                (1 + math.cos(math.pi * num_update / self.max_update)) / 2
-        return self.base_lr
+    def _rate(self, t):
+        frac = min(t, self.max_update) / float(self.max_update)
+        blend = 0.5 * (1.0 + math.cos(math.pi * frac))
+        return self.final_lr + (self.base_lr - self.final_lr) * blend
 
 
 class WarmupScheduler(LRScheduler):
-    """Linear warmup wrapping another scheduler (beyond-reference)."""
+    """Linear ramp over `warmup_steps` updates into a wrapped schedule,
+    whose clock starts when the ramp ends (beyond-reference)."""
 
     def __init__(self, warmup_steps, scheduler: LRScheduler):
         super().__init__(scheduler.base_lr)
         self.warmup_steps = warmup_steps
         self.scheduler = scheduler
 
-    def __call__(self, num_update):
-        if num_update < self.warmup_steps:
-            return self.scheduler.base_lr * num_update / max(1, self.warmup_steps)
-        return self.scheduler(num_update - self.warmup_steps)
+    def _rate(self, t):
+        if t < self.warmup_steps:
+            return self.scheduler.base_lr * t / max(1, self.warmup_steps)
+        return self.scheduler(t - self.warmup_steps)

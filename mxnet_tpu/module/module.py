@@ -1,90 +1,100 @@
-"""Module — symbol + data-parallel executor group + optimizer.
+"""Module — the symbolic training harness: one Symbol, an executor
+group that runs it as fused XLA programs, and an optimizer loop.
 
-Reference: python/mxnet/module/module.py (bind :363, init_optimizer :472,
-forward :570, backward :612, update :629, save/load_checkpoint :126,:164).
+Reference analog: python/mxnet/module/module.py (bind :363,
+init_optimizer :472, forward :570, backward :612, update :629,
+save/load_checkpoint :126,:164).  Differences that matter here: a
+"device list" is almost always one TPU mesh entry, forward+backward is
+ONE compiled computation (forward_backward), and parameter sync from
+devices is a fetch of already-consistent sharded buffers rather than a
+multi-GPU reduce.
 """
 from __future__ import annotations
 
 import logging
 import warnings
-from typing import Dict, List, Optional
-
-import numpy as np
-
 from .. import optimizer as opt_mod
-from ..base import MXNetError
 from ..context import Context, cpu
 from ..initializer import InitDesc, Uniform
 from ..io.io import DataDesc
 from ..model import (_create_kvstore, _initialize_kvstore, _update_params,
                      _update_params_on_kvstore, load_checkpoint,
                      save_checkpoint)
-from ..ndarray.ndarray import NDArray, zeros as nd_zeros
+from ..ndarray.ndarray import zeros as nd_zeros
 from .base_module import BaseModule, _check_input_names
 from .executor_group import DataParallelExecutorGroup
 
 
-class Module(BaseModule):
-    """reference module.py:71"""
+def _to_descs(shapes):
+    """Normalize a list of (name, shape) / DataDesc into DataDescs;
+    empty input -> None (unlabeled binding)."""
+    if not shapes:
+        return None
+    return [s if isinstance(s, DataDesc) else DataDesc(*s) for s in shapes]
 
-    def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
-                 logger=logging, context=None, work_load_list=None,
-                 fixed_param_names=None, state_names=None, group2ctxs=None,
-                 compression_params=None):
+
+class Module(BaseModule):
+    """Symbol + executor group + optimizer (reference module.py:71)."""
+
+    def _require(self, bound=False, params=False, optimizer=False):
+        """Raise a descriptive error when a lifecycle stage is missing."""
+        if bound and not self.binded:
+            raise RuntimeError("this Module is not bound yet — call bind()")
+        if params and not self.params_initialized:
+            raise RuntimeError("parameters not initialized — call "
+                               "init_params() or load()")
+        if optimizer and not self.optimizer_initialized:
+            raise RuntimeError("optimizer not initialized — call "
+                               "init_optimizer()")
+
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None, group2ctxs=None, compression_params=None):
         super().__init__(logger=logger)
-        if context is None:
-            context = [cpu()]
-        if isinstance(context, Context):
-            context = [context]
-        self._context = context
-        if work_load_list is None:
-            work_load_list = [1] * len(self._context)
-        assert len(work_load_list) == len(self._context)
-        self._work_load_list = work_load_list
+        ctxs = context if context is not None else [cpu()]
+        self._context = [ctxs] if isinstance(ctxs, Context) else ctxs
+        self._work_load_list = (work_load_list if work_load_list is not None
+                                else [1] * len(self._context))
+        assert len(self._work_load_list) == len(self._context)
 
         self._symbol = symbol
-        data_names = list(data_names) if data_names is not None else []
-        label_names = list(label_names) if label_names is not None else []
-
-        arg_names = symbol.list_arguments()
-        input_names = data_names + label_names
-        self._param_names = [x for x in arg_names if x not in input_names]
-        self._fixed_param_names = list(fixed_param_names or [])
-        self._aux_names = symbol.list_auxiliary_states()
-        self._data_names = data_names
-        self._label_names = label_names
+        self._data_names = list(data_names or [])
+        self._label_names = list(label_names or [])
         self._state_names = list(state_names or [])
+        self._fixed_param_names = list(fixed_param_names or [])
         self._output_names = symbol.list_outputs()
-        self._compression_params = compression_params
+        self._aux_names = symbol.list_auxiliary_states()
+        inputs = set(self._data_names) | set(self._label_names)
+        self._param_names = [a for a in symbol.list_arguments()
+                             if a not in inputs]
         self._group2ctxs = group2ctxs
+        self._compression_params = compression_params
 
-        _check_input_names(symbol, data_names, "data", True)
-        _check_input_names(symbol, label_names, "label", False)
-        _check_input_names(symbol, self._state_names, "state", True)
-        _check_input_names(symbol, self._fixed_param_names, "fixed_param", True)
+        for names, role, required in (
+                (self._data_names, "data", True),
+                (self._label_names, "label", False),
+                (self._state_names, "state", True),
+                (self._fixed_param_names, "fixed_param", True)):
+            _check_input_names(symbol, names, role, required)
 
-        self._arg_params = None
-        self._aux_params = None
+        for slot in ("_arg_params", "_aux_params", "_optimizer",
+                     "_kvstore", "_update_on_kvstore", "_updater",
+                     "_preload_opt_states", "_grad_req", "_exec_group",
+                     "_data_shapes", "_label_shapes"):
+            setattr(self, slot, None)
         self._params_dirty = False
 
-        self._optimizer = None
-        self._kvstore = None
-        self._update_on_kvstore = None
-        self._updater = None
-        self._preload_opt_states = None
-        self._grad_req = None
-        self._exec_group: Optional[DataParallelExecutorGroup] = None
-        self._data_shapes = None
-        self._label_shapes = None
+    # -- checkpointing -----------------------------------------------------
 
-    # -- persistence ------------------------------------------------------
-    @staticmethod
-    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
-        """reference module.py:164"""
+    @classmethod
+    def load(cls, prefix, epoch, load_optimizer_states=False, **kwargs):
+        """Rebuild a Module from `prefix-symbol.json` + params of `epoch`
+        (reference module.py:164); optimizer state is loaded lazily at
+        init_optimizer time."""
         sym, args, auxs = load_checkpoint(prefix, epoch)
-        mod = Module(symbol=sym, **kwargs)
-        mod._arg_params = args
-        mod._aux_params = auxs
+        mod = cls(symbol=sym, **kwargs)
+        mod._arg_params, mod._aux_params = args, auxs
         mod.params_initialized = True
         if load_optimizer_states:
             mod._preload_opt_states = "%s-%04d.states" % (prefix, epoch)
@@ -96,101 +106,91 @@ class Module(BaseModule):
         save_checkpoint(prefix, epoch, self.symbol, self._arg_params,
                         self._aux_params)
         if save_optimizer_states:
-            state_name = "%s-%04d.states" % (prefix, epoch)
-            self.save_optimizer_states(state_name)
+            self.save_optimizer_states("%s-%04d.states" % (prefix, epoch))
 
-    # -- properties -------------------------------------------------------
-    @property
-    def data_names(self):
-        return self._data_names
+    # -- introspection -----------------------------------------------------
 
     @property
-    def label_names(self):
-        return self._label_names
+    def data_names(self): return self._data_names          # noqa: E704
 
     @property
-    def output_names(self):
-        return self._output_names
+    def label_names(self): return self._label_names        # noqa: E704
+
+    @property
+    def output_names(self): return self._output_names      # noqa: E704
 
     @property
     def data_shapes(self):
-        assert self.binded
-        return self._data_shapes
+        return self._require(bound=True) or self._data_shapes
 
     @property
     def label_shapes(self):
-        assert self.binded
-        return self._label_shapes
+        return self._require(bound=True) or self._label_shapes
 
     @property
     def output_shapes(self):
-        assert self.binded
-        outs = self._exec_group.execs[0].outputs
-        per_dev = [(n, o.shape) for n, o in zip(self._output_names, outs)]
-        if len(self._exec_group.execs) == 1:
-            return per_dev
-        bs = self._exec_group.batch_size
-        return [(n, (bs,) + tuple(s[1:])) for n, s in per_dev]
+        self._require(bound=True)
+        head = self._exec_group.execs[0]
+        shapes = [(name, out.shape) for name, out
+                  in zip(self._output_names, head.outputs)]
+        if len(self._exec_group.execs) > 1:
+            # concat along batch: report the merged leading dim
+            total = self._exec_group.batch_size
+            shapes = [(n, (total,) + tuple(s[1:])) for n, s in shapes]
+        return shapes
 
-    # -- params -----------------------------------------------------------
+    # -- parameter lifecycle ----------------------------------------------
+
     def get_params(self):
-        assert self.binded and self.params_initialized
+        self._require(bound=True, params=True)
         if self._params_dirty:
             self._sync_params_from_devices()
-        return (self._arg_params, self._aux_params)
+        return self._arg_params, self._aux_params
 
     def init_params(self, initializer=Uniform(0.01), arg_params=None,
                     aux_params=None, allow_missing=False, force_init=False,
                     allow_extra=False):
-        """reference module.py:233"""
+        """Materialize host copies of every parameter, fill them from
+        the given dicts or the initializer, and push to the executors
+        (reference module.py:233)."""
         if self.params_initialized and not force_init:
-            warnings.warn("Parameters already initialized and force_init=False. "
-                          "init_params call ignored.", stacklevel=2)
+            warnings.warn("parameters already set; init_params is a no-op "
+                          "without force_init", stacklevel=2)
             return
-        assert self.binded, "call bind before initializing the parameters"
+        self._require(bound=True)
 
-        param_shapes = {}
-        aux_shapes = {}
         ex0 = self._exec_group.execs[0]
-        for name in self._param_names:
-            if name in ex0.arg_dict:
-                param_shapes[name] = ex0.arg_dict[name]
-        for name in self._aux_names:
-            if name in ex0.aux_dict:
-                aux_shapes[name] = ex0.aux_dict[name]
 
-        if self._arg_params is None:
-            self._arg_params = {
-                name: nd_zeros(arr.shape, dtype=arr.dtype)
-                for name, arr in param_shapes.items()}
-        if self._aux_params is None:
-            self._aux_params = {
-                name: nd_zeros(arr.shape, dtype=arr.dtype)
-                for name, arr in aux_shapes.items()}
+        def materialize(names, device_dict, current):
+            if current is not None:
+                return current
+            return {n: nd_zeros(device_dict[n].shape,
+                                dtype=device_dict[n].dtype)
+                    for n in names if n in device_dict}
+
+        self._arg_params = materialize(self._param_names, ex0.arg_dict,
+                                       self._arg_params)
+        self._aux_params = materialize(self._aux_names, ex0.aux_dict,
+                                       self._aux_params)
 
         attrs = self._symbol.attr_dict()
 
-        def _impl(name, arr, cache):
-            if cache is not None:
-                if name in cache:
-                    cache_arr = cache[name]
-                    if cache_arr is not arr:
-                        cache_arr.copyto(arr)
-                else:
-                    if not allow_missing:
-                        raise RuntimeError("%s is not presented" % name)
-                    if initializer is not None:
-                        initializer(InitDesc(name, attrs.get(name)), arr)
-            else:
-                if initializer is not None:
+        def fill(host, source):
+            for name in sorted(host):
+                arr = host[name]
+                given = None if source is None else source.get(name)
+                if given is not None:
+                    if given is not arr:
+                        given.copyto(arr)
+                elif source is not None and not allow_missing:
+                    raise RuntimeError(
+                        "parameter %r missing from the provided dict "
+                        "(allow_missing=False)" % name)
+                elif initializer is not None:
                     initializer(InitDesc(name, attrs.get(name)), arr)
 
-        for name, arr in sorted(self._arg_params.items()):
-            desc = InitDesc(name, attrs.get(name))
-            _impl(desc, arr, arg_params)
-        for name, arr in sorted(self._aux_params.items()):
-            desc = InitDesc(name, attrs.get(name))
-            _impl(desc, arr, aux_params)
+        fill(self._arg_params, arg_params)
+        fill(self._aux_params, aux_params)
 
         self.params_initialized = True
         self._params_dirty = False
@@ -200,46 +200,46 @@ class Module(BaseModule):
     def set_params(self, arg_params, aux_params, allow_missing=False,
                    force_init=True, allow_extra=False):
         if not allow_missing:
+            # strict mode goes through init_params so the missing-name
+            # check and host-copy maintenance are shared
             self.init_params(initializer=None, arg_params=arg_params,
-                             aux_params=aux_params, allow_missing=allow_missing,
+                             aux_params=aux_params, allow_missing=False,
                              force_init=force_init, allow_extra=allow_extra)
             return
         if self.params_initialized and not force_init:
-            warnings.warn("Parameters already initialized and force_init=False. "
-                          "set_params call ignored.", stacklevel=2)
+            warnings.warn("parameters already set; set_params is a no-op "
+                          "without force_init", stacklevel=2)
             return
         self._exec_group.set_params(arg_params, aux_params,
                                     allow_extra=allow_extra)
-        self._params_dirty = True
+        self._params_dirty = True      # host copies now stale
         self.params_initialized = True
 
-    # -- bind -------------------------------------------------------------
+    # -- binding -----------------------------------------------------------
+
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
              grad_req="write"):
-        """reference module.py:363"""
+        """Compile-and-allocate for the given input shapes (reference
+        module.py:363)."""
         if force_rebind:
             self._reset_bind()
         if self.binded:
             self.logger.warning("Already bound, ignoring bind()")
             return
-
-        self.for_training = for_training
-        self.inputs_need_grad = inputs_need_grad
-        self._grad_req = grad_req
-
         if not for_training:
             assert not inputs_need_grad
 
-        self._data_shapes = [x if isinstance(x, DataDesc) else DataDesc(*x)
-                             for x in data_shapes]
-        self._label_shapes = [x if isinstance(x, DataDesc) else DataDesc(*x)
-                              for x in (label_shapes or [])] or None
+        self.for_training, self.inputs_need_grad, self._grad_req = \
+            for_training, inputs_need_grad, grad_req
+        self._data_shapes = _to_descs(data_shapes)
+        self._label_shapes = _to_descs(label_shapes)
 
         shared_group = None
         if shared_module is not None:
-            assert isinstance(shared_module, Module) and \
-                shared_module.binded and shared_module.params_initialized
+            assert (isinstance(shared_module, Module)
+                    and shared_module.binded
+                    and shared_module.params_initialized)
             shared_group = shared_module._exec_group
 
         self._exec_group = DataParallelExecutorGroup(
@@ -252,157 +252,157 @@ class Module(BaseModule):
         self.binded = True
 
         if shared_module is not None and shared_module.params_initialized:
-            self._arg_params = shared_module._arg_params
-            self._aux_params = shared_module._aux_params
+            self._arg_params, self._aux_params = (shared_module._arg_params,
+                                                  shared_module._aux_params)
             self.params_initialized = True
         elif self.params_initialized:
             self._exec_group.set_params(self._arg_params, self._aux_params)
 
     def _reset_bind(self):
         self.binded = False
-        self._exec_group = None
-        self._data_shapes = None
-        self._label_shapes = None
+        self._exec_group = self._data_shapes = self._label_shapes = None
 
     def reshape(self, data_shapes, label_shapes=None):
-        assert self.binded
-        self._data_shapes = [x if isinstance(x, DataDesc) else DataDesc(*x)
-                             for x in data_shapes]
-        self._label_shapes = [x if isinstance(x, DataDesc) else DataDesc(*x)
-                              for x in (label_shapes or [])] or None
+        self._require(bound=True)
+        self._data_shapes = _to_descs(data_shapes)
+        self._label_shapes = _to_descs(label_shapes)
         self._exec_group.reshape(self._data_shapes, self._label_shapes)
         if self.params_initialized:
             self._exec_group.set_params(self._arg_params, self._aux_params)
 
-    # -- optimizer --------------------------------------------------------
+    # -- optimizer ---------------------------------------------------------
+
+    def _rescale_denominator(self, kvstore):
+        """Global batch size the loss gradient must be averaged over."""
+        n = self._exec_group.batch_size
+        if kvstore and "dist" in kvstore.type and "_sync" in kvstore.type:
+            n *= kvstore.num_workers
+        return n
+
+    def _param_index_names(self, update_on_kvstore):
+        """Updater index -> parameter name.  When updates run locally
+        every (param, device) pair gets its own updater slot."""
+        names = self._exec_group.param_names
+        if update_on_kvstore:
+            return dict(enumerate(names))
+        n_dev = len(self._context)
+        return {i * n_dev + k: name
+                for i, name in enumerate(names) for k in range(n_dev)}
+
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
                        force_init=False):
         """reference module.py:472"""
-        assert self.binded and self.params_initialized
+        self._require(bound=True, params=True)
         if self.optimizer_initialized and not force_init:
             self.logger.warning("optimizer already initialized, ignoring...")
             return
-        if self._params_dirty:
+        if self._params_dirty:      # pull latest values before rescaling
             self._sync_params_from_devices()
 
-        (kvstore, update_on_kvstore) = _create_kvstore(
+        kvstore, update_on_kvstore = _create_kvstore(
             kvstore, len(self._context), self._arg_params)
-        batch_size = self._exec_group.batch_size
-        if kvstore and "dist" in kvstore.type and "_sync" in kvstore.type:
-            batch_size *= kvstore.num_workers
-        rescale_grad = 1.0 / batch_size
-
-        idx2name = {}
-        if update_on_kvstore:
-            idx2name.update(enumerate(self._exec_group.param_names))
-        else:
-            for k in range(len(self._context)):
-                idx2name.update(
-                    {i * len(self._context) + k: n
-                     for i, n in enumerate(self._exec_group.param_names)})
+        rescale = 1.0 / self._rescale_denominator(kvstore)
+        idx2name = self._param_index_names(update_on_kvstore)
 
         if isinstance(optimizer, str):
-            optimizer_params = dict(optimizer_params)
-            if "rescale_grad" not in optimizer_params:
-                optimizer_params["rescale_grad"] = rescale_grad
+            kwargs = dict(optimizer_params)
+            kwargs.setdefault("rescale_grad", rescale)
             optimizer = opt_mod.create(optimizer, sym=self.symbol,
-                                       param_idx2name=idx2name,
-                                       **optimizer_params)
+                                       param_idx2name=idx2name, **kwargs)
         else:
             assert isinstance(optimizer, opt_mod.Optimizer)
-            if optimizer.rescale_grad != rescale_grad:
+            if optimizer.rescale_grad != rescale:
                 warnings.warn(
-                    "Optimizer created manually outside Module but rescale_grad "
-                    "is not normalized to 1.0/batch_size/num_workers (%s vs. %s). "
-                    % (optimizer.rescale_grad, rescale_grad))
+                    "externally created optimizer has rescale_grad=%s; the "
+                    "global batch implies %s — gradients will not be "
+                    "averaged the usual way" % (optimizer.rescale_grad,
+                                                rescale))
             if not optimizer.idx2name:
                 optimizer.idx2name = idx2name.copy()
 
-        self._optimizer = optimizer
-        self._kvstore = kvstore
-        self._update_on_kvstore = update_on_kvstore
-        self._updater = None
+        self._optimizer, self._kvstore = optimizer, kvstore
+        self._update_on_kvstore, self._updater = update_on_kvstore, None
 
         if kvstore:
             if self._compression_params:
                 kvstore.set_gradient_compression(self._compression_params)
-            _initialize_kvstore(kvstore=kvstore,
-                                param_arrays=self._exec_group_param_arrays(),
-                                arg_params=self._arg_params,
-                                param_names=self._exec_group.param_names,
-                                update_on_kvstore=update_on_kvstore)
+            _initialize_kvstore(
+                kvstore=kvstore, arg_params=self._arg_params,
+                param_arrays=self._exec_group_param_arrays(),
+                param_names=self._exec_group.param_names,
+                update_on_kvstore=update_on_kvstore)
         if update_on_kvstore:
             kvstore.set_optimizer(self._optimizer)
         else:
             self._updater = opt_mod.get_updater(optimizer)
-
         self.optimizer_initialized = True
+
         if self._preload_opt_states is not None:
             self.load_optimizer_states(self._preload_opt_states)
             self._preload_opt_states = None
 
     def borrow_optimizer(self, shared_module):
-        """Share optimizer/kvstore with another Module (reference
-        module.py borrow_optimizer; used by BucketingModule)."""
+        """Adopt another Module's optimizer/kvstore/updater triple so
+        bucketed executors share one optimizer (reference
+        borrow_optimizer; used by BucketingModule)."""
         assert shared_module.optimizer_initialized
-        self._optimizer = shared_module._optimizer
-        self._kvstore = shared_module._kvstore
-        self._update_on_kvstore = shared_module._update_on_kvstore
-        self._updater = shared_module._updater
+        for attr in ("_optimizer", "_kvstore", "_update_on_kvstore",
+                     "_updater"):
+            setattr(self, attr, getattr(shared_module, attr))
         self.optimizer_initialized = True
 
     def _exec_group_param_arrays(self):
-        """param_arrays: per-param list of per-device NDArrays."""
-        out = []
-        for name in self._exec_group.param_names:
-            out.append([ex.arg_dict[name] for ex in self._exec_group.execs
-                        if name in ex.arg_dict])
-        return out
+        """Per-parameter lists of per-device arrays."""
+        return [[ex.arg_dict[name] for ex in self._exec_group.execs
+                 if name in ex.arg_dict]
+                for name in self._exec_group.param_names]
 
     def _exec_group_grad_arrays(self):
-        out = []
-        for name in self._exec_group.param_names:
-            grads = [ex.grad_dict.get(name) for ex in self._exec_group.execs]
-            out.append(grads)
-        return out
+        return [[ex.grad_dict.get(name) for ex in self._exec_group.execs]
+                for name in self._exec_group.param_names]
 
-    # -- train step -------------------------------------------------------
+    # -- the train step ----------------------------------------------------
+
     def forward(self, data_batch, is_train=None):
-        assert self.binded and self.params_initialized
-        curr_data_shapes = tuple(i.shape for i in self._data_shapes)
+        self._require(bound=True, params=True)
+        bound = tuple(d.shape for d in self._data_shapes)
         if isinstance(data_batch, list):
-            new_data_shapes = tuple(b.data[0].shape for b in data_batch)
+            incoming = tuple(b.data[0].shape for b in data_batch)
         else:
-            new_data_shapes = tuple(i.shape for i in data_batch.data)
-        if curr_data_shapes != new_data_shapes:
-            new_dshape = [
-                DataDesc(i.name, shape, i.dtype, i.layout)
-                for i, shape in zip(self._data_shapes, new_data_shapes)]
-            if hasattr(data_batch, "provide_label") and data_batch.provide_label:
-                new_lshape = data_batch.provide_label
-            elif getattr(data_batch, "label", None):
-                new_lshape = [
-                    DataDesc(i.name, j.shape, i.dtype, i.layout)
-                    for i, j in zip(self._label_shapes or [], data_batch.label)]
-            else:
-                new_lshape = None
-            self.reshape(new_dshape, new_lshape)
+            incoming = tuple(a.shape for a in data_batch.data)
+        if bound != incoming:
+            self._rebind_for(data_batch, incoming)
         self._exec_group.forward(data_batch, is_train)
+
+    def _rebind_for(self, data_batch, incoming):
+        """Shape change mid-stream (e.g. last partial batch): reshape the
+        executor group to the new geometry."""
+        new_data = [DataDesc(d.name, shp, d.dtype, d.layout)
+                    for d, shp in zip(self._data_shapes, incoming)]
+        if getattr(data_batch, "provide_label", None):
+            new_label = data_batch.provide_label
+        elif getattr(data_batch, "label", None):
+            new_label = [DataDesc(d.name, a.shape, d.dtype, d.layout)
+                         for d, a in zip(self._label_shapes or [],
+                                         data_batch.label)]
+        else:
+            new_label = None
+        self.reshape(new_data, new_label)
 
     def forward_backward(self, data_batch):
         """Fused fwd+bwd — one XLA computation per device."""
-        assert self.binded and self.params_initialized
+        self._require(bound=True, params=True)
         self._exec_group.forward_backward(data_batch)
 
     def backward(self, out_grads=None):
-        assert self.binded and self.params_initialized
+        self._require(bound=True, params=True)
         self._exec_group.backward(out_grads=out_grads)
 
     def update(self):
-        """reference module.py:629"""
-        assert self.binded and self.params_initialized and \
-            self.optimizer_initialized
+        """Apply one optimizer step to every parameter (reference
+        module.py:629)."""
+        self._require(bound=True, params=True, optimizer=True)
         self._params_dirty = True
         if self._update_on_kvstore:
             _update_params_on_kvstore(self._exec_group_param_arrays(),
@@ -412,18 +412,18 @@ class Module(BaseModule):
         else:
             _update_params(self._exec_group_param_arrays(),
                            self._exec_group_grad_arrays(),
-                           updater=self._updater,
+                           updater=self._updater, kvstore=self._kvstore,
                            num_device=len(self._context),
-                           kvstore=self._kvstore,
                            param_names=self._exec_group.param_names)
 
     def get_outputs(self, merge_multi_context=True):
-        assert self.binded and self.params_initialized
+        self._require(bound=True, params=True)
         return self._exec_group.get_outputs(merge_multi_context)
 
     def get_input_grads(self, merge_multi_context=True):
-        assert self.binded and self.params_initialized and \
-            self.inputs_need_grad
+        self._require(bound=True, params=True)
+        if not self.inputs_need_grad:
+            raise RuntimeError("bind(inputs_need_grad=True) required")
         return self._exec_group.get_input_grads(merge_multi_context)
 
     def update_metric(self, eval_metric, labels):
@@ -432,47 +432,52 @@ class Module(BaseModule):
     def _sync_params_from_devices(self):
         self._exec_group.get_params(self._arg_params, self._aux_params)
         if self._kvstore and self._update_on_kvstore:
-            for param_name, param_val in sorted(self._arg_params.items()):
-                if param_val.stype == "row_sparse":
-                    row_ids = nd_zeros(param_val.shape[0], dtype="int64")
-                    self._kvstore.row_sparse_pull(param_name, param_val,
-                                                  row_ids=row_ids)
+            for name, val in sorted(self._arg_params.items()):
+                if val.stype == "row_sparse":
+                    self._kvstore.row_sparse_pull(
+                        name, val,
+                        row_ids=nd_zeros(val.shape[0], dtype="int64"))
         self._params_dirty = False
 
+    # -- optimizer-state persistence --------------------------------------
+
     def save_optimizer_states(self, fname):
-        assert self.optimizer_initialized
-        if self._update_on_kvstore:
-            self._kvstore.save_optimizer_states(fname)
-        else:
-            with open(fname, "wb") as fout:
-                fout.write(self._updater.get_states())
+        self._require(optimizer=True)
+        owner = self._kvstore if self._update_on_kvstore else None
+        if owner is not None:
+            owner.save_optimizer_states(fname)
+            return
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states())
 
     def load_optimizer_states(self, fname):
-        assert self.optimizer_initialized
-        if self._update_on_kvstore:
-            self._kvstore.load_optimizer_states(fname)
-        else:
-            with open(fname, "rb") as f:
-                self._updater.set_states(f.read())
+        self._require(optimizer=True)
+        owner = self._kvstore if self._update_on_kvstore else None
+        if owner is not None:
+            owner.load_optimizer_states(fname)
+            return
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    # -- misc --------------------------------------------------------------
 
     def install_monitor(self, mon):
-        assert self.binded
+        self._require(bound=True)
         self._exec_group.install_monitor(mon)
 
     def prepare(self, data_batch, sparse_row_id_fn=None):
-        assert self.binded
-        if sparse_row_id_fn is not None:
-            if not self._kvstore or not self._update_on_kvstore:
-                warnings.warn(UserWarning(
-                    "sparse_row_id_fn is not invoked with no kvstore/"
-                    "update_on_kvstore."))
-            else:
-                row_ids = sparse_row_id_fn(data_batch)
-                for param_name, row_id in row_ids.items():
-                    if param_name not in self._exec_group.param_names:
-                        continue
-                    idx = self._exec_group.param_names.index(param_name)
-                    param_arrays = self._exec_group_param_arrays()[idx]
-                    self._kvstore.row_sparse_pull(
-                        param_name, param_arrays, row_ids=[row_id] *
-                        len(param_arrays))
+        self._require(bound=True)
+        if sparse_row_id_fn is None:
+            return
+        if not (self._kvstore and self._update_on_kvstore):
+            warnings.warn(UserWarning(
+                "sparse_row_id_fn does nothing without a kvstore doing "
+                "the updates"))
+            return
+        for name, row_id in sparse_row_id_fn(data_batch).items():
+            if name not in self._exec_group.param_names:
+                continue
+            idx = self._exec_group.param_names.index(name)
+            arrays = self._exec_group_param_arrays()[idx]
+            self._kvstore.row_sparse_pull(name, arrays,
+                                          row_ids=[row_id] * len(arrays))
