@@ -267,25 +267,9 @@ def _roi_pooling(attrs, data, rois):
     return jax.vmap(one_roi)(rois).astype(data.dtype)
 
 
-@register("_contrib_Proposal",
-          inputs=("cls_prob", "bbox_pred", "im_info"),
-          params=dict(rpn_pre_nms_top_n=attr_int(6000),
-                      rpn_post_nms_top_n=attr_int(300),
-                      threshold=attr_float(0.7),
-                      rpn_min_size=attr_int(16),
-                      scales=_floats((4.0, 8.0, 16.0, 32.0)),
-                      ratios=_floats((0.5, 1.0, 2.0)),
-                      feature_stride=attr_int(16),
-                      output_score=attr_bool(False),
-                      iou_loss=attr_bool(False)),
-          aliases=("Proposal", "_contrib_proposal"))
-def _proposal(attrs, cls_prob, bbox_pred, im_info):
-    """RPN proposal layer (reference contrib/proposal-inl.h), fixed-shape:
-    returns (post_nms_top_n, 5) rois [batch0, x1,y1,x2,y2]."""
-    B, A2, H, W = cls_prob.shape
-    A = A2 // 2
+def _rpn_anchors(attrs, A, H, W):
+    """All shifted base anchors for an (H, W) feature map."""
     stride = attrs.feature_stride
-    # base anchors at each cell
     base = []
     for r in attrs.ratios:
         for s in attrs.scales:
@@ -299,10 +283,15 @@ def _proposal(attrs, cls_prob, bbox_pred, im_info):
     shift_y = jnp.arange(H) * stride
     sy, sx = jnp.meshgrid(shift_y, shift_x, indexing="ij")
     shifts = jnp.stack([sx, sy, sx, sy], axis=-1).reshape(-1, 4)  # (HW,4)
-    anchors = (shifts[:, None, :] + base[None]).reshape(-1, 4)  # (HW*A,4)
+    return (shifts[:, None, :] + base[None]).reshape(-1, 4)  # (HW*A,4)
 
-    scores = cls_prob[0, A:].transpose(1, 2, 0).reshape(-1)  # fg scores
-    deltas = bbox_pred[0].transpose(1, 2, 0).reshape(-1, 4)
+
+def _propose_one(attrs, anchors, fg_scores, deltas, info):
+    """Single-image RPN proposal: decode, clip, size-filter, NMS, topk.
+    fg_scores (A,H,W); deltas (A*4,H,W); info (3,).  Returns
+    (rois (post_n,4), scores (post_n,))."""
+    scores = fg_scores.transpose(1, 2, 0).reshape(-1)
+    deltas = deltas.transpose(1, 2, 0).reshape(-1, 4)
     aw = anchors[:, 2] - anchors[:, 0] + 1
     ah = anchors[:, 3] - anchors[:, 1] + 1
     acx = anchors[:, 0] + aw / 2
@@ -313,7 +302,7 @@ def _proposal(attrs, cls_prob, bbox_pred, im_info):
     h = jnp.exp(jnp.clip(deltas[:, 3], -10, 10)) * ah
     boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
                       axis=-1)
-    imh, imw = im_info[0, 0], im_info[0, 1]
+    imh, imw = info[0], info[1]
     boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, imw - 1),
                        jnp.clip(boxes[:, 1], 0, imh - 1),
                        jnp.clip(boxes[:, 2], 0, imw - 1),
@@ -327,11 +316,70 @@ def _proposal(attrs, cls_prob, bbox_pred, im_info):
     keep = _greedy_nms(top_boxes, top_scores, attrs.threshold, pre_n)
     final_score = jnp.where(keep, top_scores, -jnp.inf)
     post_n = min(attrs.rpn_post_nms_top_n, pre_n)
-    _, sel = jax.lax.top_k(final_score, post_n)
-    rois = top_boxes[sel]
-    out = jnp.concatenate([jnp.zeros((post_n, 1), rois.dtype), rois], axis=1)
+    sel_score, sel = jax.lax.top_k(final_score, post_n)
+    return top_boxes[sel], jnp.maximum(sel_score, 0.0)
+
+
+@register("_contrib_Proposal",
+          inputs=("cls_prob", "bbox_pred", "im_info"),
+          params=dict(rpn_pre_nms_top_n=attr_int(6000),
+                      rpn_post_nms_top_n=attr_int(300),
+                      threshold=attr_float(0.7),
+                      rpn_min_size=attr_int(16),
+                      scales=_floats((4.0, 8.0, 16.0, 32.0)),
+                      ratios=_floats((0.5, 1.0, 2.0)),
+                      feature_stride=attr_int(16),
+                      output_score=attr_bool(False),
+                      iou_loss=attr_bool(False)),
+          num_outputs=lambda attrs: 2 if attrs.output_score else 1,
+          aliases=("Proposal", "_contrib_proposal"))
+def _proposal(attrs, cls_prob, bbox_pred, im_info):
+    """RPN proposal layer (reference contrib/proposal-inl.h), fixed-shape:
+    returns (post_nms_top_n, 5) rois [batch0, x1,y1,x2,y2]; with
+    output_score also the (post_nms_top_n, 1) scores."""
+    B, A2, H, W = cls_prob.shape
+    A = A2 // 2
+    anchors = _rpn_anchors(attrs, A, H, W)
+    rois, scores = _propose_one(attrs, anchors, cls_prob[0, A:],
+                                bbox_pred[0], im_info[0])
+    post_n = rois.shape[0]
+    out = jnp.concatenate([jnp.zeros((post_n, 1), rois.dtype), rois],
+                          axis=1)
     if attrs.output_score:
-        return out
+        return out, scores[:, None]
+    return out
+
+
+@register("_contrib_MultiProposal",
+          inputs=("cls_prob", "bbox_pred", "im_info"),
+          params=dict(rpn_pre_nms_top_n=attr_int(6000),
+                      rpn_post_nms_top_n=attr_int(300),
+                      threshold=attr_float(0.7),
+                      rpn_min_size=attr_int(16),
+                      scales=_floats((4.0, 8.0, 16.0, 32.0)),
+                      ratios=_floats((0.5, 1.0, 2.0)),
+                      feature_stride=attr_int(16),
+                      output_score=attr_bool(False),
+                      iou_loss=attr_bool(False)),
+          num_outputs=lambda attrs: 2 if attrs.output_score else 1,
+          aliases=("MultiProposal", "_contrib_multi_proposal"))
+def _multi_proposal(attrs, cls_prob, bbox_pred, im_info):
+    """Batched RPN proposals (reference contrib/multi_proposal-inl.h:121):
+    the whole batch in one call, output (B*post_nms_top_n, 5) with the
+    image index in column 0 (+ scores with output_score).  One vmap over
+    the single-image path — the per-image NMS loops run as one batched
+    XLA program."""
+    B, A2, H, W = cls_prob.shape
+    A = A2 // 2
+    anchors = _rpn_anchors(attrs, A, H, W)
+    rois, scores = jax.vmap(
+        lambda s, d, i: _propose_one(attrs, anchors, s, d, i)
+    )(cls_prob[:, A:], bbox_pred, im_info)
+    post_n = rois.shape[1]
+    bidx = jnp.repeat(jnp.arange(B, dtype=rois.dtype), post_n)[:, None]
+    out = jnp.concatenate([bidx, rois.reshape(B * post_n, 4)], axis=1)
+    if attrs.output_score:
+        return out, scores.reshape(B * post_n, 1)
     return out
 
 
@@ -511,6 +559,102 @@ def _psroi_pooling(attrs, data, rois):
         return jnp.transpose(cells, (2, 0, 1))
 
     return jax.vmap(one_roi)(rois).astype(data.dtype)
+
+
+@register("_contrib_DeformablePSROIPooling",
+          inputs=("data", "rois", "trans"),
+          params=dict(spatial_scale=attr_float(required=True),
+                      output_dim=attr_int(required=True),
+                      group_size=attr_int(required=True),
+                      pooled_size=attr_int(required=True),
+                      part_size=attr_int(0),
+                      sample_per_part=attr_int(1),
+                      trans_std=attr_float(0.0),
+                      no_trans=attr_bool(False)),
+          num_outputs=2, aliases=("DeformablePSROIPooling",))
+def _deformable_psroi_pooling(attrs, data, rois, trans=None):
+    """Deformable position-sensitive ROI pooling (reference
+    contrib/deformable_psroi_pooling.cu ForwardKernel; R-FCN deformable
+    head).  data (B, output_dim*group_size^2, H, W); rois (R,5) image
+    coords; trans (R, 2*num_classes, part_size, part_size) learned bin
+    offsets, scaled by trans_std.  Outputs (output, top_count), both
+    (R, output_dim, k, k)."""
+    k = attrs.pooled_size
+    od = attrs.output_dim
+    gs = attrs.group_size
+    part = attrs.part_size or k
+    spp = attrs.sample_per_part
+    B, C, H, W = data.shape
+    no_trans = attrs.no_trans or trans is None
+    n_cls = 1 if no_trans else trans.shape[1] // 2
+    ch_per_cls = max(od // n_cls, 1)
+
+    def one_roi(roi, tr):
+        bidx = roi[0].astype(jnp.int32)
+        # [start, end) sampling window on the -0.5-centered pixel grid
+        x0 = jnp.round(roi[1]) * attrs.spatial_scale - 0.5
+        y0 = jnp.round(roi[2]) * attrs.spatial_scale - 0.5
+        x1 = (jnp.round(roi[3]) + 1.0) * attrs.spatial_scale - 0.5
+        y1 = (jnp.round(roi[4]) + 1.0) * attrs.spatial_scale - 0.5
+        rw = jnp.maximum(x1 - x0, 0.1)
+        rh = jnp.maximum(y1 - y0, 0.1)
+        bin_w, bin_h = rw / k, rh / k
+        sub_w, sub_h = bin_w / spp, bin_h / spp
+        img = data[bidx]
+
+        def pool_cell(ctop, py, px):
+            part_h = jnp.floor(py.astype(jnp.float32) / k * part) \
+                .astype(jnp.int32)
+            part_w = jnp.floor(px.astype(jnp.float32) / k * part) \
+                .astype(jnp.int32)
+            cls = ctop // ch_per_cls
+            if no_trans:
+                tx = ty = 0.0
+            else:
+                tx = tr[2 * cls, part_h, part_w] * attrs.trans_std
+                ty = tr[2 * cls + 1, part_h, part_w] * attrs.trans_std
+            wstart = px * bin_w + x0 + tx * rw
+            hstart = py * bin_h + y0 + ty * rh
+            gw = jnp.clip(jnp.floor(px.astype(jnp.float32) * gs / k)
+                          .astype(jnp.int32), 0, gs - 1)
+            gh = jnp.clip(jnp.floor(py.astype(jnp.float32) * gs / k)
+                          .astype(jnp.int32), 0, gs - 1)
+            c = (ctop * gs + gh) * gs + gw
+            chan = img[c]   # (H, W)
+
+            iw, ih = jnp.meshgrid(jnp.arange(spp), jnp.arange(spp),
+                                  indexing="xy")
+            ws = wstart + iw * sub_w   # (spp, spp)
+            hs = hstart + ih * sub_h
+            # the reference kernel SKIPS strictly-outside samples
+            # (w < -0.5 || w > width-0.5), so the boundary is inside
+            inside = ((ws >= -0.5) & (ws <= W - 0.5) &
+                      (hs >= -0.5) & (hs <= H - 0.5))
+            wc = jnp.clip(ws, 0.0, W - 1.0)
+            hc = jnp.clip(hs, 0.0, H - 1.0)
+            wl = jnp.floor(wc).astype(jnp.int32)
+            hl = jnp.floor(hc).astype(jnp.int32)
+            wr = jnp.minimum(wl + 1, W - 1)
+            hr = jnp.minimum(hl + 1, H - 1)
+            fw, fh = wc - wl, hc - hl
+            val = ((1 - fh) * (1 - fw) * chan[hl, wl] +
+                   (1 - fh) * fw * chan[hl, wr] +
+                   fh * (1 - fw) * chan[hr, wl] +
+                   fh * fw * chan[hr, wr])
+            cnt = inside.sum()
+            total = jnp.where(inside, val, 0.0).sum()
+            return jnp.where(cnt > 0, total / jnp.maximum(cnt, 1), 0.0), \
+                cnt.astype(data.dtype)
+
+        ci, pyi, pxi = jnp.meshgrid(jnp.arange(od), jnp.arange(k),
+                                    jnp.arange(k), indexing="ij")
+        vm = jax.vmap(jax.vmap(jax.vmap(pool_cell)))
+        return vm(ci, pyi, pxi)   # two (od,k,k) arrays
+
+    tr_in = (jnp.zeros((rois.shape[0], 2, part, part), data.dtype)
+             if no_trans else trans)
+    out, cnt = jax.vmap(one_roi)(rois, tr_in)
+    return out.astype(data.dtype), cnt
 
 
 # ---------------------------------------------------------------------------

@@ -295,6 +295,19 @@ SPECS.update({
     "Crop": S([_any(1, 2, 5, 5)],
               dict(offset=(1, 1), h_w=(3, 3), num_args=1), grad=True),
     # contrib
+    # sparse-storage ops (dense graph semantics; see ops/sparse_storage.py)
+    "cast_storage": S([_any(3, 4)], dict(stype="row_sparse"),
+                      grad=True, ref=lambda a, **kw: a),
+    "_sparse_retain": S(
+        [_any(4, 3), np.array([0., 2.], np.float32)],
+        ref=lambda a, idx: a * np.isin(np.arange(4),
+                                       idx.astype(int))[:, None]),
+    "_square_sum": S([_any(3, 4)], dict(axis=(1,)), grad=True,
+                     ref=lambda a, **kw: (a * a).sum(1)),
+    "_contrib_SparseEmbedding": S(
+        [np.array([[0., 2.], [1., 1.]], np.float32), _any(4, 3)],
+        dict(input_dim=4, output_dim=3),
+        ref=lambda idx, w, **kw: w[idx.astype(int)]),
     "_contrib_fft": S([_any(2, 4)], out_shape=(2, 8)),
     "_contrib_ifft": S([_any(2, 8)], out_shape=(2, 4)),
     "_contrib_count_sketch": S(
@@ -323,6 +336,17 @@ SPECS.update({
         dict(feature_stride=4, scales=(8,), ratios=(1.0,),
              rpn_pre_nms_top_n=6, rpn_post_nms_top_n=4,
              rpn_min_size=0)),
+    "_contrib_MultiProposal": S(
+        [_pos(2, 2, 4, 4), _any(2, 4, 4, 4),
+         np.array([[16., 16., 1.], [16., 16., 1.]], np.float32)],
+        dict(feature_stride=4, scales=(8,), ratios=(1.0,),
+             rpn_pre_nms_top_n=6, rpn_post_nms_top_n=4,
+             rpn_min_size=0), out_shape=(8, 5)),
+    "_contrib_DeformablePSROIPooling": S(
+        [_any(1, 8, 6, 6), np.array([[0., 0., 0., 4., 4.]], np.float32),
+         _any(1, 2, 2, 2)],
+        dict(output_dim=2, group_size=2, pooled_size=2, spatial_scale=1.0,
+             part_size=2, sample_per_part=2, trans_std=0.1)),
     "ROIPooling": S(
         [_any(1, 2, 6, 6), np.array([[0., 0., 0., 3., 3.]], np.float32)],
         dict(pooled_size=(2, 2), spatial_scale=1.0)),

@@ -313,3 +313,87 @@ def test_cast_block_grad():
         out = (nd.BlockGrad(v) * v).sum()
     out.backward()
     assert_almost_equal(v.grad.asnumpy(), v.asnumpy())  # only one path flows
+
+
+def test_multi_proposal_matches_per_image_proposal():
+    """reference contrib/multi_proposal-inl.h:121 — batched output is the
+    per-image Proposal results stacked with the image index in col 0."""
+    rs = np.random.RandomState(7)
+    B, A, H, W = 3, 2, 5, 5
+    cls_prob = rs.rand(B, 2 * A, H, W).astype(np.float32)
+    bbox_pred = (rs.randn(B, 4 * A, H, W) * 0.1).astype(np.float32)
+    im_info = np.tile(np.array([[40., 40., 1.]], np.float32), (B, 1))
+    kw = dict(feature_stride=8, scales=(4,), ratios=(0.5, 1.0),
+              rpn_pre_nms_top_n=12, rpn_post_nms_top_n=5, rpn_min_size=0)
+    multi = nd.contrib.MultiProposal(
+        nd.array(cls_prob), nd.array(bbox_pred), nd.array(im_info),
+        **kw).asnumpy()
+    assert multi.shape == (B * 5, 5)
+    for b in range(B):
+        single = nd.contrib.Proposal(
+            nd.array(cls_prob[b:b + 1]), nd.array(bbox_pred[b:b + 1]),
+            nd.array(im_info[b:b + 1]), **kw).asnumpy()
+        got = multi[b * 5:(b + 1) * 5]
+        assert_almost_equal(got[:, 0], np.full(5, b, np.float32))
+        assert_almost_equal(got[:, 1:], single[:, 1:], rtol=1e-5, atol=1e-5)
+
+
+def test_deformable_psroi_pooling():
+    """reference contrib/deformable_psroi_pooling.cu ForwardKernel: with
+    no_trans and sample_per_part=1 each output cell is the bilinear
+    sample at the bin's top-left sampling point of the matching
+    position-sensitive channel."""
+    od, gs, k = 2, 2, 2
+    H = W = 4
+    rs = np.random.RandomState(3)
+    data = rs.rand(1, od * gs * gs, H, W).astype(np.float32)
+    rois = np.array([[0., 0., 0., 3., 3.]], np.float32)
+    out, cnt = nd.contrib.DeformablePSROIPooling(
+        nd.array(data), nd.array(rois), nd.zeros((1, 2, k, k)),
+        spatial_scale=1.0, output_dim=od, group_size=gs, pooled_size=k,
+        sample_per_part=1, no_trans=True)
+    out, cnt = out.asnumpy(), cnt.asnumpy()
+    assert out.shape == (1, od, k, k) and cnt.shape == (1, od, k, k)
+    # mirror of the kernel math for this config
+    x0 = y0 = 0.0 * 1.0 - 0.5
+    rw = rh = max((3. + 1) * 1.0 - 0.5 - x0, 0.1)
+    bin_sz = rw / k
+    for ctop in range(od):
+        for py in range(k):
+            for px in range(k):
+                w = np.clip(px * bin_sz + x0, 0, W - 1)
+                h = np.clip(py * bin_sz + y0, 0, H - 1)
+                c = (ctop * gs + py) * gs + px   # gh=py, gw=px when gs==k
+                wl, hl = int(np.floor(w)), int(np.floor(h))
+                wr, hr = min(wl + 1, W - 1), min(hl + 1, H - 1)
+                fw, fh = w - wl, h - hl
+                ch = data[0, c]
+                want = ((1 - fh) * (1 - fw) * ch[hl, wl] +
+                        (1 - fh) * fw * ch[hl, wr] +
+                        fh * (1 - fw) * ch[hr, wl] +
+                        fh * fw * ch[hr, wr])
+                assert abs(out[0, ctop, py, px] - want) < 1e-5
+                assert cnt[0, ctop, py, px] == 1.0
+
+
+def test_deformable_psroi_trans_shifts_window():
+    """A positive x-offset in trans moves the sampling window right by
+    trans_std * offset * roi_width pixels."""
+    od, gs, k = 1, 1, 1
+    H = W = 6
+    data = np.arange(H * W, dtype=np.float32).reshape(1, 1, H, W)
+    rois = np.array([[0., 1., 1., 4., 4.]], np.float32)
+    base = nd.contrib.DeformablePSROIPooling(
+        nd.array(data), nd.array(rois), nd.zeros((1, 2, 1, 1)),
+        spatial_scale=1.0, output_dim=od, group_size=gs, pooled_size=k,
+        sample_per_part=2, trans_std=0.1, no_trans=False)[0].asnumpy()
+    trans = np.zeros((1, 2, 1, 1), np.float32)
+    trans[0, 0, 0, 0] = 1.0   # x offset
+    shifted = nd.contrib.DeformablePSROIPooling(
+        nd.array(data), nd.array(rois), nd.array(trans),
+        spatial_scale=1.0, output_dim=od, group_size=gs, pooled_size=k,
+        sample_per_part=2, trans_std=0.1, no_trans=False)[0].asnumpy()
+    # moving right on a row-major ramp increases the pooled value by the
+    # x-shift: 0.1 * 1.0 * roi_width(=4) = 0.4
+    assert shifted[0, 0, 0, 0] > base[0, 0, 0, 0]
+    assert abs((shifted - base)[0, 0, 0, 0] - 0.4) < 1e-4
