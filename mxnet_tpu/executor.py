@@ -458,6 +458,45 @@ def infer_types(symbol: Symbol, kwargs):
     return arg_types, out_types, aux_types
 
 
+def infer_storage_types(symbol: Symbol, kwargs):
+    """Storage-type inference (reference Symbol.infer_storage_type over
+    FInferStorageType, infer_graph_attr_pass.cc).
+
+    Forward propagation of {'default','row_sparse','csr'} tags through
+    the graph.  An op with a registered ``stype_rule`` (ops/
+    sparse_storage.py) declares its output storage; every other op is a
+    dense producer — sparse inputs densify at its edge, the reference's
+    dense-fallback path.  Variables default to 'default' unless given in
+    `kwargs` or tagged with a ``__storage_type__`` attr.
+
+    Returns (arg_stypes, out_stypes, aux_stypes) as strings."""
+    prog = GraphProgram(symbol)
+    given = {k: v for k, v in (kwargs or {}).items() if v}
+    sts: Dict[int, tuple] = {}
+    for node in prog.nodes:
+        if node.is_var:
+            st = given.get(node.name) or \
+                node.attrs.get("__storage_type__", "default")
+            sts[id(node)] = (st,)
+            continue
+        in_sts = tuple(sts[id(e.node)][e.index] for e in node.inputs)
+        rule = getattr(node.op, "stype_rule", None)
+        attrs = node.parsed_attrs()
+        if rule is not None:
+            out = tuple(rule(attrs, in_sts))
+            n_out = node.op.num_outputs(attrs)
+            if len(out) < n_out:
+                out = out + ("default",) * (n_out - len(out))
+        else:
+            out = ("default",) * node.op.num_outputs(attrs)
+        sts[id(node)] = out
+    by_name = {n.name: n for n in prog.nodes if n.is_var}
+    arg_sts = [sts[id(by_name[n])][0] for n in prog.arg_names]
+    out_sts = [sts[id(e.node)][e.index] for e in symbol._entries]
+    aux_sts = [sts[id(by_name[n])][0] for n in prog.aux_names]
+    return arg_sts, out_sts, aux_sts
+
+
 class Executor:
     """Bound computation (reference python/mxnet/executor.py).
 

@@ -19,6 +19,23 @@ from .sparse import (BaseSparseNDArray, CSRNDArray, RowSparseNDArray,  # noqa: E
                      csr_matrix, row_sparse_array)
 
 
+def cast_storage(data, stype):
+    """Eager storage conversion: returns a real CSR/RowSparse/dense
+    NDArray (the registry op of the same name is the identity inside
+    compiled graphs — storage is a boundary property; see
+    ops/sparse_storage.py)."""
+    return sparse.cast_storage(data, stype)
+
+
+def sparse_retain(data, indices):
+    """Eager sparse_retain: O(nnz) on RowSparse inputs, registry-op
+    (masked dense) semantics otherwise."""
+    if isinstance(data, RowSparseNDArray):
+        return data.retain(indices)
+    from .ndarray import invoke_with_arrays as _inv
+    return _inv("_sparse_retain", [data, indices], {})
+
+
 def maximum(lhs, rhs):
     from .ndarray import NDArray as _ND, invoke_with_arrays as _inv
     if isinstance(lhs, _ND) and isinstance(rhs, _ND):

@@ -12,3 +12,4 @@ from . import optimizer_ops     # noqa: F401
 from . import rnn               # noqa: F401
 from . import contrib           # noqa: F401
 from . import spatial           # noqa: F401
+from . import sparse_storage    # noqa: F401

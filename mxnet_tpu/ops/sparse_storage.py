@@ -1,0 +1,98 @@
+"""Sparse-storage operators as first-class registry ops.
+
+Reference analogs: src/operator/tensor/cast_storage.cc:33,
+sparse_retain.cc:33, square_sum.cc:50, indexing_op.cc:249
+(_contrib_SparseEmbedding).
+
+TPU-first storage model (see docs/architecture/note_sparse.md): inside a
+compiled XLA program every tensor is dense — MXU/VPU tiles want dense
+blocks, and the (indices, values) pairs of RowSparse/CSR live at the
+HOST boundary (ndarray/sparse.py keeps O(nnz) kernels for kvstore
+push/pull and optimizer updates).  These registry ops therefore compute
+the DENSE semantics of each sparse op so symbolic graphs compose, and
+carry a storage-type rule so ``infer_storage_type`` can mark which graph
+edges are logically sparse: the executor uses that to accept sparse
+NDArray feeds (densified lazily at the boundary) and to convert outputs
+back via ``tostype``.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import attr_bool, attr_dtype, attr_int, attr_shape, attr_str
+from .registry import get_op, register
+
+_STYPES = ("default", "row_sparse", "csr")
+
+
+@register("cast_storage", inputs=("data",),
+          params=dict(stype=attr_str(required=True)))
+def _cast_storage(attrs, x):
+    """Storage-format conversion (reference cast_storage-inl.h).  The
+    traced computation is the identity — storage format is a boundary
+    property, not a value property; the stype rule re-tags the edge."""
+    if attrs.stype not in _STYPES:
+        raise ValueError("unknown storage type %r" % (attrs.stype,))
+    return x
+
+
+@register("_sparse_retain", inputs=("data", "indices"),
+          aliases=("sparse_retain",))
+def _sparse_retain_op(attrs, data, indices):
+    """Keep only the requested rows (reference sparse_retain.cc:33).
+    Dense semantics: rows not named in `indices` become zero — exactly
+    what densifying the reference's row_sparse output yields."""
+    keep = jnp.zeros((data.shape[0],), bool) \
+        .at[indices.astype(jnp.int32)].set(True, mode="drop")
+    return jnp.where(keep.reshape((-1,) + (1,) * (data.ndim - 1)),
+                     data, jnp.zeros((), data.dtype))
+
+
+@register("_square_sum", inputs=("data",),
+          params=dict(axis=attr_shape(None), keepdims=attr_bool(False),
+                      exclude=attr_bool(False)),
+          aliases=("square_sum",))
+def _square_sum_op(attrs, x):
+    """sum(x**2) fused reduce (reference square_sum.cc:50 — there a
+    row_sparse-only fused kernel; here one XLA fusion over the dense
+    value, which never materialises x**2 either)."""
+    from .broadcast_reduce import _norm_axes
+    axes = _norm_axes(attrs, x.ndim)
+    return jnp.sum(x * x, axis=axes, keepdims=attrs.keepdims)
+
+
+@register("_contrib_SparseEmbedding", inputs=("data", "weight"),
+          params=dict(input_dim=attr_int(required=True),
+                      output_dim=attr_int(required=True),
+                      dtype=attr_dtype("float32"),
+                      deterministic=attr_bool(False)))
+def _sparse_embedding(attrs, idx, weight):
+    """Embedding whose weight gradient is logically row_sparse
+    (reference indexing_op.cc:249).  Forward is a dense gather; the
+    row_sparse gradient materialises at the kvstore boundary — the
+    trainer pushes only touched rows (ndarray/sparse.py embedding_grad),
+    which is the reference's SparseEmbedding contract."""
+    return jnp.take(weight, idx.astype(jnp.int32), axis=0)
+
+
+# -- storage-type rules -----------------------------------------------------
+# rule(attrs, in_stypes) -> out_stypes tuple.  Ops without a rule are
+# dense producers: any sparse input is densified at the edge (the
+# reference's "dense fallback" in FInferStorageType) and outputs are
+# "default".
+
+def install_stype_rules():
+    get_op("cast_storage").stype_rule = \
+        lambda attrs, ins: (attrs.stype,)
+    get_op("_sparse_retain").stype_rule = \
+        lambda attrs, ins: ("row_sparse",)
+    # square_sum: dense output (a reduction of a sparse input is dense)
+    get_op("_square_sum").stype_rule = \
+        lambda attrs, ins: ("default",)
+    get_op("_contrib_SparseEmbedding").stype_rule = \
+        lambda attrs, ins: ("default",)
+    # dot passes csr through structurally: dot(csr, dense) is dense
+    get_op("dot").stype_rule = lambda attrs, ins: ("default",)
+
+
+install_stype_rules()

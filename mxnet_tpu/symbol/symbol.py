@@ -326,6 +326,15 @@ class Symbol:
             kwargs = dict(zip(self.list_arguments(), args))
         return infer_types(self, kwargs)
 
+    def infer_storage_type(self, *args, **kwargs):
+        """Propagate {'default','row_sparse','csr'} tags through the
+        graph (reference Symbol.infer_storage_type); returns
+        (arg_stypes, out_stypes, aux_stypes)."""
+        from ..executor import infer_storage_types
+        if args:
+            kwargs = dict(zip(self.list_arguments(), args))
+        return infer_storage_types(self, kwargs)
+
     def simple_bind(self, ctx, grad_req="write", type_dict=None,
                     stype_dict=None, group2ctx=None, shared_arg_names=None,
                     shared_exec=None, shared_buffer=None, **kwargs):
