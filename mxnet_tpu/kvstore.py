@@ -101,6 +101,18 @@ class KVStore:
         keys, values = self._normalize_push(key, value)
         for k, vlist in zip(keys, values):
             merged = self._reduce(k, vlist)
+            stored = self._store[k]
+            if not isinstance(merged, RowSparseNDArray) and \
+                    not isinstance(stored, RowSparseNDArray):
+                # colocate: the updater must run where the stored value
+                # lives (executors may sit on a different device than the
+                # host-side arg_params the store was seeded from).  Only
+                # single-device stores move — a mesh-sharded entry keeps
+                # its sharding (gathering it would de-shard the param)
+                sdevs = stored._handle.devices()
+                if len(sdevs) == 1 and merged._handle.devices() != sdevs:
+                    merged = NDArray(jax.device_put(merged._handle,
+                                                    next(iter(sdevs))))
             if self._updater is not None:
                 self._updater(self._updater_key(k), merged, self._store[k])
             else:

@@ -48,10 +48,16 @@ def _create_kvstore(kvstore, num_device, arg_params):
 
 def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
                         update_on_kvstore):
-    """reference model.py:87"""
+    """reference model.py:87 — with one placement twist: seed the store
+    from the EXECUTOR's copy (same values as arg_params after
+    set_params) so kvstore updates run on the executor's device instead
+    of ping-ponging against host-side arg_params placed elsewhere."""
     for idx, param_on_devs in enumerate(param_arrays):
         name = param_names[idx]
-        kvstore.init(name, arg_params[name])
+        seed = param_on_devs[0] if param_on_devs else arg_params[name]
+        if getattr(seed, "stype", "default") != "default":
+            seed = arg_params[name]
+        kvstore.init(name, seed)
         if update_on_kvstore:
             kvstore.pull(name, param_on_devs, priority=-idx)
 

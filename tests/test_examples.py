@@ -16,6 +16,7 @@ EXAMPLES = [
     ("moe/switch_ffn.py", "switch_ffn example OK"),
     ("sparse/linear_classification.py",
      "sparse linear_classification example OK"),
+    ("sparse/symbolic_sparse_lr.py", "symbolic_sparse_lr example OK"),
     ("model_parallel/two_stage.py", "model_parallel two_stage example OK"),
     ("profiler/profile_mlp.py", "profile_mlp example OK"),
 ]
@@ -30,3 +31,51 @@ def test_example_runs(script, ok_line):
         capture_output=True, text=True, timeout=420, env=env, cwd=REPO)
     assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
     assert ok_line in r.stdout, r.stdout[-1000:]
+
+
+def test_train_imagenet_cli(tmp_path):
+    """The flagship CLI (reference example/image-classification/
+    train_imagenet.py + common/fit.py): one command trains through the
+    public API — model zoo symbol, ImageRecordIter (native pipeline when
+    built), kvstore, Speedometer, checkpoint + resume."""
+    import io as pyio
+
+    import numpy as np
+    from PIL import Image
+
+    from mxnet_tpu import recordio
+
+    rec = tmp_path / "train.rec"
+    w = recordio.MXIndexedRecordIO(str(tmp_path / "train.idx"), str(rec),
+                                   "w")
+    rs = np.random.RandomState(0)
+    for i in range(64):
+        arr = rs.randint(0, 256, (36, 36, 3), dtype=np.uint8)
+        buf = pyio.BytesIO()
+        Image.fromarray(arr).save(buf, format="JPEG")
+        w.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, float(i % 4), i, 0), buf.getvalue()))
+    w.close()
+
+    prefix = str(tmp_path / "ckpt" / "lenet")
+    (tmp_path / "ckpt").mkdir()
+    script = os.path.join(REPO, "example", "image_classification",
+                          "train_imagenet.py")
+    common = [sys.executable, script, "--data-train", str(rec),
+              "--network", "lenet", "--image-shape", "3,28,28",
+              "--num-classes", "4", "--num-examples", "64",
+              "--batch-size", "16", "--disp-batches", "2",
+              "--kv-store", "local", "--model-prefix", prefix]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(common + ["--num-epochs", "1"], env=env, cwd=REPO,
+                       capture_output=True, text=True, timeout=420)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert "train_imagenet OK" in r.stdout
+    assert os.path.isfile(prefix + "-0001.params")
+    # resume from the checkpoint
+    r2 = subprocess.run(common + ["--num-epochs", "2", "--load-epoch", "1"],
+                        env=env, cwd=REPO, capture_output=True, text=True,
+                        timeout=420)
+    assert r2.returncode == 0, r2.stdout[-2000:] + r2.stderr[-2000:]
+    assert "Resumed from" in r2.stderr + r2.stdout
+    assert os.path.isfile(prefix + "-0002.params")
