@@ -53,7 +53,73 @@ def _ensure_bench_rec(n_images, hw):
     return prefix
 
 
+def _transformer_main():
+    """BENCH_MODEL=transformer: decoder-only LM training tokens/sec —
+    the attention-path number of record (GPT-2-small-ish geometry by
+    default: 12 layers, 768 hidden, 12 heads, T=1024)."""
+    batch = int(os.environ.get("BENCH_BATCH", "8"))
+    seq_len = int(os.environ.get("BENCH_SEQ", "1024"))
+    layers = int(os.environ.get("BENCH_LAYERS", "12"))
+    hidden = int(os.environ.get("BENCH_HIDDEN", "768"))
+    heads = int(os.environ.get("BENCH_HEADS", "12"))
+    vocab = int(os.environ.get("BENCH_VOCAB", "32768"))
+    dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
+    warmup = int(os.environ.get("BENCH_WARMUP", "5"))
+    iters = int(os.environ.get("BENCH_ITERS", "30"))
+
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu  # noqa: F401
+    from mxnet_tpu.models.transformer import get_symbol
+    from mxnet_tpu.parallel.mesh import MeshSpec, make_mesh
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+    n_dev = len([d for d in jax.devices() if d.platform != "cpu"]) or 1
+    sym = get_symbol(vocab_size=vocab, seq_len=seq_len,
+                     num_layers=layers, hidden=hidden, heads=heads)
+    spec = MeshSpec(make_mesh((n_dev,), ("dp",)))
+    trainer = ShardedTrainer(sym, spec, lr=1e-4, momentum=0.9, wd=0.0,
+                             param_dtype=dtype if dtype != "float32" else None)
+    gb = batch * n_dev
+    shapes = {"data": (gb, seq_len), "softmax_label": (gb, seq_len)}
+    params, mom, aux = trainer.init_state(shapes)
+    if os.environ.get("BENCH_AUTO_LAYOUT", "1") != "0":
+        step, params, mom, aux = trainer.build_step_auto_layout(
+            params, mom, aux, shapes)
+    else:
+        from mxnet_tpu.parallel.trainer import sgd_step_fn
+        step = sgd_step_fn(trainer)
+    keys = trainer._keys()
+    key = jax.random.PRNGKey(0)
+    data = jax.device_put(
+        jax.random.randint(key, (gb, seq_len), 0, vocab)
+        .astype(jnp.float32), spec.batch_sharding())
+    label = jax.device_put(
+        jax.random.randint(key, (gb, seq_len), 0, vocab)
+        .astype(jnp.float32), spec.batch_sharding())
+    batch_dict = {"data": data, "softmax_label": label}
+    for _ in range(warmup):
+        params, mom, aux, loss = step(params, mom, aux, batch_dict, keys)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, mom, aux, loss = step(params, mom, aux, batch_dict, keys)
+    float(loss)
+    dt = time.perf_counter() - t0
+    tok_s = gb * seq_len * iters / dt / n_dev
+    print(json.dumps({
+        "metric": "transformer_train_tokens_per_sec_per_chip",
+        "value": round(tok_s, 2),
+        "unit": "tokens/sec/chip (L%d H%d T%d bs%d, %s)" % (
+            layers, hidden, seq_len, batch, dtype),
+        "vs_baseline": None,
+    }))
+
+
 def main():
+    if os.environ.get("BENCH_MODEL", "resnet50") == "transformer":
+        _transformer_main()
+        return
     batch = int(os.environ.get("BENCH_BATCH", "32"))
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
     warmup = int(os.environ.get("BENCH_WARMUP", "5"))
@@ -79,11 +145,18 @@ def main():
               "softmax_label": (global_batch,)}
     params, mom, aux = trainer.init_state(shapes)
 
-    from mxnet_tpu.parallel.trainer import sgd_step_fn
-    step = sgd_step_fn(trainer)
-    keys = trainer._keys()
-
     io_mode = os.environ.get("BENCH_IO", "0") == "1"
+    if os.environ.get("BENCH_AUTO_LAYOUT", "1") != "0":
+        # compiler-chosen parameter layouts: kills the per-step layout
+        # copies on NCHW/OIHW weights (see build_step_auto_layout).
+        # The AOT executable is dtype-exact: the IO path feeds uint8.
+        step, params, mom, aux = trainer.build_step_auto_layout(
+            params, mom, aux, shapes,
+            input_dtypes={"data": jnp.uint8} if io_mode else None)
+    else:
+        from mxnet_tpu.parallel.trainer import sgd_step_fn
+        step = sgd_step_fn(trainer)
+    keys = trainer._keys()
     if not io_mode:
         # data generated on device — the tunnel must not be in the loop
         key = jax.random.PRNGKey(0)

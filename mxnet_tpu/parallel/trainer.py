@@ -210,7 +210,8 @@ class ShardedTrainer:
         return tuple(params), mom, aux
 
     # -- the step ---------------------------------------------------------
-    def _build_step(self, donate=True):
+    def _make_step_fn(self):
+        """The raw (un-jitted) fused fwd+bwd+SGD step."""
         prog = self.prog
         param_idx = list(self.param_idx)
         input_idx = dict(self.input_idx)
@@ -238,27 +239,83 @@ class ShardedTrainer:
                 params, grads, mom, lr, momentum, wd, 1.0)
             return new_params, new_mom, new_aux, loss
 
+        return step_fn
+
+    def _state_shardings(self):
+        rep = self.spec.replicated()
+        return (self._param_shardings(), self._mom_shardings(),
+                tuple(rep for _ in self.prog.aux_names))
+
+    def _build_step(self, donate=True):
+        step_fn = self._make_step_fn()
         rep = self.spec.replicated()
         bat = self.spec.batch_sharding()
-        pshard = self._param_shardings()
-        mshard = self._mom_shardings()
+        pshard, mshard, ashard = self._state_shardings()
         in_shardings = (
             pshard,                                 # params (tp-aware)
             mshard,                                 # mom (ZeRO: +dp-sharded)
-            tuple(rep for _ in self.prog.aux_names),  # aux
+            ashard,                                 # aux
             {n: bat for n in self.input_names},     # batch
             rep,                                    # keys
         )
-        out_shardings = (
-            pshard,
-            mshard,
-            tuple(rep for _ in self.prog.aux_names),
-            rep,
-        )
+        out_shardings = (pshard, mshard, ashard, rep)
         with self.spec.mesh:
             return jax.jit(step_fn, in_shardings=in_shardings,
                            out_shardings=out_shardings,
                            donate_argnums=(0, 1, 2) if donate else ())
+
+    def build_step_auto_layout(self, params, mom, aux, batch_shapes,
+                               input_dtypes=None):
+        """Compile the step letting XLA pick the PARAMETER LAYOUTS, then
+        re-lay the state once to match; returns
+        (compiled_step, params, mom, aux).
+
+        Why: with NCHW/OIHW graphs the default (row-major) parameter
+        layout differs from the layout TPU convolutions want, and with
+        fixed input layouts + donation XLA inserts a layout-conversion
+        copy of EVERY conv weight and its momentum EVERY step (~250
+        copies/step on ResNet-50, measured via tools/hlo_diff.py — a
+        fixed ~2.5 ms/step tax at any batch size).  AUTO layouts let the
+        compiler store each parameter the way its consumers read it, so
+        the donated update aliases cleanly.  Batch inputs and rng keys
+        keep default layouts (they arrive fresh from the host each
+        step)."""
+        from jax.experimental.layout import Format, Layout
+
+        step_fn = self._make_step_fn()
+        rep = self.spec.replicated()
+        bat = self.spec.batch_sharding()
+        pshard, mshard, ashard = self._state_shardings()
+
+        def auto(shardings):
+            return tuple(Format(Layout.AUTO, s) for s in shardings)
+
+        in_shardings = (auto(pshard), auto(mshard), auto(ashard),
+                        {n: bat for n in self.input_names}, rep)
+        out_shardings = (auto(pshard), auto(mshard), auto(ashard), rep)
+
+        def sds(x):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+
+        # AOT-compiled executables are dtype-exact: callers feeding
+        # non-f32 batches (e.g. the uint8 RecordIO path) must say so
+        dts = input_dtypes or {}
+        inputs = {n: jax.ShapeDtypeStruct(tuple(batch_shapes[n]),
+                                          dts.get(n, jnp.float32))
+                  for n in self.input_names}
+        keys = self._keys()
+        with self.spec.mesh:
+            jitted = jax.jit(step_fn, in_shardings=in_shardings,
+                             out_shardings=out_shardings,
+                             donate_argnums=(0, 1, 2))
+            compiled = jitted.lower(
+                tuple(sds(p) for p in params), tuple(sds(m) for m in mom),
+                tuple(sds(a) for a in aux), inputs, sds(keys)).compile()
+        p_fmt, m_fmt, a_fmt = compiled.input_formats[0][:3]
+        params = tuple(jax.device_put(p, f) for p, f in zip(params, p_fmt))
+        mom = tuple(jax.device_put(m, f) for m, f in zip(mom, m_fmt))
+        aux = tuple(jax.device_put(a, f) for a, f in zip(aux, a_fmt))
+        return compiled, params, mom, aux
 
     def step(self, params, mom, aux, batch: Dict[str, np.ndarray]):
         """One synchronous data-parallel SGD step.  batch arrays are global

@@ -241,6 +241,7 @@ def test_sparse_embedding_trains_symbolically():
     feats = rs.randint(0, V, (N, A)).astype(np.float32)
     y = (table[feats.astype(int)].mean(1) @ proj > 0).astype(np.float32)
 
+    mx.random.seed(7)
     ids = sym.Variable("data")
     emb = sym.contrib.SparseEmbedding(data=ids,
                                       weight=sym.Variable("w"),
@@ -250,8 +251,8 @@ def test_sparse_embedding_trains_symbolically():
     mod = mx.mod.Module(net, context=mx.cpu())
     it = mx.io.NDArrayIter(feats, y, batch_size=16, shuffle=True,
                            label_name="softmax_label")
-    mod.fit(it, num_epoch=12,
-            optimizer_params={"learning_rate": 0.5},
+    mod.fit(it, num_epoch=20,
+            optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
             initializer=mx.init.Xavier(), force_init=True)
     it.reset()
     score = mod.score(it, mx.metric.Accuracy())

@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Measure Gluon DataLoader worker modes on a decode-bound dataset.
+
+Synthesizes JPEGs, then times one epoch of batches through:
+  workers=0 (sync), threads (thread_workers=True), processes (default).
+Prints one JSON line per mode.  This is the evidence for the
+multiprocess worker plane (reference gluon/data/dataloader.py:23 forks
+for the same reason: Python-level decode does not scale under the GIL).
+
+Usage: python tools/bench_dataloader.py [n_images] [num_workers]
+"""
+import io
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import numpy as np
+from PIL import Image
+
+from mxnet_tpu.gluon.data import DataLoader, Dataset
+
+
+class JpegDataset(Dataset):
+    """Decode-bound: every __getitem__ decodes + augments one JPEG."""
+
+    def __init__(self, n, hw=224):
+        rs = np.random.RandomState(0)
+        self._blobs = []
+        for _ in range(min(n, 64)):
+            arr = rs.randint(0, 256, (hw, hw, 3), dtype=np.uint8)
+            b = io.BytesIO()
+            Image.fromarray(arr).save(b, format="JPEG", quality=90)
+            self._blobs.append(b.getvalue())
+        self._n = n
+
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, idx):
+        img = np.asarray(Image.open(io.BytesIO(
+            self._blobs[idx % len(self._blobs)])), dtype=np.float32)
+        img = (img - 128.0) / 64.0          # numpy augment tail
+        return img.transpose(2, 0, 1), np.float32(idx % 10)
+
+
+def run(loader, label, n):
+    t0 = time.perf_counter()
+    seen = 0
+    for batch in loader:
+        seen += batch[0].shape[0]
+    dt = time.perf_counter() - t0
+    print(json.dumps({"metric": "dataloader_img_per_sec", "mode": label,
+                      "value": round(seen / dt, 1), "images": seen}))
+    return seen / dt
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 512
+    workers = int(sys.argv[2]) if len(sys.argv) > 2 else (os.cpu_count() or 4)
+    ds = JpegDataset(n)
+    batch = 32
+    run(DataLoader(ds, batch), "sync", n)
+    run(DataLoader(ds, batch, num_workers=workers, thread_workers=True),
+        "threads[%d]" % workers, n)
+    run(DataLoader(ds, batch, num_workers=workers),
+        "processes[%d]" % workers, n)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,226 @@
+#!/usr/bin/env python
+"""Hand-written pure-JAX ResNet-50 train step — the "ideal program"
+yardstick for bench.py (PERF.md).  No framework code: raw jax.numpy +
+lax convs in NHWC, bf16 params/activations with fp32 BN stats, fused
+fwd+bwd+SGD(momentum+wd) step with full buffer donation.  Methodology
+matches bench.py exactly: warmup, 100-iter chain, float(loss) sync.
+
+BENCH_ARCH=v2 (default) mirrors the framework bench's architecture
+EXACTLY (models/resnet.py: pre-activation v2, data-BN stem, eps=2e-5)
+so framework-vs-ideal deltas measure the framework, not the model;
+BENCH_ARCH=v1 keeps the classic post-activation network.
+
+Usage: python tools/bench_ideal.py            # bs32 bf16
+       BENCH_BATCH=128 python tools/bench_ideal.py
+Prints one JSON line {"metric": "resnet50_ideal_img_per_sec", ...}.
+BENCH_DUMP_HLO=/path.txt additionally dumps the optimized HLO.
+"""
+import functools
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+BOTTLENECK = [3, 4, 6, 3]
+WIDTHS = [256, 512, 1024, 2048]
+ARCH = os.environ.get("BENCH_ARCH", "v2")
+EPS = 2e-5 if ARCH == "v2" else 1e-5
+
+
+def conv(x, w, stride=1):
+    return lax.conv_general_dilated(
+        x, w, (stride, stride), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def bn(x, scale, bias, mean, var, momentum=0.9, eps=EPS, train=True):
+    """Returns (y, new_mean, new_var); stats in fp32."""
+    if train:
+        m = jnp.mean(x.astype(jnp.float32), axis=(0, 1, 2))
+        v = jnp.var(x.astype(jnp.float32), axis=(0, 1, 2))
+        new_mean = momentum * mean + (1 - momentum) * m
+        new_var = momentum * var + (1 - momentum) * v
+    else:
+        m, v, new_mean, new_var = mean, var, mean, var
+    inv = lax.rsqrt(v + eps) * scale
+    y = (x.astype(jnp.float32) - m) * inv + bias
+    return y.astype(x.dtype), new_mean, new_var
+
+
+def init_params(key, dtype=jnp.bfloat16):
+    params, stats = {}, {}
+    rngs = iter(jax.random.split(key, 200))
+
+    def conv_p(name, kh, kw, cin, cout):
+        fan = kh * kw * cin
+        params[name] = (jax.random.normal(next(rngs), (kh, kw, cin, cout),
+                                          jnp.float32)
+                        * np.sqrt(2.0 / fan)).astype(dtype)
+
+    def bn_p(name, c):
+        params[name + "_g"] = jnp.ones((c,), jnp.float32)
+        params[name + "_b"] = jnp.zeros((c,), jnp.float32)
+        stats[name + "_m"] = jnp.zeros((c,), jnp.float32)
+        stats[name + "_v"] = jnp.ones((c,), jnp.float32)
+
+    if ARCH == "v2":
+        bn_p("bn_data", 3)
+        conv_p("stem", 7, 7, 3, 64)
+        bn_p("bn0", 64)
+        cin = 64
+        for s, (n, w) in enumerate(zip(BOTTLENECK, WIDTHS)):
+            for u in range(n):
+                pre = "s%du%d" % (s, u)
+                mid = w // 4
+                bn_p(pre + "_bn1", cin)
+                conv_p(pre + "_c1", 1, 1, cin, mid)
+                bn_p(pre + "_bn2", mid)
+                conv_p(pre + "_c2", 3, 3, mid, mid)
+                bn_p(pre + "_bn3", mid)
+                conv_p(pre + "_c3", 1, 1, mid, w)
+                if u == 0:
+                    conv_p(pre + "_sc", 1, 1, cin, w)
+                cin = w
+        bn_p("bn1", 2048)
+    else:
+        conv_p("stem", 7, 7, 3, 64)
+        bn_p("stem_bn", 64)
+        cin = 64
+        for s, (n, w) in enumerate(zip(BOTTLENECK, WIDTHS)):
+            for u in range(n):
+                pre = "s%du%d" % (s, u)
+                mid = w // 4
+                conv_p(pre + "_c1", 1, 1, cin, mid)
+                bn_p(pre + "_bn1", mid)
+                conv_p(pre + "_c2", 3, 3, mid, mid)
+                bn_p(pre + "_bn2", mid)
+                conv_p(pre + "_c3", 1, 1, mid, w)
+                bn_p(pre + "_bn3", w)
+                if u == 0:
+                    conv_p(pre + "_sc", 1, 1, cin, w)
+                    bn_p(pre + "_scbn", w)
+                cin = w
+    params["fc_w"] = (jax.random.normal(next(rngs), (2048, 1000), jnp.float32)
+                      * 0.01).astype(dtype)
+    params["fc_b"] = jnp.zeros((1000,), jnp.float32)
+    return params, stats
+
+
+def forward(params, stats, x, train=True):
+    new_stats = {}
+
+    def run_bn(name, x, fix_gamma=False):
+        g = (jnp.ones_like(params[name + "_g"]) if fix_gamma
+             else params[name + "_g"])
+        y, m, v = bn(x, g, params[name + "_b"],
+                     stats[name + "_m"], stats[name + "_v"], train=train)
+        new_stats[name + "_m"], new_stats[name + "_v"] = m, v
+        return y
+
+    if ARCH == "v2":
+        # mirror models/resnet.py resnet(): Cast(bf16) then pre-act v2
+        x = x.astype(jnp.bfloat16)
+        x = run_bn("bn_data", x, fix_gamma=True)
+        x = conv(x, params["stem"], 2)
+        x = jax.nn.relu(run_bn("bn0", x))
+        x = lax.reduce_window(x, -jnp.inf, lax.max, (1, 3, 3, 1),
+                              (1, 2, 2, 1), "SAME")
+        for s, (n, w) in enumerate(zip(BOTTLENECK, WIDTHS)):
+            for u in range(n):
+                pre = "s%du%d" % (s, u)
+                stride = 2 if (u == 0 and s > 0) else 1
+                act1 = jax.nn.relu(run_bn(pre + "_bn1", x))
+                y = conv(act1, params[pre + "_c1"])
+                y = jax.nn.relu(run_bn(pre + "_bn2", y))
+                y = conv(y, params[pre + "_c2"], stride)
+                y = jax.nn.relu(run_bn(pre + "_bn3", y))
+                y = conv(y, params[pre + "_c3"])
+                sc = x if u != 0 else conv(act1, params[pre + "_sc"], stride)
+                x = y + sc
+        x = jax.nn.relu(run_bn("bn1", x))
+        x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+        logits = x @ params["fc_w"].astype(jnp.float32) + params["fc_b"]
+        return logits, new_stats
+
+    x = conv(x, params["stem"], 2)
+    x = jax.nn.relu(run_bn("stem_bn", x))
+    x = lax.reduce_window(x, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
+                          "SAME")
+    cin = 64
+    for s, (n, w) in enumerate(zip(BOTTLENECK, WIDTHS)):
+        for u in range(n):
+            pre = "s%du%d" % (s, u)
+            stride = 2 if (u == 0 and s > 0) else 1
+            y = jax.nn.relu(run_bn(pre + "_bn1",
+                                   conv(x, params[pre + "_c1"], stride)))
+            y = jax.nn.relu(run_bn(pre + "_bn2", conv(y, params[pre + "_c2"])))
+            y = run_bn(pre + "_bn3", conv(y, params[pre + "_c3"]))
+            if u == 0:
+                x = run_bn(pre + "_scbn", conv(x, params[pre + "_sc"], stride))
+            x = jax.nn.relu(x + y)
+            cin = w
+    x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+    logits = x @ params["fc_w"].astype(jnp.float32) + params["fc_b"]
+    return logits, new_stats
+
+
+def loss_fn(params, stats, x, labels):
+    logits, new_stats = forward(params, stats, x)
+    logp = jax.nn.log_softmax(logits)
+    loss = -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
+    return loss, new_stats
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+def train_step(params, mom, stats, x, labels):
+    (loss, new_stats), grads = jax.value_and_grad(
+        loss_fn, has_aux=True)(params, stats, x, labels)
+    lr, mu, wd = 0.1, 0.9, 1e-4
+    new_p, new_m = {}, {}
+    for k, p in params.items():
+        g = grads[k].astype(jnp.float32) + wd * p.astype(jnp.float32)
+        m = mu * mom[k] + g
+        new_m[k] = m
+        new_p[k] = (p.astype(jnp.float32) - lr * m).astype(p.dtype)
+    return new_p, new_m, new_stats, loss
+
+
+def main():
+    batch = int(os.environ.get("BENCH_BATCH", "32"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "5"))
+    iters = int(os.environ.get("BENCH_ITERS", "100"))
+    key = jax.random.PRNGKey(0)
+    params, stats = init_params(key)
+    mom = {k: jnp.zeros(v.shape, jnp.float32) for k, v in params.items()}
+    # v2 parity: the framework feeds f32 and casts in-graph
+    x_dtype = jnp.float32 if ARCH == "v2" else jnp.bfloat16
+    x = jax.random.uniform(key, (batch, 224, 224, 3), x_dtype)
+    labels = jax.random.randint(key, (batch,), 0, 1000)
+
+    dump = os.environ.get("BENCH_DUMP_HLO")
+    if dump:
+        txt = train_step.lower(params, mom, stats, x, labels) \
+            .compile().as_text()
+        open(dump, "w").write(txt)
+
+    for _ in range(warmup):
+        params, mom, stats, loss = train_step(params, mom, stats, x, labels)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, mom, stats, loss = train_step(params, mom, stats, x, labels)
+    float(loss)
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "resnet50_ideal_img_per_sec",
+        "value": round(batch * iters / dt, 2),
+        "unit": "images/sec (bs%d, bf16, pure-JAX NHWC, arch=%s)"
+                % (batch, ARCH)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Dump + compare the optimized HLO of the framework train step
+(bench.py's exact program) vs the hand-written ideal
+(tools/bench_ideal.py).  Prints per-program op histograms and their
+diff — the evidence base for PERF.md's framework-vs-ideal analysis.
+
+Usage: python tools/hlo_diff.py [batch]
+Writes /tmp/hlo_framework_bs{N}.txt (the ideal dump comes from
+BENCH_DUMP_HLO in bench_ideal.py).
+"""
+import collections
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def histogram(path):
+    ops = collections.Counter()
+    for line in open(path):
+        m = re.match(r"\s*(?:ROOT )?%?[\w.\-]+ = \S+ ([a-z][\w\-]*)\(", line)
+        if m:
+            ops[m.group(1)] += 1
+    return ops
+
+
+def dump_framework(batch):
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu  # noqa: F401
+    from mxnet_tpu.models.resnet import get_symbol
+    from mxnet_tpu.parallel.mesh import MeshSpec, make_mesh
+    from mxnet_tpu.parallel.trainer import ShardedTrainer, sgd_step_fn
+
+    sym = get_symbol(num_classes=1000, num_layers=50,
+                     image_shape="3,224,224", dtype="bfloat16")
+    spec = MeshSpec(make_mesh((1,), ("dp",)))
+    trainer = ShardedTrainer(sym, spec, lr=0.1, momentum=0.9, wd=1e-4,
+                             param_dtype="bfloat16")
+    shapes = {"data": (batch, 3, 224, 224), "softmax_label": (batch,)}
+    params, mom, aux = trainer.init_state(shapes)
+    step = sgd_step_fn(trainer)
+    keys = trainer._keys()
+    data = jnp.zeros((batch, 3, 224, 224), jnp.float32)
+    label = jnp.zeros((batch,), jnp.float32)
+    lowered = step.lower(params, mom, aux,
+                         {"data": data, "softmax_label": label}, keys)
+    txt = lowered.compile().as_text()
+    path = "/tmp/hlo_framework_bs%d.txt" % batch
+    open(path, "w").write(txt)
+    return path
+
+
+def main():
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 32
+    fw = dump_framework(batch)
+    ideal = "/tmp/hlo_ideal_bs%d.txt" % batch
+    hf, hi = histogram(fw), histogram(ideal)
+    print("%-28s %10s %10s %8s" % ("op", "framework", "ideal", "delta"))
+    for op in sorted(set(hf) | set(hi),
+                     key=lambda o: -(hf[o] + hi[o])):
+        if hf[op] or hi[op]:
+            print("%-28s %10d %10d %+8d"
+                  % (op, hf[op], hi[op], hf[op] - hi[op]))
+    nf = sum(open(fw).read().count("\n") for _ in [0])
+    print("\ntotal lines: framework=%d ideal=%d"
+          % (nf, len(open(ideal).read().splitlines())))
+
+
+if __name__ == "__main__":
+    main()
