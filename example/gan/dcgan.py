@@ -1,0 +1,103 @@
+"""DCGAN on synthetic images (reference example/gan/dcgan.py role):
+adversarial training through the Gluon API — two networks, two
+trainers, alternating updates — shrunk to a CI-sized workload.
+
+Run: python example/gan/dcgan.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+HW, NZ = 16, 16
+
+
+def generator():
+    net = nn.HybridSequential(prefix="gen_")
+    with net.name_scope():
+        # z (N, NZ, 1, 1) -> (N, 1, 16, 16)
+        net.add(nn.Conv2DTranspose(32, 4, 1, 0, use_bias=False))   # 4x4
+        net.add(nn.BatchNorm())
+        net.add(nn.Activation("relu"))
+        net.add(nn.Conv2DTranspose(16, 4, 2, 1, use_bias=False))   # 8x8
+        net.add(nn.BatchNorm())
+        net.add(nn.Activation("relu"))
+        net.add(nn.Conv2DTranspose(1, 4, 2, 1, use_bias=False))    # 16x16
+        net.add(nn.Activation("tanh"))
+    return net
+
+
+def discriminator():
+    net = nn.HybridSequential(prefix="disc_")
+    with net.name_scope():
+        net.add(nn.Conv2D(16, 4, 2, 1, use_bias=False))            # 8x8
+        net.add(nn.LeakyReLU(0.2))
+        net.add(nn.Conv2D(32, 4, 2, 1, use_bias=False))            # 4x4
+        net.add(nn.BatchNorm())
+        net.add(nn.LeakyReLU(0.2))
+        net.add(nn.Conv2D(1, 4, 1, 0, use_bias=False))             # 1x1
+    return net
+
+
+def real_batch(rs, n):
+    """'Real' data: soft blobs with a fixed orientation the G must learn."""
+    yy, xx = np.mgrid[0:HW, 0:HW] / (HW - 1.0)
+    imgs = []
+    for _ in range(n):
+        cx, cy = rs.uniform(0.3, 0.7, 2)
+        img = np.exp(-(((xx - cx) ** 2 + (yy - cy) ** 2) / 0.02))
+        imgs.append(img * 2 - 1)
+    return nd.array(np.stack(imgs)[:, None].astype(np.float32))
+
+
+def main():
+    mx.random.seed(42)
+    rs = np.random.RandomState(42)
+    G, D = generator(), discriminator()
+    G.initialize(mx.init.Normal(0.02))
+    D.initialize(mx.init.Normal(0.02))
+    gt = gluon.Trainer(G.collect_params(), "adam",
+                       {"learning_rate": 2e-4, "beta1": 0.5})
+    dt = gluon.Trainer(D.collect_params(), "adam",
+                       {"learning_rate": 2e-4, "beta1": 0.5})
+    loss_fn = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+
+    batch = 16
+    d_hist, g_hist = [], []
+    for it in range(20):
+        real = real_batch(rs, batch)
+        z = nd.random.normal(shape=(batch, NZ, 1, 1))
+        # D step: real -> 1, fake -> 0
+        with autograd.record():
+            out_r = D(real).reshape((-1,))
+            out_f = D(G(z).detach()).reshape((-1,))
+            d_loss = (loss_fn(out_r, nd.ones(batch)) +
+                      loss_fn(out_f, nd.zeros(batch)))
+        d_loss.backward()
+        dt.step(batch)
+        # G step: fool D
+        with autograd.record():
+            out = D(G(z)).reshape((-1,))
+            g_loss = loss_fn(out, nd.ones(batch))
+        g_loss.backward()
+        gt.step(batch)
+        d_hist.append(float(d_loss.mean().asnumpy()))
+        g_hist.append(float(g_loss.mean().asnumpy()))
+    print("D loss %.3f -> %.3f | G loss %.3f -> %.3f"
+          % (d_hist[0], d_hist[-1], g_hist[0], g_hist[-1]))
+    assert np.isfinite(d_hist).all() and np.isfinite(g_hist).all()
+    # the discriminator must have learned SOMETHING against a frozen-
+    # then-updated generator: its loss moves off the initial value
+    assert abs(d_hist[-1] - d_hist[0]) > 1e-3
+    print("dcgan example OK")
+
+
+if __name__ == "__main__":
+    main()

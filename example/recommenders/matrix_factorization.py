@@ -1,0 +1,60 @@
+"""Matrix-factorization recommender (reference
+example/recommenders/demo1-MF.ipynb role): user/item embeddings whose
+dot product predicts ratings, trained symbolically with Module on a
+synthetic low-rank ratings matrix.
+
+Run: python example/recommenders/matrix_factorization.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+def build_net(n_users, n_items, k):
+    user = sym.Variable("user")
+    item = sym.Variable("item")
+    score = sym.Variable("score_label")
+    u = sym.Embedding(user, input_dim=n_users, output_dim=k, name="user_emb")
+    v = sym.Embedding(item, input_dim=n_items, output_dim=k, name="item_emb")
+    pred = sym.sum(u * v, axis=1)
+    return sym.LinearRegressionOutput(pred, score, name="score")
+
+
+def main():
+    mx.random.seed(3)
+    rs = np.random.RandomState(3)
+    n_users, n_items, k, n_obs = 200, 120, 8, 4096
+    # ground-truth low-rank structure
+    U = rs.normal(0, 1, (n_users, k)).astype(np.float32)
+    V = rs.normal(0, 1, (n_items, k)).astype(np.float32)
+    users = rs.randint(0, n_users, n_obs).astype(np.float32)
+    items = rs.randint(0, n_items, n_obs).astype(np.float32)
+    scores = (U[users.astype(int)] * V[items.astype(int)]).sum(1) \
+        + rs.normal(0, 0.1, n_obs).astype(np.float32)
+
+    it = mx.io.NDArrayIter({"user": users, "item": items},
+                           {"score_label": scores},
+                           batch_size=256, shuffle=True)
+    mod = mx.mod.Module(build_net(n_users, n_items, k),
+                        data_names=("user", "item"),
+                        label_names=("score_label",),
+                        context=mx.cpu())
+    mod.fit(it, num_epoch=30, optimizer="adam",
+            optimizer_params={"learning_rate": 0.05},
+            initializer=mx.init.Normal(0.1),
+            eval_metric=mx.metric.RMSE())
+    rmse = dict(mod.score(it, mx.metric.RMSE()))["rmse"]
+    print("final RMSE: %.3f" % rmse)
+    assert rmse < 1.0, rmse        # var(scores) ~ k = 8, so 1.0 is learned
+    print("matrix_factorization example OK")
+
+
+if __name__ == "__main__":
+    main()

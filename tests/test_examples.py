@@ -19,6 +19,10 @@ EXAMPLES = [
     ("sparse/symbolic_sparse_lr.py", "symbolic_sparse_lr example OK"),
     ("model_parallel/two_stage.py", "model_parallel two_stage example OK"),
     ("profiler/profile_mlp.py", "profile_mlp example OK"),
+    ("gan/dcgan.py", "dcgan example OK"),
+    ("recommenders/matrix_factorization.py",
+     "matrix_factorization example OK"),
+    ("detection/train_ssd_toy.py", "train_ssd_toy example OK"),
 ]
 
 
