@@ -662,11 +662,9 @@ def _contrib_fused_attention(attrs, q, k, v):
     @jax.custom_vjp
     def attn(q, k, v):
         from .pallas_kernels import fused_attention
-        bq = block_q
-        while q.shape[1] % bq:
-            bq //= 2   # clamp to a divisor of T
+        # fused_attention clamps block_q/block_k to divisors of T itself
         return fused_attention(q, k, v, causal=causal, scale=scale,
-                               block_q=max(bq, 1))
+                               block_q=block_q)
 
     def fwd(q, k, v):
         return attn(q, k, v), (q, k, v)

@@ -8,12 +8,15 @@ import pytest
 from mxnet_tpu.ops.pallas_kernels import fused_attention, two_bit_compress
 
 
-def test_two_bit_compress_matches_formula():
+@pytest.mark.parametrize("use_pallas", [False, True],
+                         ids=["xla", "pallas-kernel"])
+def test_two_bit_compress_matches_formula(use_pallas):
     rs = np.random.RandomState(0)
     for shape in [(7,), (33, 5), (2, 3, 4)]:
         g = jnp.asarray(rs.normal(0, 1, shape).astype(np.float32))
         r = jnp.asarray(rs.normal(0, 0.3, shape).astype(np.float32))
-        q, nr = two_bit_compress(g, r, threshold=0.5)
+        q, nr = two_bit_compress(g, r, threshold=0.5,
+                                 use_pallas=use_pallas)
         comp = np.asarray(g) + np.asarray(r)
         want_q = np.where(comp >= 0.5, 0.5, np.where(comp <= -0.5, -0.5, 0.0))
         np.testing.assert_allclose(np.asarray(q), want_q, atol=1e-6)
@@ -61,13 +64,19 @@ def _naive_attention(q, k, v, causal=False, scale=None):
 
 
 @pytest.mark.parametrize("causal", [False, True])
-def test_fused_attention_matches_naive(causal):
+@pytest.mark.parametrize("block_k", [512, 8],
+                         ids=["one-k-block", "multi-k-block"])
+def test_fused_attention_matches_naive(causal, block_k):
+    """block_k=8 forces nk=4: the online-softmax carry (running max/sum
+    renormalization across k blocks, causal block skipping) is on the
+    line, not just the single-block degenerate path."""
     rs = np.random.RandomState(1)
     B, T, H, D = 2, 32, 2, 16
     q = jnp.asarray(rs.normal(0, 1, (B, T, H, D)).astype(np.float32))
     k = jnp.asarray(rs.normal(0, 1, (B, T, H, D)).astype(np.float32))
     v = jnp.asarray(rs.normal(0, 1, (B, T, H, D)).astype(np.float32))
-    out = fused_attention(q, k, v, causal=causal, block_q=16)
+    out = fused_attention(q, k, v, causal=causal, block_q=16,
+                          block_k=block_k)
     want = _naive_attention(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(out), np.asarray(want),
                                rtol=1e-4, atol=1e-5)

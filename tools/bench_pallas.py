@@ -67,11 +67,11 @@ def main():
                                               two_bit_compress)
     key = jax.random.PRNGKey(0)
     B, H, D = 1, 8, 64
-    for T in (1024, 2048, 4096):
+    scale = 1.0 / float(np.sqrt(D))
+    for T in (1024, 2048, 4096, 8192, 16384):
         q = jax.random.normal(key, (B, T, H, D), jnp.bfloat16)
         k = jax.random.normal(key, (B, T, H, D), jnp.bfloat16)
         v = jax.random.normal(key, (B, T, H, D), jnp.bfloat16)
-        scale = 1.0 / float(np.sqrt(D))
         t_pallas = timed(jax.jit(functools.partial(
             fused_attention, causal=True)), (q, k, v))
         t_naive = timed(jax.jit(functools.partial(
@@ -81,12 +81,26 @@ def main():
             "pallas": round(t_pallas * 1e3, 3),
             "xla_naive": round(t_naive * 1e3, 3),
             "speedup": round(t_naive / t_pallas, 2)}))
+    # reach probe: the flash kernel is HBM-bound, the naive program
+    # needs the full (T, T) scores
+    T = 32768
+    q = jax.random.normal(key, (B, T, H, D), jnp.bfloat16)
+    t_pallas = timed(jax.jit(functools.partial(
+        fused_attention, causal=True)), (q, q, q), iters=10)
+    try:
+        t_naive = round(timed(jax.jit(functools.partial(
+            naive_attention, scale=scale)), (q, q, q), iters=10) * 1e3, 3)
+    except Exception as e:
+        t_naive = "FAILS (%s)" % type(e).__name__
+    print(json.dumps({"metric": "attention_ms", "T": T,
+                      "pallas": round(t_pallas * 1e3, 3),
+                      "xla_naive": t_naive}))
 
     n = 25_600_000
     g = jax.random.normal(key, (n,), jnp.float32)
     r = jnp.zeros((n,), jnp.float32)
-    t_pallas = timed(jax.jit(lambda g, r: two_bit_compress(g, r, 0.5)),
-                     (g, r))
+    t_pallas = timed(jax.jit(lambda g, r: two_bit_compress(
+        g, r, 0.5, use_pallas=True)), (g, r))
     t_xla = timed(jax.jit(lambda g, r: two_pass_two_bit(g, r, 0.5)), (g, r))
     print(json.dumps({
         "metric": "two_bit_compress_ms", "elements": n,
