@@ -38,9 +38,11 @@ def parse_args():
         formatter_class=argparse.ArgumentDefaultsHelpFormatter)
     d = p.add_argument
     d("--network", default="resnet",
-      help="model family: resnet | vgg | alexnet | mlp | lenet")
+      help="model family: resnet | resnet_v1 | resnext | mobilenet | "
+           "googlenet | vgg | alexnet | mlp | lenet")
     d("--num-layers", type=int, default=50,
-      help="depth for depth-parameterised families (resnet/vgg)")
+      help="depth for depth-parameterised families "
+           "(resnet/resnet_v1/resnext/vgg)")
     d("--num-classes", type=int, default=1000)
     d("--image-shape", default="3,224,224")
     d("--dtype", default="float32",
@@ -91,6 +93,16 @@ def get_network(args):
         return models.resnet.get_symbol(
             num_layers=args.num_layers, image_shape=args.image_shape, **kw), \
             shape
+    if fam == "resnet_v1":
+        return models.resnet_v1.get_symbol(num_layers=args.num_layers,
+                                           **kw), shape
+    if fam == "resnext":
+        return models.resnext.get_symbol(num_layers=args.num_layers,
+                                         **kw), shape
+    if fam == "mobilenet":
+        return models.mobilenet.get_symbol(**kw), shape
+    if fam == "googlenet":
+        return models.googlenet.get_symbol(**kw), shape
     if fam == "vgg":
         return models.vgg.get_symbol(num_layers=args.num_layers, **kw), shape
     if fam == "alexnet":

@@ -1,9 +1,13 @@
 """Model zoo (symbol builders) — reference example/image-classification/symbols/."""
 from . import resnet
+from . import resnet_v1
+from . import resnext
 from . import lenet
 from . import mlp
 from . import alexnet
 from . import vgg
+from . import mobilenet
+from . import googlenet
 from . import transformer
 
 get_resnet = resnet.get_symbol

@@ -1,0 +1,43 @@
+"""Shape checks for every symbolic model family (reference keeps
+example/image-classification/symbols/ working via the train scripts;
+here each builder is pinned directly)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+
+@pytest.mark.parametrize("family,kwargs", [
+    ("resnet", dict(num_layers=50)),
+    ("resnet_v1", dict(num_layers=18)),
+    ("resnext", dict(num_layers=50, cardinality=4, bottleneck_width=4)),
+    ("mobilenet", dict(multiplier=0.25)),
+    ("googlenet", {}),
+    ("alexnet", {}),
+    ("vgg", dict(num_layers=11)),
+])
+def test_symbol_family_output_shape(family, kwargs):
+    net = getattr(models, family).get_symbol(num_classes=13, **kwargs)
+    hw = 224
+    _, out_shapes, _ = net.infer_shape(data=(2, 3, hw, hw),
+                                       softmax_label=(2,))
+    assert out_shapes[0] == (2, 13), (family, out_shapes)
+
+
+def test_small_families_forward():
+    """The cheap families also execute end-to-end."""
+    for family, kwargs, hw in [("mobilenet", dict(multiplier=0.25), 64),
+                               ("resnet_v1", dict(num_layers=18), 64)]:
+        net = getattr(models, family).get_symbol(num_classes=5, **kwargs)
+        ex = net.simple_bind(mx.cpu(), data=(2, 3, hw, hw),
+                             softmax_label=(2,))
+        for name, arr in ex.arg_dict.items():
+            if name not in ("data", "softmax_label"):
+                arr[:] = np.random.RandomState(0).normal(
+                    0, 0.05, arr.shape).astype(np.float32)
+        ex.arg_dict["data"][:] = np.random.rand(2, 3, hw, hw)
+        ex.arg_dict["softmax_label"][:] = np.array([1.0, 3.0])
+        out = ex.forward()[0].asnumpy()
+        assert out.shape == (2, 5)
+        np.testing.assert_allclose(out.sum(1), 1.0, rtol=1e-4)
