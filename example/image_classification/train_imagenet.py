@@ -39,7 +39,7 @@ def parse_args():
     d = p.add_argument
     d("--network", default="resnet",
       help="model family: resnet | resnet_v1 | resnext | mobilenet | "
-           "googlenet | vgg | alexnet | mlp | lenet")
+           "googlenet | inception_v4 | vgg | alexnet | mlp | lenet")
     d("--num-layers", type=int, default=50,
       help="depth for depth-parameterised families "
            "(resnet/resnet_v1/resnext/vgg)")
@@ -103,6 +103,8 @@ def get_network(args):
         return models.mobilenet.get_symbol(**kw), shape
     if fam == "googlenet":
         return models.googlenet.get_symbol(**kw), shape
+    if fam == "inception_v4":
+        return models.inception_v4.get_symbol(**kw), shape
     if fam == "vgg":
         return models.vgg.get_symbol(num_layers=args.num_layers, **kw), shape
     if fam == "alexnet":

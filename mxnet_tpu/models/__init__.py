@@ -8,6 +8,7 @@ from . import alexnet
 from . import vgg
 from . import mobilenet
 from . import googlenet
+from . import inception_v4
 from . import transformer
 
 get_resnet = resnet.get_symbol

@@ -14,6 +14,7 @@ from mxnet_tpu import models
     ("resnext", dict(num_layers=50, cardinality=4, bottleneck_width=4)),
     ("mobilenet", dict(multiplier=0.25)),
     ("googlenet", {}),
+    ("inception_v4", {}),
     ("alexnet", {}),
     ("vgg", dict(num_layers=11)),
 ])
