@@ -1,0 +1,31 @@
+"""CIFAR-10 training CLI (reference
+example/image-classification/train_cifar10.py): the same engine as
+train_imagenet.py with the CIFAR presets — 3x28x28 crops, 10 classes,
+the resnet builder's CIFAR stage layout (models/resnet.py selects it
+for heights <= 28), and the reference's lr schedule.
+
+Run: python example/image_classification/train_cifar10.py \
+        --data-train cifar10_train.rec [--num-layers 110]
+     (or --benchmark 1 for synthetic data)
+"""
+import sys
+
+import train_imagenet
+
+
+def main():
+    presets = [
+        ("--num-classes", "10"), ("--image-shape", "3,28,28"),
+        ("--num-examples", "50000"), ("--lr-step-epochs", "200,250"),
+        ("--num-epochs", "300"), ("--lr", "0.05"),
+        ("--batch-size", "128"), ("--num-layers", "110"),
+    ]
+    # presets go FIRST so any user-supplied value (either `--flag v` or
+    # `--flag=v` form) wins under argparse's last-occurrence rule
+    preset_args = [tok for pair in presets for tok in pair]
+    sys.argv = [sys.argv[0]] + preset_args + sys.argv[1:]
+    train_imagenet.main()
+
+
+if __name__ == "__main__":
+    main()

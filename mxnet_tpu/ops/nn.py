@@ -79,7 +79,8 @@ def _conv_nd(attrs, x):
     return nd, stride, dilate, [(p, p) for p in pad]
 
 
-@register("Convolution", inputs=_conv_inputs, params=dict(_CONV_PARAMS))
+@register("Convolution", inputs=_conv_inputs, params=dict(_CONV_PARAMS),
+          aliases=("Convolution_v1",))
 def _convolution(attrs, x, w, bias=None):
     """NC(D)HW activations, OIHW weights (reference convolution-inl.h)."""
     nd, stride, dilate, pad = _conv_nd(attrs, x)
