@@ -203,7 +203,13 @@ def main():
     mon = mx.mon.Monitor(args.monitor, pattern=".*weight") \
         if args.monitor > 0 else None
 
-    mod = mx.mod.Module(net, context=mx.context.current_context())
+    # train on the accelerator when one exists (the reference's --gpus
+    # analog; mxnet's default context is cpu, which would silently run
+    # the model on the host)
+    ctx = mx.tpu() if mx.context.num_tpus() > 0 else \
+        mx.context.current_context()
+    logging.info("training on %s", ctx)
+    mod = mx.mod.Module(net, context=ctx)
     mod.fit(train, eval_data=val,
             eval_metric=mx.metric.CompositeEvalMetric(metrics),
             kvstore=kv, optimizer=args.optimizer,

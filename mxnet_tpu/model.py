@@ -75,7 +75,10 @@ def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore, param_names):
 
 def _update_params(param_arrays, grad_arrays, updater, num_device,
                    kvstore=None, param_names=None):
-    """reference model.py:138 — reduce via kvstore, update locally."""
+    """reference model.py:138 — reduce via kvstore, update locally.
+    The local updates go through Updater.update_batch: plain dense SGD
+    collapses into ONE compiled program per device instead of one
+    dispatch per parameter (the reference's multi_sgd aggregation)."""
     updates = [[] for _ in range(num_device)]
     for i, pair in enumerate(zip(param_arrays, grad_arrays)):
         arg_list, grad_list = pair
@@ -90,8 +93,11 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
             w, g = p
             updates[k].append((index * num_device + k, g, w))
     for dev_updates in updates:
-        for index, g, w in dev_updates:
-            updater(index, g, w)
+        if hasattr(updater, "update_batch"):
+            updater.update_batch(dev_updates)
+        else:   # user-supplied bare callable (kvstore _set_updater style)
+            for index, g, w in dev_updates:
+                updater(index, g, w)
 
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):

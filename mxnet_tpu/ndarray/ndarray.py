@@ -530,11 +530,15 @@ def empty(shape, ctx=None, dtype=None) -> NDArray:
 
 
 def zeros(shape, ctx=None, dtype=None, **kwargs) -> NDArray:
+    # host-side fill + device_put, NOT jnp.zeros: an eager creation op
+    # must never cost an XLA compile (a bound ResNet allocates ~160
+    # distinct shapes; on remote-compile setups each jnp.zeros would be
+    # a multi-second compile RTT)
     if isinstance(shape, int):
         shape = (shape,)
     dt = dtype_np(dtype or "float32")
     ctx = ctx or current_context()
-    return NDArray(_put(jnp.zeros(shape, dt), ctx), ctx)
+    return NDArray(_put(np.zeros(shape, dt), ctx), ctx)
 
 
 def ones(shape, ctx=None, dtype=None, **kwargs) -> NDArray:
@@ -542,7 +546,7 @@ def ones(shape, ctx=None, dtype=None, **kwargs) -> NDArray:
         shape = (shape,)
     dt = dtype_np(dtype or "float32")
     ctx = ctx or current_context()
-    return NDArray(_put(jnp.ones(shape, dt), ctx), ctx)
+    return NDArray(_put(np.ones(shape, dt), ctx), ctx)
 
 
 def full(shape, val, ctx=None, dtype=None, out=None) -> NDArray:
@@ -550,7 +554,7 @@ def full(shape, val, ctx=None, dtype=None, out=None) -> NDArray:
         shape = (shape,)
     dt = dtype_np(dtype or "float32")
     ctx = ctx or current_context()
-    nd = NDArray(_put(jnp.full(shape, val, dt), ctx), ctx)
+    nd = NDArray(_put(np.full(shape, val, dt), ctx), ctx)
     if out is not None:
         out._handle = nd._handle
         return out

@@ -629,6 +629,37 @@ class Test(Optimizer):
 create = Optimizer.create_optimizer
 
 
+def _fused_sgd_program(momentum_on, clip):
+    """One jitted program updating a whole TUPLE of (w, g, m) triples —
+    the aggregation the reference gets from multi_sgd_mom_update
+    (optimizer_op.cc multi-tensor kernels): ~3 dispatches per STEP
+    instead of ~3 per PARAMETER.  Math mirrors sgd_update/
+    sgd_mom_update exactly; lr/wd/rescale/momentum ride as traced
+    scalars so schedulers don't retrace."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(ws, gs, ms, lrs, wds, rescale, momentum):
+        new_ws, new_ms = [], []
+        for w, g, m, lr, wd in zip(ws, gs, ms, lrs, wds):
+            g = g * rescale
+            if clip > 0:
+                g = jnp.clip(g, -clip, clip)
+            if momentum_on:
+                nm = momentum * m - lr * (g + wd * w)
+                new_ws.append(w + nm)
+                new_ms.append(nm)
+            else:
+                new_ws.append(w - lr * (g + wd * w))
+                new_ms.append(None)
+        return tuple(new_ws), tuple(new_ms)
+
+    return run
+
+
 class Updater:
     """Closure applying an optimizer, used by kvstore (reference
     optimizer.py get_updater)."""
@@ -637,6 +668,7 @@ class Updater:
         self.optimizer = optimizer
         self.states = {}
         self.states_synced = {}
+        self._fused_cache = {}
 
     def __call__(self, index, grad, weight):
         if index not in self.states:
@@ -645,6 +677,56 @@ class Updater:
             self.states_synced[index] = True
         self.optimizer.update_multi_precision(index, weight, grad,
                                               self.states[index])
+
+    # -- fused whole-step path --------------------------------------------
+
+    def _fusable(self, triples):
+        opt = self.optimizer
+        if type(opt) is not SGD or opt.multi_precision:
+            return False
+        from .ndarray.sparse import BaseSparseNDArray
+        return not any(isinstance(g, BaseSparseNDArray)
+                       or isinstance(w, BaseSparseNDArray)
+                       for _, g, w in triples)
+
+    def update_batch(self, triples):
+        """Apply the optimizer to every (index, grad, weight) triple —
+        in ONE compiled program when the optimizer is plain dense SGD
+        (the hot Module.fit path), else per-parameter.  Dispatch count
+        per train step drops from O(3·n_params) to O(1); on hosts where
+        dispatch is expensive this is the difference between the fit
+        loop being update-bound and compute-bound."""
+        if not triples:
+            return
+        if not self._fusable(triples):
+            for index, g, w in triples:
+                self(index, g, w)
+            return
+        opt = self.optimizer
+        for index, _, w in triples:
+            if index not in self.states:
+                self.states[index] = opt.create_state(index, w)
+                self.states_synced[index] = True
+            opt._update_count(index)
+        momentum_on = opt.momentum != 0.0
+        clip = float(opt.clip_gradient or 0.0)
+        key = (momentum_on, clip)
+        if key not in self._fused_cache:
+            self._fused_cache[key] = _fused_sgd_program(momentum_on, clip)
+        run = self._fused_cache[key]
+        lrs = tuple(float(opt._get_lr(i)) for i, _, _ in triples)
+        wds = tuple(float(opt._get_wd(i)) for i, _, _ in triples)
+        ws = tuple(w._handle for _, _, w in triples)
+        gs = tuple(g._handle for _, g, _ in triples)
+        ms = tuple(self.states[i]._handle if momentum_on else None
+                   for i, _, _ in triples)
+        new_ws, new_ms = run(ws, gs, ms, lrs, wds,
+                             float(opt.rescale_grad),
+                             float(opt.momentum))
+        for (i, _, w), nw, nm in zip(triples, new_ws, new_ms):
+            w._handle = nw
+            if nm is not None:
+                self.states[i]._handle = nm
 
     def set_states(self, states):
         self.states = pickle.loads(states) if isinstance(states, bytes) \

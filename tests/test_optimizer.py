@@ -169,3 +169,44 @@ def test_adam_clip_after_wd():
                       clip_gradient=0.5, beta1=0.0, beta2=0.0, epsilon=0.0)
     # with beta1=beta2=0: mean=g_eff=0.5, var=0.25, step=lr*0.5/0.5=1.0
     np.testing.assert_allclose(w.asnumpy(), np.full((4,), 9.0), rtol=1e-5)
+
+
+def test_updater_fused_batch_matches_per_param():
+    """Updater.update_batch (the one-dispatch Module.fit path) must be
+    numerically identical to per-parameter sgd_mom_update calls."""
+    rs = np.random.RandomState(0)
+    shapes = [(5, 3), (7,), (2, 4, 3)]
+    weights_a = [nd.array(rs.rand(*s).astype(np.float32)) for s in shapes]
+    weights_b = [w.copy() for w in weights_a]
+    grads = [nd.array(rs.rand(*s).astype(np.float32)) for s in shapes]
+
+    def make(lr):
+        o = opt.SGD(learning_rate=lr, momentum=0.9, wd=0.01,
+                    rescale_grad=1.0 / 8, clip_gradient=0.5)
+        return opt.get_updater(o)
+
+    up_a, up_b = make(0.1), make(0.1)
+    for step in range(3):
+        up_a.update_batch([(i, g, w) for i, (g, w)
+                           in enumerate(zip(grads, weights_a))])
+        for i, (g, w) in enumerate(zip(grads, weights_b)):
+            up_b(i, g, w)
+    for wa, wb in zip(weights_a, weights_b):
+        assert_almost_equal(wa.asnumpy(), wb.asnumpy(), rtol=1e-5,
+                            atol=1e-6)
+    # momentum states agree too
+    for i in range(len(shapes)):
+        assert_almost_equal(up_a.states[i].asnumpy(),
+                            up_b.states[i].asnumpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_updater_fused_batch_falls_back_for_adam():
+    rs = np.random.RandomState(1)
+    w = nd.array(rs.rand(4, 2).astype(np.float32))
+    w_ref = w.copy()
+    g = nd.array(rs.rand(4, 2).astype(np.float32))
+    up = opt.get_updater(opt.Adam(learning_rate=0.01))
+    up_ref = opt.get_updater(opt.Adam(learning_rate=0.01))
+    up.update_batch([(0, g, w)])
+    up_ref(0, g, w_ref)
+    assert_almost_equal(w.asnumpy(), w_ref.asnumpy(), rtol=1e-6)
