@@ -1,10 +1,19 @@
-"""Samplers (reference python/mxnet/gluon/data/sampler.py)."""
+"""Index samplers for the Gluon DataLoader.
+
+Capability parity with the reference samplers
+(python/mxnet/gluon/data/sampler.py): sequential, shuffled, and batching
+with keep/discard/rollover tail policies.
+"""
 from __future__ import annotations
 
 import numpy as np
 
+_TAIL_POLICIES = ("keep", "discard", "rollover")
+
 
 class Sampler:
+    """Iterable over dataset indices with a known length."""
+
     def __iter__(self):
         raise NotImplementedError
 
@@ -13,6 +22,8 @@ class Sampler:
 
 
 class SequentialSampler(Sampler):
+    """0..length-1 in order."""
+
     def __init__(self, length):
         self._length = length
 
@@ -24,20 +35,24 @@ class SequentialSampler(Sampler):
 
 
 class RandomSampler(Sampler):
+    """A fresh permutation of 0..length-1 each epoch."""
+
     def __init__(self, length):
         self._length = length
 
     def __iter__(self):
-        indices = np.arange(self._length)
-        np.random.shuffle(indices)
-        return iter(indices)
+        return iter(np.random.permutation(self._length))
 
     def __len__(self):
         return self._length
 
 
 class BatchSampler(Sampler):
-    """reference sampler.py BatchSampler; last_batch: keep|discard|rollover"""
+    """Group a sampler's indices into batch-size lists.
+
+    Tail policy: "keep" yields the short final batch, "discard" drops it,
+    "rollover" saves it to start the next epoch.
+    """
 
     def __init__(self, sampler, batch_size, last_batch="keep"):
         self._sampler = sampler
@@ -45,33 +60,32 @@ class BatchSampler(Sampler):
         self._last_batch = last_batch
         self._prev = []
 
+    def _check_policy(self):
+        if self._last_batch not in _TAIL_POLICIES:
+            raise ValueError(
+                "last_batch must be one of 'keep', 'discard', or "
+                "'rollover', but got %s" % self._last_batch)
+
     def __iter__(self):
-        batch, self._prev = self._prev, []
-        for i in self._sampler:
-            batch.append(i)
-            if len(batch) == self._batch_size:
-                yield batch
-                batch = []
-        if batch:
+        self._check_policy()
+        pending, self._prev = self._prev, []
+        for idx in self._sampler:
+            pending.append(idx)
+            if len(pending) == self._batch_size:
+                yield pending
+                pending = []
+        if pending:
             if self._last_batch == "keep":
-                yield batch
-            elif self._last_batch == "discard":
-                return
+                yield pending
             elif self._last_batch == "rollover":
-                self._prev = batch
-            else:
-                raise ValueError(
-                    "last_batch must be one of 'keep', 'discard', or "
-                    "'rollover', but got %s" % self._last_batch)
+                self._prev = pending
+            # "discard": drop the tail
 
     def __len__(self):
+        self._check_policy()
+        n = len(self._sampler)
         if self._last_batch == "keep":
-            return (len(self._sampler) + self._batch_size - 1) // \
-                self._batch_size
+            return -(-n // self._batch_size)
         if self._last_batch == "discard":
-            return len(self._sampler) // self._batch_size
-        if self._last_batch == "rollover":
-            return (len(self._prev) + len(self._sampler)) // self._batch_size
-        raise ValueError(
-            "last_batch must be one of 'keep', 'discard', or 'rollover', "
-            "but got %s" % self._last_batch)
+            return n // self._batch_size
+        return (len(self._prev) + n) // self._batch_size

