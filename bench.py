@@ -53,11 +53,24 @@ def _ensure_bench_rec(n_images, hw):
     return prefix
 
 
-def _transformer_main():
+def _transformer_flops_per_step(batch, seq, layers, hidden, vocab):
+    """One true FLOPs/MFU formula, loaded from tools/bench_ideal.py so
+    framework and ideal MFU can never drift apart."""
+    import importlib.util
+    here = os.path.dirname(os.path.abspath(__file__))
+    spec = importlib.util.spec_from_file_location(
+        "bench_ideal_flops", os.path.join(here, "tools", "bench_ideal.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.transformer_flops_per_step(batch, seq, layers, hidden, vocab)
+
+
+def _transformer_main(as_dict=False, batch=None, iters=None):
     """BENCH_MODEL=transformer: decoder-only LM training tokens/sec —
     the attention-path number of record (GPT-2-small-ish geometry by
-    default: 12 layers, 768 hidden, 12 heads, T=1024)."""
-    batch = int(os.environ.get("BENCH_BATCH", "8"))
+    default: 12 layers, 768 hidden, 12 heads, T=1024).  Reports MFU
+    against BENCH_PEAK_TFLOPS (default 197, TPU v5e bf16 peak)."""
+    batch = batch or int(os.environ.get("BENCH_BATCH", "8"))
     seq_len = int(os.environ.get("BENCH_SEQ", "1024"))
     layers = int(os.environ.get("BENCH_LAYERS", "12"))
     hidden = int(os.environ.get("BENCH_HIDDEN", "768"))
@@ -65,7 +78,8 @@ def _transformer_main():
     vocab = int(os.environ.get("BENCH_VOCAB", "32768"))
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
     warmup = int(os.environ.get("BENCH_WARMUP", "5"))
-    iters = int(os.environ.get("BENCH_ITERS", "30"))
+    iters = iters or int(os.environ.get("BENCH_ITERS", "30"))
+    peak = float(os.environ.get("BENCH_PEAK_TFLOPS", "197")) * 1e12
 
     import jax
     import jax.numpy as jnp
@@ -107,13 +121,19 @@ def _transformer_main():
     float(loss)
     dt = time.perf_counter() - t0
     tok_s = gb * seq_len * iters / dt / n_dev
-    print(json.dumps({
+    mfu = _transformer_flops_per_step(gb, seq_len, layers, hidden,
+                                      vocab) * iters / dt / (peak * n_dev)
+    result = {
         "metric": "transformer_train_tokens_per_sec_per_chip",
         "value": round(tok_s, 2),
+        "mfu": round(mfu, 4),
         "unit": "tokens/sec/chip (L%d H%d T%d bs%d, %s)" % (
             layers, hidden, seq_len, batch, dtype),
         "vs_baseline": None,
-    }))
+    }
+    if as_dict:
+        return result
+    print(json.dumps(result))
 
 
 def main():
@@ -258,7 +278,7 @@ def main():
 
     img_s = global_batch * iters / dt
     img_s_chip = img_s / n_dev
-    print(json.dumps({
+    result = {
         "metric": "resnet50_train_img_per_sec_per_chip" +
                   ("_io" if io_mode else ""),
         "value": round(img_s_chip, 2),
@@ -266,7 +286,27 @@ def main():
             batch, dtype, n_dev, "s" if n_dev > 1 else "",
             ", RecordIO+native decode in loop" if io_mode else ""),
         "vs_baseline": round(img_s_chip / BASELINE_IMG_S, 2),
-    }))
+    }
+    if not io_mode and os.environ.get("BENCH_TRANSFORMER", "1") != "0":
+        # attention-path number of record, captured in the same artifact.
+        # Runs in a fresh subprocess: HBM must start empty (the resident
+        # ResNet state would skew or OOM the LM step), and the ResNet
+        # BENCH_BATCH/BENCH_ITERS knobs must not leak into LM geometry.
+        import subprocess
+        env = dict(os.environ, BENCH_MODEL="transformer")
+        for knob in ("BENCH_BATCH", "BENCH_ITERS", "BENCH_WARMUP"):
+            env.pop(knob, None)
+        r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                           env=env, capture_output=True, text=True,
+                           timeout=1800)
+        try:
+            result["transformer"] = json.loads(
+                r.stdout.strip().splitlines()[-1])
+        except Exception:
+            result["transformer"] = {
+                "error": (r.stderr.strip().splitlines() or ["no output"])
+                [-1][:200]}
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -189,7 +189,154 @@ def train_step(params, mom, stats, x, labels):
     return new_p, new_m, new_stats, loss
 
 
+def transformer_flops_per_step(batch, seq, layers, hidden, vocab):
+    """Model FLOPs for one fused train step (fwd+bwd = 3x fwd matmuls).
+
+    Matmul counting (dense 2mnk): qkv+out projections 4*D^2/tok/layer,
+    FFN 8*D^2/tok/layer, vocab head D*V/tok; attention scores+values
+    4*T*D/tok/layer counted over the FULL score matrix (both the ideal
+    and the flash kernel do the causal work, so full-matrix counting is
+    the consistent convention; halve for the causal-skip convention).
+    """
+    tokens = batch * seq
+    proj = 2 * tokens * (layers * 12 * hidden * hidden + hidden * vocab)
+    attn = 2 * tokens * layers * 2 * (2 * seq * hidden)
+    return 3 * (proj + attn)
+
+
+def _t_init(key, vocab, seq, layers, hidden, dtype=jnp.bfloat16):
+    """GPT-2-small-geometry decoder LM params, bf16 weights + f32 norms."""
+    rngs = iter(jax.random.split(key, 8 * layers + 8))
+    p = {}
+
+    def dense(name, fan_in, fan_out):
+        p[name + "_w"] = (jax.random.normal(next(rngs), (fan_in, fan_out),
+                                            jnp.float32)
+                          * np.sqrt(1.0 / fan_in)).astype(dtype)
+        p[name + "_b"] = jnp.zeros((fan_out,), dtype)
+
+    def norm(name):
+        p[name + "_g"] = jnp.ones((hidden,), jnp.float32)
+        p[name + "_b"] = jnp.zeros((hidden,), jnp.float32)
+
+    p["tok"] = (jax.random.normal(next(rngs), (vocab, hidden), jnp.float32)
+                * 0.02).astype(dtype)
+    p["pos"] = (jax.random.normal(next(rngs), (seq, hidden), jnp.float32)
+                * 0.02).astype(dtype)
+    for i in range(layers):
+        pre = "l%d_" % i
+        norm(pre + "ln1")
+        dense(pre + "q", hidden, hidden)
+        dense(pre + "k", hidden, hidden)
+        dense(pre + "v", hidden, hidden)
+        dense(pre + "proj", hidden, hidden)
+        norm(pre + "ln2")
+        dense(pre + "ff1", hidden, 4 * hidden)
+        dense(pre + "ff2", 4 * hidden, hidden)
+    norm("ln_f")
+    dense("head", hidden, vocab)
+    return p
+
+
+def _t_forward(p, ids, layers, heads):
+    """Pre-LN causal decoder matching models/transformer.py op-for-op."""
+    hidden = p["tok"].shape[1]
+    hd = hidden // heads
+
+    def ln(name, x):
+        x32 = x.astype(jnp.float32)
+        mu = jnp.mean(x32, -1, keepdims=True)
+        var = jnp.var(x32, -1, keepdims=True)
+        y = (x32 - mu) * lax.rsqrt(var + 1e-5)
+        return (y * p[name + "_g"] + p[name + "_b"]).astype(x.dtype)
+
+    def dense(name, x):
+        return x @ p[name + "_w"] + p[name + "_b"]
+
+    x = p["tok"][ids] + p["pos"][None, :, :]
+    B, T = ids.shape
+    causal = jnp.tril(jnp.ones((T, T), jnp.bool_))
+    for i in range(layers):
+        pre = "l%d_" % i
+        a = ln(pre + "ln1", x)
+        q = dense(pre + "q", a).reshape(B, T, heads, hd)
+        k = dense(pre + "k", a).reshape(B, T, heads, hd)
+        v = dense(pre + "v", a).reshape(B, T, heads, hd)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+        scores = scores / np.sqrt(hd)
+        scores = jnp.where(causal, scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        att = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, T, hidden)
+        x = x + dense(pre + "proj", att)
+        f = ln(pre + "ln2", x)
+        f = jax.nn.gelu(dense(pre + "ff1", f))
+        x = x + dense(pre + "ff2", f)
+    x = ln("ln_f", x)
+    return dense("head", x).astype(jnp.float32)
+
+
+def _transformer_main():
+    batch = int(os.environ.get("BENCH_BATCH", "8"))
+    seq = int(os.environ.get("BENCH_SEQ", "1024"))
+    layers = int(os.environ.get("BENCH_LAYERS", "12"))
+    hidden = int(os.environ.get("BENCH_HIDDEN", "768"))
+    heads = int(os.environ.get("BENCH_HEADS", "12"))
+    vocab = int(os.environ.get("BENCH_VOCAB", "32768"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "5"))
+    iters = int(os.environ.get("BENCH_ITERS", "30"))
+    peak = float(os.environ.get("BENCH_PEAK_TFLOPS", "197")) * 1e12
+
+    key = jax.random.PRNGKey(0)
+    params = _t_init(key, vocab, seq, layers, hidden)
+    mom = {k: jnp.zeros(v.shape, jnp.float32) for k, v in params.items()}
+    ids = jax.random.randint(key, (batch, seq), 0, vocab)
+    labels = jax.random.randint(key, (batch, seq), 0, vocab)
+
+    def loss_fn(p, ids, labels):
+        logits = _t_forward(p, ids, layers, heads)
+        logp = jax.nn.log_softmax(logits)
+        picked = jnp.take_along_axis(logp, labels[..., None], axis=-1)
+        return -jnp.mean(picked)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(p, mom, ids, labels):
+        loss, grads = jax.value_and_grad(loss_fn)(p, ids, labels)
+        lr, mu = 1e-4, 0.9
+        new_p, new_m = {}, {}
+        for k, w in p.items():
+            m = mu * mom[k] + grads[k].astype(jnp.float32)
+            new_m[k] = m
+            new_p[k] = (w.astype(jnp.float32) - lr * m).astype(w.dtype)
+        return new_p, new_m, loss
+
+    dump = os.environ.get("BENCH_DUMP_HLO")
+    if dump:
+        open(dump, "w").write(
+            step.lower(params, mom, ids, labels).compile().as_text())
+
+    for _ in range(warmup):
+        params, mom, loss = step(params, mom, ids, labels)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, mom, loss = step(params, mom, ids, labels)
+    float(loss)
+    dt = time.perf_counter() - t0
+    tok_s = batch * seq * iters / dt
+    mfu = transformer_flops_per_step(batch, seq, layers, hidden,
+                                     vocab) * iters / dt / peak
+    print(json.dumps({
+        "metric": "transformer_ideal_tokens_per_sec",
+        "value": round(tok_s, 2),
+        "mfu": round(mfu, 4),
+        "unit": "tokens/sec (L%d H%d T%d bs%d, bf16, pure-JAX)"
+                % (layers, hidden, seq, batch)}))
+
+
 def main():
+    if os.environ.get("BENCH_MODEL", "resnet50") == "transformer":
+        _transformer_main()
+        return
     batch = int(os.environ.get("BENCH_BATCH", "32"))
     warmup = int(os.environ.get("BENCH_WARMUP", "5"))
     iters = int(os.environ.get("BENCH_ITERS", "100"))
