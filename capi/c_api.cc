@@ -2461,6 +2461,15 @@ int MXPredCreatePartialOut(const char *symbol_json_str,
   API_END();
 }
 
+int MXPredCreateFromServed(const char *served_path, PredictorHandle *out) {
+  API_BEGIN();
+  PyObject *r = Call("pred_create_served", Py_BuildValue("(s)", served_path));
+  CHECK_PY(r);
+  *out = HP(PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
 int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
                          mx_uint **shape_data, mx_uint *shape_ndim) {
   API_BEGIN();

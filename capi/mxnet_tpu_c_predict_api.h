@@ -41,6 +41,12 @@ MXNET_DLL int MXPredCreatePartialOut(
     const char **input_keys, const mx_uint *input_shape_indptr,
     const mx_uint *input_shape_data, mx_uint num_output_nodes,
     const char **output_keys, PredictorHandle *out);
+/*! Create a predictor from a serialized AOT deploy artifact written by
+ * Executor.export_compiled (deploy.py).  Loads the compiled XLA
+ * executable + weights directly: no symbol JSON, no graph build, no
+ * tracing.  Artifact must match the running device kind. */
+MXNET_DLL int MXPredCreateFromServed(const char *served_path,
+                                     PredictorHandle *out);
 MXNET_DLL int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
                                    mx_uint **shape_data,
                                    mx_uint *shape_ndim);

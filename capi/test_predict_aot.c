@@ -1,0 +1,67 @@
+/*
+ * AOT deploy-artifact consumer: load a SERIALIZED COMPILED program
+ * (written by Executor.export_compiled, deploy.py) and score a batch —
+ * no symbol JSON, no graph construction, no tracing anywhere on this
+ * path.  The TPU-native answer to the reference's amalgamation
+ * predictor (a minimal artifact + loader).
+ *
+ * Usage: test_predict_aot <artifact.mxt>
+ *        (input "data" of shape 4x3, one softmax output)
+ */
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "mxnet_tpu_c_predict_api.h"
+
+#define CHECK(x)                                                        \
+  do {                                                                  \
+    if ((x) != 0) {                                                     \
+      fprintf(stderr, "FAILED %s:%d: %s\n", __FILE__, __LINE__,         \
+              MXGetLastError());                                        \
+      exit(1);                                                          \
+    }                                                                   \
+  } while (0)
+
+int main(int argc, char **argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s <artifact>\n", argv[0]);
+    return 1;
+  }
+
+  PredictorHandle pred = NULL;
+  CHECK(MXPredCreateFromServed(argv[1], &pred));
+
+  /* standard MXPred flow: size the output buffer BEFORE feeding input */
+  mx_uint *shape = NULL, ndim = 0;
+  CHECK(MXPredGetOutputShape(pred, 0, &shape, &ndim));
+
+  float batch[4 * 3];
+  for (int i = 0; i < 4 * 3; ++i) batch[i] = (float)(i % 5) * 0.25f - 0.5f;
+  CHECK(MXPredSetInput(pred, "data", batch, 4 * 3));
+  CHECK(MXPredForward(pred));
+  if (ndim != 2 || shape[0] != 4) {
+    fprintf(stderr, "unexpected output rank/shape\n");
+    return 1;
+  }
+  mx_uint total = shape[0] * shape[1];
+  float *probs = (float *)malloc(total * sizeof(float));
+  CHECK(MXPredGetOutput(pred, 0, probs, total));
+
+  /* softmax rows must each sum to ~1 */
+  for (mx_uint r = 0; r < shape[0]; ++r) {
+    float s = 0.f;
+    for (mx_uint c = 0; c < shape[1]; ++c) s += probs[r * shape[1] + c];
+    if (s < 0.99f || s > 1.01f) {
+      fprintf(stderr, "row %u prob mass %f\n", r, s);
+      return 1;
+    }
+    int best = 0;
+    for (mx_uint c = 1; c < shape[1]; ++c)
+      if (probs[r * shape[1] + c] > probs[r * shape[1] + best]) best = (int)c;
+    printf("row %u -> class %d\n", r, best);
+  }
+  free(probs);
+  CHECK(MXPredFree(pred));
+  printf("PREDICT AOT OK\n");
+  return 0;
+}

@@ -1234,6 +1234,45 @@ class _Predictor:
         return tuple(structs[index].shape)
 
 
+class _ServedPredictor:
+    """Predictor over a deploy.ServedProgram artifact: the compiled
+    executable deserializes directly (no symbol layer, no tracing), so
+    the C consumer path MXPredCreateFromServed -> SetInput -> Forward ->
+    GetOutput never builds a graph."""
+
+    def __init__(self, path):
+        from .deploy import ServedProgram
+        self._served = ServedProgram.load(path)
+        self._feed = {}
+        self._outputs = None
+
+    def set_input(self, name, data):
+        if name not in self._served.input_names:
+            raise MXNetError("unknown predictor input %r" % name)
+        self._feed[name] = np.asarray(data)
+
+    def forward(self):
+        self._outputs = self._served.forward(**self._feed)
+
+    def get_output(self, index):
+        if self._outputs is None:
+            raise MXNetError("call MXPredForward first")
+        return np.asarray(self._outputs[index], np.float32)
+
+    def output_shape(self, index):
+        # static schema from the bundle: callers may size buffers before
+        # the first SetInput/Forward (standard MXPred call order)
+        if self._served.output_shapes:
+            return self._served.output_shapes[index]
+        if self._outputs is None:
+            raise MXNetError("call MXPredForward first")
+        return tuple(self._outputs[index].shape)
+
+
+def pred_create_served(path: str) -> int:
+    return _put(_ServedPredictor(path))
+
+
 def pred_create(symbol_json: str, param_bytes, dev_type: int, dev_id: int,
                 input_names, input_shapes) -> int:
     return _put(_Predictor(symbol_json, param_bytes, dev_type, dev_id,

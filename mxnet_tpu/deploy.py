@@ -1,0 +1,137 @@
+"""Ahead-of-time deployment artifacts: serialized compiled programs.
+
+The TPU deploy unit (docs/deploy.md) is a compiled XLA executable plus
+its weights — the analog of the reference's amalgamation predictor
+(a single .so + symbol JSON + params blob).  ``export_compiled`` AOT-
+compiles an inference program and writes ONE self-describing file:
+
+    { magic, version, payload (serialized executable), in/out pytrees,
+      arg/aux names + input slots, params/aux as host numpy, out names }
+
+``ServedProgram.load`` deserializes and runs it WITHOUT the symbol
+layer, graph builder, or any tracing — jax.experimental
+.serialize_executable.deserialize_and_load hands back the executable
+directly.  The C ABI reaches this through MXPredCreateFromServed
+(capi.py pred_create_served), so a C consumer can run a trained model
+from the artifact alone.
+
+Caveat (inherent to XLA AOT): the artifact is compiled for a specific
+device kind + topology; load on matching hardware.
+"""
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+
+from .base import MXNetError
+
+_MAGIC = "mxnet_tpu-served-v1"
+
+
+def _to_host(arr):
+    return np.asarray(arr)
+
+
+def export_compiled(prog, const_args, aux, input_names, input_shapes,
+                    path, input_dtypes=None):
+    """AOT-compile prog's inference forward and write the deploy bundle.
+
+    ``prog`` is an executor GraphProgram; ``const_args`` maps non-input
+    arg names to their (trained) values; ``aux`` is the aux-state tuple.
+    The compiled program takes (params_tuple, inputs_tuple) so weights
+    stay out of the executable and visible in the artifact.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import serialize_executable
+
+    input_dtypes = input_dtypes or {}
+    param_names = [n for n in prog.arg_names if n not in input_names]
+    missing = [n for n in param_names if n not in const_args]
+    if missing:
+        raise MXNetError("export_compiled: missing values for %s" % missing)
+    arg_pos = {n: i for i, n in enumerate(prog.arg_names)}
+
+    def fwd(param_vals, input_vals):
+        args = [None] * len(prog.arg_names)
+        for n, v in zip(param_names, param_vals):
+            args[arg_pos[n]] = v
+        for n, v in zip(input_names, input_vals):
+            args[arg_pos[n]] = v
+        keys = jnp.zeros((prog.num_rng, 2), jnp.uint32)
+        outs, _ = prog.evaluate(args, tuple(aux), keys, False)
+        return tuple(outs)
+
+    def struct_of(value):
+        host = np.asarray(value)
+        return jax.ShapeDtypeStruct(host.shape, host.dtype)
+
+    param_structs = tuple(struct_of(const_args[n]) for n in param_names)
+    input_structs = tuple(
+        jax.ShapeDtypeStruct(tuple(input_shapes[n]),
+                             input_dtypes.get(n, np.float32))
+        for n in input_names)
+    out_structs = jax.eval_shape(fwd, param_structs, input_structs)
+    compiled = jax.jit(fwd).lower(param_structs, input_structs).compile()
+    payload, in_tree, out_tree = serialize_executable.serialize(compiled)
+
+    bundle = {
+        "magic": _MAGIC,
+        "payload": payload,
+        "in_tree": in_tree,
+        "out_tree": out_tree,
+        "param_names": param_names,
+        "params": {n: _to_host(const_args[n]) for n in param_names},
+        "input_names": list(input_names),
+        "input_shapes": {n: tuple(input_shapes[n]) for n in input_names},
+        "input_dtypes": {n: np.dtype(input_dtypes.get(n, np.float32)).name
+                         for n in input_names},
+        "output_names": list(prog.out_names)
+        if hasattr(prog, "out_names") else None,
+        # static output schema: consumers size buffers before any forward
+        "output_shapes": [tuple(s.shape) for s in out_structs],
+        "output_dtypes": [np.dtype(s.dtype).name for s in out_structs],
+    }
+    with open(path, "wb") as f:
+        pickle.dump(bundle, f)
+    return path
+
+
+class ServedProgram:
+    """A deserialized AOT executable + its weights; no tracing anywhere."""
+
+    def __init__(self, bundle):
+        import jax
+        from jax.experimental import serialize_executable
+        if bundle.get("magic") != _MAGIC:
+            raise MXNetError("not a mxnet_tpu served-program file")
+        self._compiled = serialize_executable.deserialize_and_load(
+            bundle["payload"], bundle["in_tree"], bundle["out_tree"])
+        self.input_names = bundle["input_names"]
+        self.input_shapes = bundle["input_shapes"]
+        self.input_dtypes = {n: np.dtype(d) for n, d
+                             in bundle["input_dtypes"].items()}
+        self.output_names = bundle.get("output_names")
+        self.output_shapes = [tuple(s) for s in
+                              bundle.get("output_shapes") or []]
+        self._params = tuple(jax.device_put(bundle["params"][n])
+                             for n in bundle["param_names"])
+
+    @classmethod
+    def load(cls, path):
+        with open(path, "rb") as f:
+            return cls(pickle.load(f))
+
+    def forward(self, **inputs):
+        """Run the compiled program; returns a list of host numpy outputs."""
+        import jax
+        vals = []
+        for n in self.input_names:
+            if n not in inputs:
+                raise MXNetError("missing input %r" % n)
+            host = np.asarray(inputs[n], self.input_dtypes[n]) \
+                .reshape(self.input_shapes[n])
+            vals.append(jax.device_put(host))
+        outs = self._compiled(self._params, tuple(vals))
+        return [np.asarray(o) for o in outs]

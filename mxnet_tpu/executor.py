@@ -792,6 +792,25 @@ class Executor:
     def output_dict(self):
         return dict(zip(self._symbol.list_outputs(), self.outputs))
 
+    def export_compiled(self, path, input_names=("data",),
+                        input_dtypes=None):
+        """Write a serialized AOT deploy artifact (see deploy.py).
+
+        The bound arg arrays become the artifact's weights; ``input_names``
+        stay runtime inputs.  The result loads via
+        deploy.ServedProgram.load (or the C ABI's MXPredCreateFromServed)
+        and runs with no symbol layer or tracing."""
+        from .deploy import export_compiled as _export
+        unknown = [n for n in input_names if n not in self.arg_dict]
+        if unknown:
+            raise MXNetError("export_compiled: unknown inputs %s" % unknown)
+        const_args = {n: arr.asnumpy() for n, arr in self.arg_dict.items()
+                      if n not in input_names}
+        aux = tuple(a._handle for a in self.aux_arrays)
+        input_shapes = {n: self.arg_dict[n].shape for n in input_names}
+        return _export(self._prog, const_args, aux, list(input_names),
+                       input_shapes, path, input_dtypes)
+
     def copy_params_from(self, arg_params, aux_params=None,
                          allow_extra_params=False):
         for name, arr in arg_params.items():

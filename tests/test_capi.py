@@ -12,6 +12,7 @@ import ctypes
 import os
 import shutil
 import subprocess
+import sys
 
 import numpy as np
 import pytest
@@ -306,6 +307,40 @@ def test_c_predict_api(capi_lib, tmp_path):
                        env=env, timeout=600)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "PREDICT OK" in r.stdout
+
+
+def test_c_predict_aot_served(capi_lib, tmp_path):
+    """capi/test_predict_aot.c: Executor.export_compiled writes a
+    serialized AOT artifact; a real C consumer loads and scores it via
+    MXPredCreateFromServed with no symbol layer or tracing (the
+    amalgamation-deployment answer, deploy.py).  Export runs in a clean
+    subprocess so artifact and consumer share one jax backend."""
+    artifact = str(tmp_path / "model.mxt")
+    code = (
+        "import numpy as np\n"
+        "import mxnet_tpu as mx\n"
+        "net = mx.sym.Variable('data')\n"
+        "net = mx.sym.FullyConnected(net, num_hidden=8, name='fc1')\n"
+        "net = mx.sym.Activation(net, act_type='relu')\n"
+        "net = mx.sym.FullyConnected(net, num_hidden=5, name='fc2')\n"
+        "net = mx.sym.SoftmaxOutput(net, name='softmax')\n"
+        "ex = net.simple_bind(mx.cpu(), data=(4, 3))\n"
+        "rs = np.random.RandomState(0)\n"
+        "for a in ex.arg_arrays:\n"
+        "    a[:] = mx.nd.array(rs.normal(0, 0.3, a.shape))\n"
+        "ex.export_compiled(%r, input_names=('data',))\n" % artifact)
+    env = dict(os.environ, MXNET_TPU_HOME=REPO,
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH",
+                                                             ""))
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, env=env, timeout=600, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    exe = os.path.join(CAPI, "build", "test_predict_aot")
+    assert os.path.isfile(exe)
+    r = subprocess.run([exe, artifact], capture_output=True, text=True,
+                       env=env, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "PREDICT AOT OK" in r.stdout
 
 
 def test_c_autograd_and_cachedop(capi_lib):
