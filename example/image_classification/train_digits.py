@@ -1,0 +1,112 @@
+"""Real-data convergence proof: scanned handwritten digits end-to-end.
+
+This environment has no network egress and no CIFAR/MNIST archive on
+disk (keras/torchvision/huggingface caches all empty — checked), so the
+real-dataset convergence evidence the reference establishes with
+MNIST/CIFAR (tests/python/train/test_conv.py,
+example/image-classification) runs here on the one real image dataset
+shipped inside the software stack: scikit-learn's bundled UCI ML
+hand-written digits (1,797 genuine 8x8 scans, NIST-derived).  Same
+shape of proof — a conv net trained through the public Module API on
+real pixels to a recorded held-out accuracy — on data that is actually
+present.
+
+Run: python example/image_classification/train_digits.py
+     [--num-epochs 30] [--batch-size 64] [--lr 0.1] [--target 0.95]
+
+Exits non-zero if held-out accuracy misses --target; prints a per-epoch
+validation curve (the PERF.md record comes from this output).
+
+When CIFAR-10 *is* staged on a host (cifar10_train.rec), use
+train_cifar10.py — the full-size CLI path, CI-smoked on synthetic data.
+"""
+import argparse
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+
+def build_net(sym):
+    net = sym.Variable("data")
+    net = sym.Convolution(net, kernel=(3, 3), num_filter=32, pad=(1, 1),
+                          name="conv1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.Convolution(net, kernel=(3, 3), num_filter=64, pad=(1, 1),
+                          name="conv2")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = sym.Flatten(net)
+    net = sym.FullyConnected(net, num_hidden=128, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=10, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def load_split(val_fraction=0.25, seed=7):
+    from sklearn.datasets import load_digits
+    raw = load_digits()
+    images = (raw.images.astype(np.float32) / 16.0)[:, None, :, :]
+    labels = raw.target.astype(np.float32)
+    order = np.random.RandomState(seed).permutation(len(labels))
+    images, labels = images[order], labels[order]
+    n_val = int(len(labels) * val_fraction)
+    return (images[n_val:], labels[n_val:]), (images[:n_val], labels[:n_val])
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-epochs", type=int, default=30)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--target", type=float, default=0.95)
+    args = ap.parse_args(argv)
+
+    import mxnet_tpu as mx
+    logging.basicConfig(level=logging.INFO)
+
+    (x_tr, y_tr), (x_va, y_va) = load_split()
+    print("train on %d real digit scans, validate on %d"
+          % (len(y_tr), len(y_va)))
+
+    train_iter = mx.io.NDArrayIter(x_tr, y_tr, args.batch_size,
+                                   shuffle=True, label_name="softmax_label")
+    val_iter = mx.io.NDArrayIter(x_va, y_va, args.batch_size,
+                                 label_name="softmax_label")
+
+    mod = mx.mod.Module(build_net(mx.sym), context=mx.context.current_context())
+    curve = []
+
+    def at_epoch_end(epoch, sym=None, arg=None, aux=None):
+        score = dict(mod.score(val_iter, "acc"))
+        curve.append((epoch, score["accuracy"]))
+        print("epoch %d val-acc %.4f" % (epoch, score["accuracy"]))
+
+    t0 = time.time()
+    mod.fit(train_iter, num_epoch=args.num_epochs,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                              "wd": 1e-4},
+            initializer=mx.init.Xavier(),
+            epoch_end_callback=at_epoch_end,
+            eval_metric="acc")
+    wall = time.time() - t0
+
+    best = max(acc for _, acc in curve)
+    final = curve[-1][1]
+    print("digits convergence: final val-acc %.4f (best %.4f) "
+          "in %d epochs, %.1fs wall" % (final, best, args.num_epochs, wall))
+    if best < args.target:
+        print("FAILED: best val-acc %.4f < target %.4f" % (best, args.target))
+        return 1
+    print("CONVERGED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,6 +37,22 @@ def test_example_runs(script, ok_line):
     assert ok_line in r.stdout, r.stdout[-1000:]
 
 
+def test_real_data_convergence_digits():
+    """Real-pixel convergence assertion (reference
+    tests/python/train/test_conv.py trains MNIST to an accuracy bar):
+    the digits CLI must reach >=0.90 held-out accuracy on the bundled
+    real scanned-digit dataset in a short run."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "example", "image_classification",
+                      "train_digits.py"),
+         "--num-epochs", "12", "--target", "0.90"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert "CONVERGED" in r.stdout, r.stdout[-1000:]
+
+
 def test_train_imagenet_cli(tmp_path):
     """The flagship CLI (reference example/image-classification/
     train_imagenet.py + common/fit.py): one command trains through the
