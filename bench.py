@@ -144,6 +144,12 @@ def main():
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
     warmup = int(os.environ.get("BENCH_WARMUP", "5"))
     iters = int(os.environ.get("BENCH_ITERS", "100"))
+    layout = os.environ.get("BENCH_LAYOUT", "NCHW")  # NHWC: channels-last path
+    if layout not in ("NCHW", "NHWC"):
+        raise SystemExit("BENCH_LAYOUT must be NCHW or NHWC, got %r" % layout)
+    if layout == "NHWC" and os.environ.get("BENCH_IO", "0") == "1":
+        raise SystemExit("BENCH_IO=1 decodes NCHW batches; combine with "
+                         "BENCH_LAYOUT=NCHW (default)")
 
     import jax
     import jax.numpy as jnp
@@ -155,14 +161,15 @@ def main():
     devices = jax.devices()
     n_dev = len([d for d in devices if d.platform != "cpu"]) or 1
     sym = get_symbol(num_classes=1000, num_layers=50,
-                     image_shape="3,224,224", dtype=dtype)
+                     image_shape="3,224,224", dtype=dtype, layout=layout)
     spec = MeshSpec(make_mesh((n_dev,), ("dp",)))
     trainer = ShardedTrainer(sym, spec, lr=0.1, momentum=0.9, wd=1e-4,
                              param_dtype=dtype if dtype != "float32" else None)
 
     global_batch = batch * n_dev
-    shapes = {"data": (global_batch, 3, 224, 224),
-              "softmax_label": (global_batch,)}
+    data_shape = (global_batch, 224, 224, 3) if layout == "NHWC" \
+        else (global_batch, 3, 224, 224)
+    shapes = {"data": data_shape, "softmax_label": (global_batch,)}
     params, mom, aux = trainer.init_state(shapes)
 
     io_mode = os.environ.get("BENCH_IO", "0") == "1"
@@ -181,8 +188,8 @@ def main():
         # data generated on device — the tunnel must not be in the loop
         key = jax.random.PRNGKey(0)
         data = jax.device_put(
-            jax.random.uniform(key, (global_batch, 3, 224, 224),
-                               jnp.float32), spec.batch_sharding())
+            jax.random.uniform(key, data_shape, jnp.float32),
+            spec.batch_sharding())
         label = jax.device_put(
             jax.random.randint(key, (global_batch,), 0,
                                1000).astype(jnp.float32),
@@ -282,8 +289,8 @@ def main():
         "metric": "resnet50_train_img_per_sec_per_chip" +
                   ("_io" if io_mode else ""),
         "value": round(img_s_chip, 2),
-        "unit": "images/sec/chip (bs%d, %s, %d chip%s%s)" % (
-            batch, dtype, n_dev, "s" if n_dev > 1 else "",
+        "unit": "images/sec/chip (bs%d, %s, %s, %d chip%s%s)" % (
+            batch, dtype, layout, n_dev, "s" if n_dev > 1 else "",
             ", RecordIO+native decode in loop" if io_mode else ""),
         "vs_baseline": round(img_s_chip / BASELINE_IMG_S, 2),
     }

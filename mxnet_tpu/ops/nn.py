@@ -84,8 +84,22 @@ def _conv_nd(attrs, x):
 @register("Convolution", inputs=_conv_inputs, params=dict(_CONV_PARAMS),
           aliases=("Convolution_v1",))
 def _convolution(attrs, x, w, bias=None):
-    """NC(D)HW activations, OIHW weights (reference convolution-inl.h)."""
+    """NC(D)HW activations, OIHW weights (reference convolution-inl.h).
+
+    layout="NHWC" (2-d only) runs channels-last end to end with OHWI
+    weights — the TPU-native layout path (conv feature dim falls on the
+    lane dimension without relayout; see PERF.md r5)."""
     nd, stride, dilate, pad = _conv_nd(attrs, x)
+    if attrs.layout == "NHWC":
+        assert nd == 2, "NHWC layout is 2-d only"
+        dn = jax.lax.conv_dimension_numbers(
+            x.shape, w.shape, ("NHWC", "OHWI", "NHWC"))
+        out = jax.lax.conv_general_dilated(
+            x, w, window_strides=stride, padding=pad, rhs_dilation=dilate,
+            dimension_numbers=dn, feature_group_count=attrs.num_group)
+        if bias is not None:
+            out = out + bias
+        return out
     spatial = "DHW"[-nd:]
     dn = jax.lax.conv_dimension_numbers(
         x.shape, w.shape,
@@ -130,29 +144,37 @@ def _deconvolution(attrs, x, w, bias=None):
           params=dict(kernel=attr_shape(()), pool_type=attr_str("max"),
                       global_pool=attr_bool(False), cudnn_off=attr_bool(False),
                       pooling_convention=attr_str("valid"),
-                      stride=attr_shape(()), pad=attr_shape(())),
+                      stride=attr_shape(()), pad=attr_shape(()),
+                      layout=attr_str(None)),
           aliases=("Pooling_v1",))
 def _pooling(attrs, x):
     nd = x.ndim - 2
+    nhwc = attrs.layout == "NHWC"
+    sp0 = 1 if nhwc else 2          # first spatial axis
     if attrs.global_pool:
-        kernel = x.shape[2:]
+        kernel = x.shape[sp0:sp0 + nd]
         stride = (1,) * nd
         pad = (0,) * nd
     else:
         kernel = attrs.kernel
         stride = attrs.stride or (1,) * nd
         pad = attrs.pad or (0,) * nd
-    window = (1, 1) + tuple(kernel)
-    strides = (1, 1) + tuple(stride)
-    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    if nhwc:
+        window = (1,) + tuple(kernel) + (1,)
+        strides = (1,) + tuple(stride) + (1,)
+        pads = ((0, 0),) + tuple((p, p) for p in pad) + ((0, 0),)
+    else:
+        window = (1, 1) + tuple(kernel)
+        strides = (1, 1) + tuple(stride)
+        pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
     if attrs.pooling_convention == "full" and not attrs.global_pool:
         # ceil-mode output: extend right/bottom padding so ceil division holds
         pads = list(pads)
         for i in range(nd):
-            in_sz = x.shape[2 + i] + 2 * pad[i]
+            in_sz = x.shape[sp0 + i] + 2 * pad[i]
             out_sz = -(-(in_sz - kernel[i]) // stride[i]) + 1
             need = (out_sz - 1) * stride[i] + kernel[i] - in_sz
-            pads[2 + i] = (pad[i], pad[i] + max(0, need))
+            pads[sp0 + i] = (pad[i], pad[i] + max(0, need))
         pads = tuple(pads)
     if attrs.pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min

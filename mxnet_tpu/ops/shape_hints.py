@@ -31,9 +31,13 @@ def _fc(attrs, shapes):
 
 def _conv(attrs, shapes):
     data = shapes[0]
-    nd = len(attrs["kernel"])
     g = attrs.get("num_group", 1)
-    out = {1: (attrs["num_filter"], data[1] // g) + tuple(attrs["kernel"])}
+    if attrs.get("layout") == "NHWC":
+        out = {1: (attrs["num_filter"],) + tuple(attrs["kernel"])
+               + (data[-1] // g,)}
+    else:
+        out = {1: (attrs["num_filter"], data[1] // g)
+               + tuple(attrs["kernel"])}
     if not attrs.get("no_bias", False):
         out[2] = (attrs["num_filter"],)
     return out

@@ -42,3 +42,42 @@ def test_small_families_forward():
         out = ex.forward()[0].asnumpy()
         assert out.shape == (2, 5)
         np.testing.assert_allclose(out.sum(1), 1.0, rtol=1e-4)
+
+
+def test_resnet_nhwc_layout_matches_nchw():
+    """The channels-last op path (Convolution/Pooling layout=NHWC,
+    BatchNorm axis=3) must reproduce the NCHW network exactly given
+    transposed weights.  Compared at the PRE-softmax logits (softmax on
+    randomly-scaled logits saturates to one-hot and would hide conv
+    differences)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu.models.resnet import get_symbol
+    rs = np.random.RandomState(0)
+    x = rs.rand(2, 3, 32, 32).astype(np.float32)
+    logits_c = get_symbol(num_classes=10, num_layers=18,
+                          image_shape="3,32,32") \
+        .get_internals()["fc1_output"]
+    logits_h = get_symbol(num_classes=10, num_layers=18,
+                          image_shape="3,32,32", layout="NHWC") \
+        .get_internals()["fc1_output"]
+    ex_c = logits_c.simple_bind(mx.cpu(), data=(2, 3, 32, 32))
+    ex_h = logits_h.simple_bind(mx.cpu(), data=(2, 32, 32, 3))
+    for n, a in ex_c.arg_dict.items():
+        a[:] = mx.nd.array(rs.normal(0, 0.05, a.shape).astype(np.float32))
+    for n, a in ex_h.arg_dict.items():
+        if n == "data":
+            continue
+        src = ex_c.arg_dict[n].asnumpy()
+        # every 4-d arg is a conv weight: OIHW -> OHWI unconditionally
+        # (shape equality is ambiguous for conv0's (64,3,3,3))
+        if src.ndim == 4:
+            src = src.transpose(0, 2, 3, 1)
+        a[:] = mx.nd.array(src.reshape(a.shape))
+    ex_c.arg_dict["data"][:] = mx.nd.array(x)
+    ex_h.arg_dict["data"][:] = mx.nd.array(x.transpose(0, 2, 3, 1))
+    ex_c.forward(is_train=False)
+    ex_h.forward(is_train=False)
+    a, b = ex_c.outputs[0].asnumpy(), ex_h.outputs[0].asnumpy()
+    assert np.abs(a).max() > 1e-3, "logits degenerate; test would be vacuous"
+    np.testing.assert_allclose(a, b, atol=2e-4)
