@@ -1,0 +1,350 @@
+"""Faster-RCNN end-to-end on a synthetic detection task (the reference
+example/rcnn/train_end2end.py role, CI-sized).
+
+The full two-stage pipeline, exercising every rcnn op in composition:
+
+  backbone convs -> RPN head
+      -> rpn_cls  : SoftmaxOutput(multi_output, ignore=-1) on anchor labels
+      -> rpn_bbox : smooth_l1 on anchor-encoded gt deltas (MakeLoss)
+  -> SoftmaxActivation(channel) -> _contrib_MultiProposal (decode+NMS)
+  -> ProposalTarget (python CustomOp, like the reference's
+     example/rcnn proposal_target layer) matching rois to gt
+  -> ROIPooling -> FC head
+      -> rcnn cls : SoftmaxOutput on matched labels
+      -> rcnn bbox: smooth_l1 on class-slot deltas (MakeLoss)
+
+Anchor targets are computed in the data iterator (the reference
+AnchorLoader role) with the same anchor layout the Proposal op decodes
+((h*W+w)*A + a ordering, +1 width convention; the RPN softmax labels
+are re-ordered channel-major to match the score reshape).  After
+training, a toy AP@0.5 over FRESH held-out scenes must clear 0.6.
+
+Run: python example/detection/train_frcnn_toy.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import operator as op_mod
+
+HW = 64                 # image side
+STRIDE = 8              # backbone downsampling
+FEAT = HW // STRIDE     # feature side
+SCALES = (2.0, 4.0)
+RATIOS = (1.0,)
+A = len(SCALES) * len(RATIOS)
+POST_NMS = 16           # proposals per image
+ROIS_PER_IMG = 16       # sampled rois per image after matching
+NUM_CLASSES = 2         # background + square
+
+
+# ---------------------------------------------------------------------------
+# anchors (must mirror ops/contrib.py _rpn_anchors exactly)
+# ---------------------------------------------------------------------------
+
+def make_anchors():
+    base = []
+    for r in RATIOS:
+        for s in SCALES:
+            size = STRIDE * STRIDE
+            ws = np.sqrt(size / r) * s / STRIDE
+            hs = ws * r
+            base.append([-ws * STRIDE / 2, -hs * STRIDE / 2,
+                         ws * STRIDE / 2, hs * STRIDE / 2])
+    base = np.asarray(base, np.float32)                      # (A,4)
+    shift = np.arange(FEAT, dtype=np.float32) * STRIDE
+    sy, sx = np.meshgrid(shift, shift, indexing="ij")
+    shifts = np.stack([sx, sy, sx, sy], -1).reshape(-1, 4)   # (HW,4)
+    return (shifts[:, None, :] + base[None]).reshape(-1, 4)  # (HW*A,4)
+
+
+def iou_matrix(a, b):
+    """IoU of (N,4) vs (M,4) corner boxes (+1 width convention)."""
+    area_a = (a[:, 2] - a[:, 0] + 1) * (a[:, 3] - a[:, 1] + 1)
+    area_b = (b[:, 2] - b[:, 0] + 1) * (b[:, 3] - b[:, 1] + 1)
+    iw = np.minimum(a[:, None, 2], b[None, :, 2]) - \
+        np.maximum(a[:, None, 0], b[None, :, 0]) + 1
+    ih = np.minimum(a[:, None, 3], b[None, :, 3]) - \
+        np.maximum(a[:, None, 1], b[None, :, 1]) + 1
+    inter = np.maximum(iw, 0) * np.maximum(ih, 0)
+    return inter / (area_a[:, None] + area_b[None] - inter)
+
+
+def encode_deltas(rois, gt):
+    """(dx,dy,dw,dh) targets, matching the Proposal decode convention."""
+    rw = rois[:, 2] - rois[:, 0] + 1
+    rh = rois[:, 3] - rois[:, 1] + 1
+    rcx = rois[:, 0] + rw / 2
+    rcy = rois[:, 1] + rh / 2
+    gw = gt[:, 2] - gt[:, 0] + 1
+    gh = gt[:, 3] - gt[:, 1] + 1
+    gcx = gt[:, 0] + gw / 2
+    gcy = gt[:, 1] + gh / 2
+    return np.stack([(gcx - rcx) / rw, (gcy - rcy) / rh,
+                     np.log(gw / rw), np.log(gh / rh)], -1)
+
+
+# ---------------------------------------------------------------------------
+# data: bright squares + AnchorLoader-style RPN targets
+# ---------------------------------------------------------------------------
+
+def synthetic_scene(rs):
+    img = rs.uniform(0, 0.1, (3, HW, HW)).astype(np.float32)
+    size = rs.randint(HW // 4, HW // 2)
+    x = rs.randint(0, HW - size)
+    y = rs.randint(0, HW - size)
+    img[:, y:y + size, x:x + size] += 0.8
+    return img, np.array([x, y, x + size - 1, y + size - 1], np.float32)
+
+
+def rpn_targets(anchors, gt_box):
+    """Per-anchor labels (1/0/-1 ignore) + fg bbox deltas/weights."""
+    ious = iou_matrix(anchors, gt_box[None])[:, 0]
+    labels = -np.ones(len(anchors), np.float32)
+    labels[ious < 0.3] = 0
+    labels[ious >= 0.5] = 1
+    labels[ious.argmax()] = 1     # gt must own one anchor
+    deltas = np.zeros((len(anchors), 4), np.float32)
+    weights = np.zeros((len(anchors), 4), np.float32)
+    fg = labels == 1
+    deltas[fg] = encode_deltas(anchors[fg], np.repeat(gt_box[None],
+                                                      fg.sum(), 0))
+    weights[fg] = 1.0
+    return labels, deltas, weights
+
+
+def build_dataset(rs, n):
+    anchors = make_anchors()
+    data, gts, lab, dlt, wts = [], [], [], [], []
+    for _ in range(n):
+        img, gt = synthetic_scene(rs)
+        l, d, w = rpn_targets(anchors, gt)
+        data.append(img)
+        gts.append(np.concatenate([[1.0], gt]))     # [cls, x1,y1,x2,y2]
+        # label positions must match Reshape(0,2,-1)'s channel-major
+        # (a*H*W + h*W + w) order, not the anchors' (h*W+w)*A + a order
+        lab.append(l.reshape(FEAT, FEAT, A).transpose(2, 0, 1).reshape(-1))
+        # (A*4, H, W) layout: anchor-major channel groups of 4
+        dlt.append(d.reshape(FEAT, FEAT, A * 4).transpose(2, 0, 1))
+        wts.append(w.reshape(FEAT, FEAT, A * 4).transpose(2, 0, 1))
+    return (np.stack(data), np.stack(gts)[:, None, :], np.stack(lab),
+            np.stack(dlt), np.stack(wts))
+
+
+# ---------------------------------------------------------------------------
+# ProposalTarget custom op (the reference rcnn example implements this
+# exact layer as a python CustomOp too)
+# ---------------------------------------------------------------------------
+
+@op_mod.register("toy_proposal_target")
+class ProposalTargetProp(op_mod.CustomOpProp):
+    def __init__(self, batch_size="0"):
+        super().__init__(need_top_grad=False)
+        self._batch = int(batch_size)
+
+    def list_arguments(self):
+        return ["rois", "gt_boxes"]
+
+    def list_outputs(self):
+        return ["rois_out", "label", "bbox_target", "bbox_weight"]
+
+    def infer_shape(self, in_shape):
+        B = in_shape[1][0]
+        S = B * ROIS_PER_IMG
+        return ([in_shape[0], in_shape[1]],
+                [(S, 5), (S,), (S, 4 * NUM_CLASSES), (S, 4 * NUM_CLASSES)],
+                [])
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return ProposalTarget()
+
+
+class ProposalTarget(op_mod.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        rois = in_data[0].asnumpy()          # (B*POST_NMS, 5)
+        gts = in_data[1].asnumpy()           # (B, 1, 5) [cls,x1,y1,x2,y2]
+        B = gts.shape[0]
+        out_r = np.zeros((B * ROIS_PER_IMG, 5), np.float32)
+        out_l = np.zeros((B * ROIS_PER_IMG,), np.float32)
+        out_t = np.zeros((B * ROIS_PER_IMG, 4 * NUM_CLASSES), np.float32)
+        out_w = np.zeros_like(out_t)
+        for b in range(B):
+            mine = rois[rois[:, 0] == b][:, 1:]
+            gt = gts[b, 0]
+            # gt box joins the roi pool (reference proposal_target does this)
+            mine = np.concatenate([gt[None, 1:], mine], 0)
+            ious = iou_matrix(mine, gt[None, 1:])[:, 0]
+            order = np.argsort(-ious)[:ROIS_PER_IMG]
+            picked = mine[order]
+            piou = ious[order]
+            npick = len(picked)
+            sl = slice(b * ROIS_PER_IMG, b * ROIS_PER_IMG + npick)
+            out_r[sl, 0] = b
+            out_r[sl, 1:] = picked
+            fg = piou >= 0.5
+            cls = int(gt[0])
+            out_l[sl] = np.where(fg, cls, 0).astype(np.float32)
+            deltas = encode_deltas(picked, np.repeat(gt[None, 1:], npick, 0))
+            cols = slice(4 * cls, 4 * cls + 4)
+            out_t[sl, cols] = deltas * fg[:, None]
+            out_w[sl, cols] = fg[:, None].astype(np.float32)
+        for i, blob in enumerate((out_r, out_l, out_t, out_w)):
+            self.assign(out_data[i], req[i], mx.nd.array(blob))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        for i in range(len(in_grad)):
+            self.assign(in_grad[i], req[i],
+                        mx.nd.zeros(in_grad[i].shape))
+
+
+# ---------------------------------------------------------------------------
+# the two-stage symbol
+# ---------------------------------------------------------------------------
+
+def get_symbol_train(batch_size):
+    sym = mx.sym
+    data = sym.Variable("data")
+    gt_boxes = sym.Variable("gt_boxes")
+    rpn_label = sym.Variable("rpn_label")
+    rpn_bbox_target = sym.Variable("rpn_bbox_target")
+    rpn_bbox_weight = sym.Variable("rpn_bbox_weight")
+    im_info = sym.Variable("im_info")
+
+    body = data
+    for i, nf in enumerate((16, 32, 64)):
+        body = sym.Convolution(body, kernel=(3, 3), pad=(1, 1),
+                               num_filter=nf, name="conv%d" % i)
+        body = sym.Activation(body, act_type="relu")
+        body = sym.Pooling(body, kernel=(2, 2), stride=(2, 2),
+                           pool_type="max")
+
+    rpn = sym.Convolution(body, kernel=(3, 3), pad=(1, 1), num_filter=64,
+                          name="rpn_conv")
+    rpn = sym.Activation(rpn, act_type="relu")
+    rpn_cls_score = sym.Convolution(rpn, kernel=(1, 1), num_filter=2 * A,
+                                    name="rpn_cls_score")
+    rpn_bbox_pred = sym.Convolution(rpn, kernel=(1, 1), num_filter=4 * A,
+                                    name="rpn_bbox_pred")
+
+    # stage-1 losses
+    score_r = sym.Reshape(rpn_cls_score, shape=(0, 2, -1),
+                          name="rpn_cls_score_reshape")
+    rpn_cls_prob = sym.SoftmaxOutput(score_r, rpn_label, multi_output=True,
+                                     use_ignore=True, ignore_label=-1,
+                                     normalization="valid",
+                                     name="rpn_cls_prob")
+    rpn_bbox_l = sym.smooth_l1(
+        (rpn_bbox_pred - rpn_bbox_target) * rpn_bbox_weight, scalar=3.0,
+        name="rpn_bbox_l1")
+    rpn_bbox_loss = sym.MakeLoss(sym.sum(rpn_bbox_l) / batch_size,
+                                 grad_scale=1.0, name="rpn_bbox_loss")
+
+    # proposals (no gradient through decode/NMS, like the reference op)
+    prob_for_prop = sym.Reshape(
+        sym.SoftmaxActivation(
+            sym.BlockGrad(score_r), mode="channel", name="rpn_cls_act"),
+        shape=(0, 2 * A, FEAT, FEAT), name="rpn_cls_act_reshape")
+    rois = sym.contrib.MultiProposal(
+        prob_for_prop, sym.BlockGrad(rpn_bbox_pred), im_info,
+        feature_stride=STRIDE, scales=SCALES, ratios=RATIOS,
+        rpn_pre_nms_top_n=64, rpn_post_nms_top_n=POST_NMS,
+        rpn_min_size=4, threshold=0.7, name="rois")
+
+    target = sym.Custom(rois, gt_boxes, op_type="toy_proposal_target",
+                        batch_size=str(batch_size), name="ptarget")
+    rois_out, label, bbox_target, bbox_weight = (
+        target[0], target[1], target[2], target[3])
+
+    # stage 2: ROI head
+    pooled = sym.ROIPooling(body, sym.BlockGrad(rois_out),
+                            pooled_size=(4, 4), spatial_scale=1.0 / STRIDE,
+                            name="roi_pool")
+    flat = sym.Flatten(pooled)
+    fc = sym.Activation(sym.FullyConnected(flat, num_hidden=128, name="fc6"),
+                        act_type="relu")
+    cls_score = sym.FullyConnected(fc, num_hidden=NUM_CLASSES,
+                                   name="cls_score")
+    cls_prob = sym.SoftmaxOutput(cls_score, sym.BlockGrad(label),
+                                 normalization="batch", name="cls_prob")
+    bbox_pred = sym.FullyConnected(fc, num_hidden=4 * NUM_CLASSES,
+                                   name="bbox_pred")
+    bbox_l = sym.smooth_l1((bbox_pred - bbox_target) * bbox_weight,
+                           scalar=1.0, name="bbox_l1")
+    bbox_loss = sym.MakeLoss(sym.sum(bbox_l) / batch_size, grad_scale=1.0,
+                             name="bbox_loss")
+
+    return sym.Group([rpn_cls_prob, rpn_bbox_loss, cls_prob, bbox_loss,
+                      sym.BlockGrad(rois_out), sym.BlockGrad(label)])
+
+
+# ---------------------------------------------------------------------------
+
+
+def toy_ap(mod, it, gts, batch_size):
+    """AP@0.5 proxy: fraction of images whose highest-scoring roi
+    (by P(square)) overlaps gt at IoU>=0.5."""
+    hits, total = 0, 0
+    it.reset()
+    for bi, batch in enumerate(it):
+        mod.forward(batch, is_train=False)
+        outs = [o.asnumpy() for o in mod.get_outputs()]
+        cls_prob, rois_out = outs[2], outs[4]
+        for b in range(batch_size):
+            idx = bi * batch_size + b
+            if idx >= len(gts):
+                break
+            rows = slice(b * ROIS_PER_IMG, (b + 1) * ROIS_PER_IMG)
+            scores = cls_prob[rows, 1]
+            boxes = rois_out[rows, 1:]
+            best = boxes[scores.argmax()][None]
+            iou = iou_matrix(best, gts[idx][None, 1:])[0, 0]
+            hits += iou >= 0.5
+            total += 1
+    return hits / max(total, 1)
+
+
+def main():
+    mx.random.seed(0)
+    rs = np.random.RandomState(0)
+    n, batch_size = 64, 4
+    data, gts, lab, dlt, wts = build_dataset(rs, n)
+    im_info = np.tile(np.array([[HW, HW, 1.0]], np.float32), (n, 1))
+
+    it = mx.io.NDArrayIter(
+        {"data": data, "gt_boxes": gts, "im_info": im_info},
+        {"rpn_label": lab, "rpn_bbox_target": dlt, "rpn_bbox_weight": wts},
+        batch_size=batch_size)
+
+    net = get_symbol_train(batch_size)
+    mod = mx.mod.Module(
+        net, context=mx.context.current_context(),
+        data_names=("data", "gt_boxes", "im_info"),
+        label_names=("rpn_label", "rpn_bbox_target", "rpn_bbox_weight"))
+    mod.fit(it, num_epoch=10, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.02, "momentum": 0.9,
+                              "wd": 5e-4},
+            initializer=mx.init.Xavier(),
+            eval_metric=mx.metric.Loss(output_names=["bbox_loss_output"],
+                                       label_names=[]))
+
+    # held-out evaluation: fresh scenes the model never trained on
+    ev_data, ev_gts, ev_lab, ev_dlt, ev_wts = build_dataset(rs, 32)
+    ev_info = np.tile(np.array([[HW, HW, 1.0]], np.float32), (32, 1))
+    ev_it = mx.io.NDArrayIter(
+        {"data": ev_data, "gt_boxes": ev_gts, "im_info": ev_info},
+        {"rpn_label": ev_lab, "rpn_bbox_target": ev_dlt,
+         "rpn_bbox_weight": ev_wts},
+        batch_size=batch_size)
+    ap = toy_ap(mod, ev_it, ev_gts[:, 0], batch_size)
+    print("toy AP@0.5 = %.3f" % ap)
+    assert ap >= 0.6, "two-stage detector failed the AP sanity bar: %f" % ap
+    print("train_frcnn_toy example OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -578,11 +578,15 @@ def _ctc_loss(attrs, data, label):
     logprobs = jax.nn.log_softmax(data, axis=-1)
     blank = 0 if attrs.blank_label == "first" else C - 1
     lab = label.astype(jnp.int32)
-    if attrs.blank_label == "last":
-        pass  # labels already 0-based
+    if attrs.blank_label == "first":
+        # channel 0 is blank; label VALUES are channel indices (1-based
+        # alphabet), 0 marks padding — no shift (shifting by -1 would
+        # collide class 1 with the blank channel)
+        lab = jnp.where(lab == 0, -1, lab)
     else:
-        lab = lab - 1  # reference: first-blank mode uses 1-based labels? keep 0-pad
-        lab = jnp.where(label.astype(jnp.int32) == 0, -1, lab)
+        # 'last': labels are 0-based channel indices, C-1 is blank;
+        # negative values mark padding
+        lab = jnp.where(lab < 0, -1, lab)
     L = lab.shape[1]
     # extended label sequence with blanks: length 2L+1
     ext = jnp.full((N, 2 * L + 1), blank, dtype=jnp.int32)
@@ -614,7 +618,14 @@ def _ctc_loss(attrs, data, label):
         jnp.take_along_axis(logprobs[0], ext[:, 1:2], axis=1)[:, 0], neg_inf))
     alpha, _ = jax.lax.scan(step, alpha0, logprobs[1:])
     last = jnp.take_along_axis(alpha, (ext_len - 1)[:, None], axis=1)[:, 0]
-    last2 = jnp.take_along_axis(alpha, (ext_len - 2)[:, None], axis=1)[:, 0]
+    # empty (all-padding) label rows have ext_len == 1: there is no
+    # "ended on the final symbol" state, and ext_len-2 == -1 would wrap
+    last2 = jnp.where(
+        lab_len > 0,
+        jnp.take_along_axis(alpha,
+                            jnp.maximum(ext_len - 2, 0)[:, None],
+                            axis=1)[:, 0],
+        neg_inf)
     ll = jnp.logaddexp(last, last2)
     return -ll
 

@@ -23,6 +23,8 @@ EXAMPLES = [
     ("recommenders/matrix_factorization.py",
      "matrix_factorization example OK"),
     ("detection/train_ssd_toy.py", "train_ssd_toy example OK"),
+    ("detection/train_frcnn_toy.py", "train_frcnn_toy example OK"),
+    ("speech_recognition/train_ctc_toy.py", "train_ctc_toy example OK"),
 ]
 
 

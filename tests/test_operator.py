@@ -397,3 +397,42 @@ def test_deformable_psroi_trans_shifts_window():
     # x-shift: 0.1 * 1.0 * roi_width(=4) = 0.4
     assert shifted[0, 0, 0, 0] > base[0, 0, 0, 0]
     assert abs((shifted - base)[0, 0, 0, 0] - 0.4) < 1e-4
+
+
+def test_ctc_loss_matches_brute_force():
+    """CTCLoss against exhaustive path enumeration (the defining
+    semantics): sum over all T-length paths that collapse to the label,
+    blank_label='first' (channel 0 blank, labels 1-based, 0 padding)."""
+    import itertools
+    rs = np.random.RandomState(3)
+    T, N, C = 5, 3, 4          # 3 real classes (1..3) + blank 0
+    acts = rs.normal(0, 1.5, (T, N, C)).astype(np.float32)
+    labels = np.array([[1, 2, 0], [3, 3, 0], [2, 0, 0]], np.float32)
+
+    out = mx.nd.CTCLoss(mx.nd.array(acts), mx.nd.array(labels)).asnumpy()
+
+    probs = np.exp(acts) / np.exp(acts).sum(-1, keepdims=True)
+    for n in range(N):
+        want_seq = [int(v) for v in labels[n] if v > 0]
+        total = 0.0
+        for path in itertools.product(range(C), repeat=T):
+            collapsed = [k for k, g in itertools.groupby(path) if k != 0]
+            if collapsed == want_seq:
+                p = 1.0
+                for t, ch in enumerate(path):
+                    p *= probs[t, n, ch]
+                total += p
+        np.testing.assert_allclose(out[n], -np.log(total), rtol=1e-4)
+
+
+def test_ctc_loss_empty_label_row():
+    """An all-padding label row means 'emit only blanks': the loss must
+    equal -log P(all-blank path), not a wrapped-index overcount."""
+    rs = np.random.RandomState(5)
+    T, N, C = 6, 2, 3
+    acts = rs.normal(0, 1.0, (T, N, C)).astype(np.float32)
+    labels = np.array([[1, 2], [0, 0]], np.float32)   # row 1 is empty
+    out = mx.nd.CTCLoss(mx.nd.array(acts), mx.nd.array(labels)).asnumpy()
+    probs = np.exp(acts) / np.exp(acts).sum(-1, keepdims=True)
+    want = -np.log(np.prod(probs[:, 1, 0]))           # all-blank path
+    np.testing.assert_allclose(out[1], want, rtol=1e-5)
