@@ -1,0 +1,79 @@
+"""Adversarial examples via FGSM (reference example/adversary/ role):
+train a digit classifier, then perturb inputs along the sign of
+d(loss)/d(input) — the gradient flows to the DATA through the
+executor's inputs_need_grad binding.  A small epsilon must collapse
+accuracy (clean >= 0.9 -> adversarial <= 0.5), demonstrating both the
+attack and the input-gradient plumbing.
+
+Run: python example/adversary/fgsm.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def get_symbol():
+    sym = mx.sym
+    net = sym.Variable("data")
+    net = sym.FullyConnected(net, num_hidden=64, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=10, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def load_digits_split():
+    from sklearn.datasets import load_digits
+    raw = load_digits()
+    x = (raw.images.astype(np.float32) / 16.0).reshape(len(raw.target), -1)
+    y = raw.target.astype(np.float32)
+    order = np.random.RandomState(3).permutation(len(y))
+    x, y = x[order], y[order]
+    return (x[:1400], y[:1400]), (x[1400:], y[1400:])
+
+
+def main():
+    mx.random.seed(0)
+    (x_tr, y_tr), (x_te, y_te) = load_digits_split()
+    it = mx.io.NDArrayIter(x_tr, y_tr, batch_size=64, shuffle=True,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(get_symbol(), context=mx.context.current_context())
+    mod.fit(it, num_epoch=15, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.init.Xavier(), eval_metric="acc")
+    args, auxs = mod.get_params()
+
+    # adversarial executor: same net, grad flows to the input
+    batch = len(y_te)
+    exe = get_symbol().simple_bind(mx.context.current_context(),
+                                   data=(batch, x_te.shape[1]),
+                                   softmax_label=(batch,),
+                                   grad_req={"data": "write"})
+    exe.copy_params_from(args, auxs)
+    exe.arg_dict["data"][:] = mx.nd.array(x_te)
+    exe.arg_dict["softmax_label"][:] = mx.nd.array(y_te)
+    exe.forward(is_train=True)
+    clean_acc = float((exe.outputs[0].asnumpy().argmax(1) == y_te).mean())
+    exe.backward()
+    sign = np.sign(exe.grad_dict["data"].asnumpy())
+
+    eps = 0.15
+    x_adv = np.clip(x_te + eps * sign, 0, 1)
+    exe.arg_dict["data"][:] = mx.nd.array(x_adv)
+    exe.forward(is_train=False)
+    adv_acc = float((exe.outputs[0].asnumpy().argmax(1) == y_te).mean())
+
+    print("clean acc %.3f -> FGSM(eps=%.2f) acc %.3f"
+          % (clean_acc, eps, adv_acc))
+    assert clean_acc >= 0.9, clean_acc
+    assert adv_acc <= 0.5, adv_acc
+    print("fgsm example OK")
+
+
+if __name__ == "__main__":
+    main()

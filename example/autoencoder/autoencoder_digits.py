@@ -1,0 +1,100 @@
+"""Stacked autoencoder (reference example/autoencoder/ role): encoder
+64->32->8, decoder mirroring back to 64, trained with
+LinearRegressionOutput against the input itself on the real bundled
+scanned digits; then the 8-d code must linearly separate digit
+identity far better than chance (a probe classifier on frozen codes).
+
+CI bars: reconstruction MSE <= 0.025 (the 8-d bottleneck's
+practical limit on 64-d inputs of variance ~0.09); probe acc >= 0.75.
+
+Run: python example/autoencoder/autoencoder_digits.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def autoencoder_symbol():
+    sym = mx.sym
+    data = sym.Variable("data")
+    enc = sym.Activation(sym.FullyConnected(data, num_hidden=32,
+                                            name="enc1"), act_type="relu")
+    code = sym.FullyConnected(enc, num_hidden=8, name="code")
+    dec = sym.Activation(sym.FullyConnected(code, num_hidden=32,
+                                            name="dec1"), act_type="relu")
+    recon = sym.FullyConnected(dec, num_hidden=64, name="recon")
+    out = sym.LinearRegressionOutput(recon, sym.Variable("recon_label"),
+                                     name="recon_out")
+    return mx.sym.Group([out, sym.BlockGrad(code, name="code_tap")])
+
+
+def main():
+    mx.random.seed(0)
+    from sklearn.datasets import load_digits
+    raw = load_digits()
+    x = (raw.images.astype(np.float32) / 16.0).reshape(len(raw.target), -1)
+    y = raw.target
+    order = np.random.RandomState(2).permutation(len(y))
+    x, y = x[order], y[order]
+
+    it = mx.io.NDArrayIter(x, {"recon_label": x}, batch_size=128,
+                           shuffle=True)
+    mod = mx.mod.Module(autoencoder_symbol(), label_names=("recon_label",),
+                        context=mx.context.current_context())
+    mod.fit(it, num_epoch=40, optimizer="adam",
+            optimizer_params={"learning_rate": 2e-3},
+            initializer=mx.init.Xavier(),
+            eval_metric=mx.metric.MSE(output_names=["recon_out_output"],
+                                      label_names=["recon_label"]))
+
+    # reconstruction error + frozen codes over the whole set — through
+    # an UNSHUFFLED iterator so code rows line up with y's order
+    it = mx.io.NDArrayIter(x, {"recon_label": x}, batch_size=128)
+    it.reset()
+    recon_err, codes, labels = [], [], []
+    for batch in it:
+        mod.forward(batch, is_train=False)
+        outs = mod.get_outputs()
+        recon = outs[0].asnumpy()
+        want = batch.label[0].asnumpy()
+        pad = batch.pad or 0
+        keep = recon.shape[0] - pad
+        recon_err.append(((recon - want) ** 2).mean(1)[:keep])
+        codes.append(outs[1].asnumpy()[:keep])
+        labels.append(want[:keep])
+    mse = float(np.concatenate(recon_err).mean())
+    codes = np.concatenate(codes)
+    digit_of = y[:len(codes)]
+
+    # linear probe on the 8-d codes
+    probe_it = mx.io.NDArrayIter(codes[:1400],
+                                 digit_of[:1400].astype(np.float32),
+                                 batch_size=64, shuffle=True,
+                                 label_name="softmax_label")
+    probe = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=10,
+                              name="probe_fc"), name="softmax")
+    pmod = mx.mod.Module(probe, context=mx.context.current_context())
+    pmod.fit(probe_it, num_epoch=30, optimizer="adam",
+             optimizer_params={"learning_rate": 5e-3},
+             initializer=mx.init.Xavier(), eval_metric="acc")
+    va_it = mx.io.NDArrayIter(codes[1400:],
+                              digit_of[1400:].astype(np.float32),
+                              batch_size=64, label_name="softmax_label")
+    probe_acc = dict(pmod.score(va_it, "acc"))["accuracy"]
+
+    print("reconstruction MSE %.4f; 8-d code linear probe acc %.3f"
+          % (mse, probe_acc))
+    assert mse <= 0.025, mse
+    assert probe_acc >= 0.75, probe_acc
+    print("autoencoder example OK")
+
+
+if __name__ == "__main__":
+    main()

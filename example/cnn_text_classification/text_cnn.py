@@ -1,0 +1,82 @@
+"""CNN text classification (reference example/cnn_text_classification/
+text_cnn.py role, CI-sized): the Kim-2014 architecture — Embedding,
+parallel conv branches of widths 2/3/4 over time, max-over-time pooling,
+concat, dropout, dense softmax — on a synthetic sentiment task.
+
+Sentences are token streams over a 60-word vocabulary; class 1
+sentences contain at least one token from a small "positive" set,
+class 0 from a "negative" set, amid shared filler (so classification
+requires spotting keyword n-grams, which is exactly what the
+max-over-time conv does).  CI bar: >= 0.9 held-out accuracy.
+
+Run: python example/cnn_text_classification/text_cnn.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+VOCAB, SEQ, EMBED = 60, 20, 16
+POS_TOKENS = (50, 51, 52)
+NEG_TOKENS = (55, 56, 57)
+
+
+def synth_sentence(rs):
+    toks = rs.randint(1, 50, SEQ)
+    cls = rs.randint(0, 2)
+    marker = rs.choice(POS_TOKENS if cls else NEG_TOKENS)
+    toks[rs.randint(SEQ)] = marker
+    return toks.astype(np.float32), float(cls)
+
+
+def get_symbol():
+    sym = mx.sym
+    data = sym.Variable("data")                       # (N, SEQ)
+    emb = sym.Embedding(data, input_dim=VOCAB, output_dim=EMBED,
+                        name="embed")                 # (N, SEQ, EMBED)
+    emb = sym.Reshape(emb, shape=(0, 1, SEQ, EMBED))  # (N, 1, T, E)
+    pooled = []
+    for width in (2, 3, 4):
+        conv = sym.Convolution(emb, kernel=(width, EMBED), num_filter=32,
+                               name="conv%d" % width)
+        act = sym.Activation(conv, act_type="relu")
+        pool = sym.Pooling(act, kernel=(SEQ - width + 1, 1),
+                           pool_type="max")           # max over time
+        pooled.append(sym.Flatten(pool))
+    body = sym.Concat(*pooled, dim=1)
+    body = sym.Dropout(body, p=0.3)
+    fc = sym.FullyConnected(body, num_hidden=2, name="fc")
+    return sym.SoftmaxOutput(fc, name="softmax")
+
+
+def main():
+    mx.random.seed(0)
+    rs = np.random.RandomState(0)
+    n = 512
+    rows = [synth_sentence(rs) for _ in range(n)]
+    data = np.stack([d for d, _ in rows])
+    label = np.array([l for _, l in rows], np.float32)
+    n_tr = 384
+    it_tr = mx.io.NDArrayIter(data[:n_tr], label[:n_tr], batch_size=32,
+                              shuffle=True, label_name="softmax_label")
+    it_va = mx.io.NDArrayIter(data[n_tr:], label[n_tr:], batch_size=32,
+                              label_name="softmax_label")
+
+    mod = mx.mod.Module(get_symbol(), context=mx.context.current_context())
+    mod.fit(it_tr, num_epoch=8, optimizer="adam",
+            optimizer_params={"learning_rate": 5e-3},
+            initializer=mx.init.Xavier(),
+            eval_metric="acc")
+    acc = dict(mod.score(it_va, "acc"))["accuracy"]
+    print("held-out accuracy: %.3f" % acc)
+    assert acc >= 0.9, acc
+    print("text_cnn example OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,124 @@
+"""Neural style transfer (reference example/neural-style/ role, CI-sized):
+optimize an IMAGE, not weights — content features from a deep layer,
+style as Gram matrices over shallow layers, with d(loss)/d(image) taken
+by the imperative autograd engine (x.attach_grad / autograd.record /
+loss.backward) and Adam stepping the pixels.
+
+A compact conv feature stack stands in for VGG-19 (this host has no
+pretrained weights and no egress; fixed random filters are the
+classical random-feature variant of style transfer and keep the example
+self-contained).  CI bar: 80 optimization steps must cut the combined
+style+content objective by >= 5x.
+
+Run: python example/neural_style/neural_style.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+HW = 64
+CHANNELS = (16, 32, 64)
+
+
+def make_filters(rs):
+    ws = []
+    cin = 3
+    for nf in CHANNELS:
+        fan = cin * 9
+        ws.append(mx.nd.array(
+            rs.normal(0, np.sqrt(2.0 / fan), (nf, cin, 3, 3))
+            .astype(np.float32)))
+        cin = nf
+    return ws
+
+
+def features(x, ws):
+    """Style taps after conv1/conv2, content tap after conv3."""
+    taps = []
+    body = x
+    for i, w in enumerate(ws):
+        body = mx.nd.Convolution(body, w, kernel=(3, 3), pad=(1, 1),
+                                 num_filter=CHANNELS[i], no_bias=True)
+        body = mx.nd.relu(body)
+        taps.append(body)
+        if i < len(ws) - 1:
+            body = mx.nd.Pooling(body, kernel=(2, 2), stride=(2, 2),
+                                 pool_type="avg")
+    return taps
+
+
+def gram(feat):
+    c = feat.shape[1]
+    f = feat.reshape((c, -1))
+    return mx.nd.dot(f, f, transpose_b=True) / float(f.size)
+
+
+def images():
+    """Content: diagonal gradient scene; style: high-frequency checkers."""
+    yy, xx = np.mgrid[0:HW, 0:HW] / HW
+    content = np.stack([yy * 0.8, xx * 0.8, (yy + xx) / 2 * 0.8]) \
+        .astype(np.float32)[None]
+    checker = ((np.mgrid[0:HW, 0:HW] // 4).sum(0) % 2).astype(np.float32)
+    style = np.stack([checker, 1 - checker, checker * 0.5])[None] \
+        .astype(np.float32)
+    return content, style
+
+
+def main():
+    mx.random.seed(0)
+    rs = np.random.RandomState(0)
+    content_img, style_img = images()
+    ws = make_filters(rs)
+
+    style_grams = [gram(f).asnumpy()
+                   for f in features(mx.nd.array(style_img), ws)[:2]]
+    content_ref = features(mx.nd.array(content_img), ws)[2].asnumpy()
+
+    style_w, content_w = 1.0, 0.2
+
+    def objective_and_grad(img):
+        x = mx.nd.array(img)
+        x.attach_grad()
+        with autograd.record():
+            taps = features(x, ws)
+            loss = None
+            for g_ref, tap in zip(style_grams, taps[:2]):
+                diff = gram(tap) - mx.nd.array(g_ref)
+                term = style_w * mx.nd.sum(diff * diff)
+                loss = term if loss is None else loss + term
+            cdiff = taps[2] - mx.nd.array(content_ref)
+            loss = loss + content_w * mx.nd.sum(cdiff * cdiff)
+        loss.backward()
+        return float(loss.asscalar()), x.grad.asnumpy()
+
+    img = content_img.copy() + rs.normal(0, 0.05, content_img.shape) \
+        .astype(np.float32)
+    first = None
+    lr, m, v = 0.02, np.zeros_like(img), np.zeros_like(img)
+    for it in range(80):            # Adam on the image itself
+        loss, grad = objective_and_grad(img)
+        if first is None:
+            first = loss
+        m = 0.9 * m + 0.1 * grad
+        v = 0.999 * v + 0.001 * grad * grad
+        mh = m / (1 - 0.9 ** (it + 1))
+        vh = v / (1 - 0.999 ** (it + 1))
+        img -= lr * mh / (np.sqrt(vh) + 1e-8)
+        img = np.clip(img, -0.2, 1.2)
+        if it % 20 == 0:
+            print("step %2d  objective %.4f" % (it, loss))
+    final, _ = objective_and_grad(img)
+    print("objective: %.4f -> %.4f (%.1fx)" % (first, final, first / final))
+    assert final < first / 5, (first, final)
+    print("neural_style example OK")
+
+
+if __name__ == "__main__":
+    main()

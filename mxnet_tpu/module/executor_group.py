@@ -268,10 +268,21 @@ class DataParallelExecutorGroup:
         return [[ex.grad_dict[n] for ex in self.execs] for n in data_names]
 
     def update_metric(self, eval_metric, labels):
-        """reference executor_group.py:583"""
+        """Route through update_dict with real names (reference
+        executor_group.py:583) so metrics constructed with
+        output_names/label_names select the right tensors; unnamed
+        metrics see every output/label exactly as before."""
+        out_names = self.symbol.list_outputs()
         outputs = self.get_outputs(merge_multi_context=True)
-        n_vis = len(self.symbol.list_outputs())
-        eval_metric.update(labels, outputs[:n_vis])
+        if not self.label_shapes and labels:
+            # bound without label schema (predict-mode bind) yet scored
+            # with iterator labels: no names to route by — positional
+            eval_metric.update(labels, outputs[:len(out_names)])
+            return
+        pred_dict = dict(zip(out_names, outputs[:len(out_names)]))
+        label_names = [l.name for l in (self.label_shapes or [])]
+        label_dict = dict(zip(label_names, labels or []))
+        eval_metric.update_dict(label_dict, pred_dict)
 
     def install_monitor(self, mon):
         for ex in self.execs:

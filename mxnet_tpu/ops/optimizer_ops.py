@@ -38,7 +38,7 @@ def _prep_grad_wd(attrs, grad, weight):
 
 @register("sgd_update", inputs=("weight", "grad"),
           params=dict(_COMMON, lazy_update=attr_bool(True)),
-          writeback={0: 0})
+          writeback={0: 0}, dynamic_params=("lr", "wd", "rescale_grad"))
 def _sgd_update(attrs, weight, grad):
     g = _prep_grad(attrs, grad)
     return weight - attrs.lr * (g + attrs.wd * weight)
@@ -47,7 +47,7 @@ def _sgd_update(attrs, weight, grad):
 @register("sgd_mom_update", inputs=("weight", "grad", "mom"),
           params=dict(_COMMON, momentum=attr_float(0.0),
                       lazy_update=attr_bool(True)),
-          num_outputs=2, num_visible_outputs=1, writeback={0: 0, 2: 1})
+          num_outputs=2, num_visible_outputs=1, writeback={0: 0, 2: 1}, dynamic_params=("lr", "wd", "rescale_grad"))
 def _sgd_mom_update(attrs, weight, grad, mom):
     g = _prep_grad(attrs, grad)
     new_mom = attrs.momentum * mom - attrs.lr * (g + attrs.wd * weight)
@@ -56,7 +56,7 @@ def _sgd_mom_update(attrs, weight, grad, mom):
 
 @register("mp_sgd_update", inputs=("weight", "grad", "weight32"),
           params=dict(_COMMON, lazy_update=attr_bool(True)),
-          num_outputs=2, num_visible_outputs=1, writeback={0: 0, 2: 1})
+          num_outputs=2, num_visible_outputs=1, writeback={0: 0, 2: 1}, dynamic_params=("lr", "wd", "rescale_grad"))
 def _mp_sgd_update(attrs, weight, grad, weight32):
     g = _prep_grad(attrs, grad.astype(jnp.float32))
     new_w32 = weight32 - attrs.lr * (g + attrs.wd * weight32)
@@ -67,7 +67,7 @@ def _mp_sgd_update(attrs, weight, grad, weight32):
           params=dict(_COMMON, momentum=attr_float(0.0),
                       lazy_update=attr_bool(True)),
           num_outputs=3, num_visible_outputs=1,
-          writeback={0: 0, 2: 1, 3: 2})
+          writeback={0: 0, 2: 1, 3: 2}, dynamic_params=("lr", "wd", "rescale_grad"))
 def _mp_sgd_mom_update(attrs, weight, grad, mom, weight32):
     g = _prep_grad(attrs, grad.astype(jnp.float32))
     new_mom = attrs.momentum * mom - attrs.lr * (g + attrs.wd * weight32)
@@ -79,7 +79,7 @@ def _mp_sgd_mom_update(attrs, weight, grad, mom, weight32):
           params=dict(_COMMON, beta1=attr_float(0.9), beta2=attr_float(0.999),
                       epsilon=attr_float(1e-8), lazy_update=attr_bool(True)),
           num_outputs=3, num_visible_outputs=1,
-          writeback={0: 0, 2: 1, 3: 2})
+          writeback={0: 0, 2: 1, 3: 2}, dynamic_params=("lr", "wd", "rescale_grad"))
 def _adam_update(attrs, weight, grad, mean, var):
     g = _prep_grad_wd(attrs, grad, weight)
     new_mean = attrs.beta1 * mean + (1 - attrs.beta1) * g
@@ -91,7 +91,7 @@ def _adam_update(attrs, weight, grad, mean, var):
 @register("rmsprop_update", inputs=("weight", "grad", "n"),
           params=dict(_COMMON, gamma1=attr_float(0.95), epsilon=attr_float(1e-8),
                       clip_weights=attr_float(-1.0)),
-          num_outputs=2, num_visible_outputs=1, writeback={0: 0, 2: 1})
+          num_outputs=2, num_visible_outputs=1, writeback={0: 0, 2: 1}, dynamic_params=("lr", "wd", "rescale_grad"))
 def _rmsprop_update(attrs, weight, grad, n):
     g = _prep_grad_wd(attrs, grad, weight)
     new_n = (1 - attrs.gamma1) * g * g + attrs.gamma1 * n
@@ -105,7 +105,8 @@ def _rmsprop_update(attrs, weight, grad, n):
           params=dict(_COMMON, gamma1=attr_float(0.95), gamma2=attr_float(0.9),
                       epsilon=attr_float(1e-8), clip_weights=attr_float(-1.0)),
           num_outputs=4, num_visible_outputs=1,
-          writeback={0: 0, 2: 1, 3: 2, 4: 3})
+          writeback={0: 0, 2: 1, 3: 2, 4: 3},
+          dynamic_params=("lr", "wd", "rescale_grad", "t"))
 def _rmspropalex_update(attrs, weight, grad, n, g_state, delta):
     g = _prep_grad_wd(attrs, grad, weight)
     new_n = (1 - attrs.gamma1) * g * g + attrs.gamma1 * n
@@ -121,7 +122,7 @@ def _rmspropalex_update(attrs, weight, grad, n, g_state, delta):
 @register("ftrl_update", inputs=("weight", "grad", "z", "n"),
           params=dict(_COMMON, lamda1=attr_float(0.01), beta=attr_float(1.0)),
           num_outputs=3, num_visible_outputs=1,
-          writeback={0: 0, 2: 1, 3: 2})
+          writeback={0: 0, 2: 1, 3: 2}, dynamic_params=("lr", "wd", "rescale_grad"))
 def _ftrl_update(attrs, weight, grad, z, n):
     g = _prep_grad(attrs, grad)
     new_n = n + g * g
@@ -136,7 +137,7 @@ def _ftrl_update(attrs, weight, grad, z, n):
 
 
 @register("signsgd_update", inputs=("weight", "grad"),
-          params=dict(_COMMON), writeback={0: 0})
+          params=dict(_COMMON), writeback={0: 0}, dynamic_params=("lr", "wd", "rescale_grad"))
 def _signsgd_update(attrs, weight, grad):
     g = _prep_grad(attrs, grad)
     return weight - attrs.lr * (jnp.sign(g) + attrs.wd * weight)
@@ -145,7 +146,7 @@ def _signsgd_update(attrs, weight, grad):
 @register("signum_update", inputs=("weight", "grad", "mom"),
           params=dict(_COMMON, momentum=attr_float(0.0),
                       wd_lh=attr_float(0.0)),
-          num_outputs=2, num_visible_outputs=1, writeback={0: 0, 2: 1})
+          num_outputs=2, num_visible_outputs=1, writeback={0: 0, 2: 1}, dynamic_params=("lr", "wd", "rescale_grad"))
 def _signum_update(attrs, weight, grad, mom):
     g = _prep_grad(attrs, grad)
     new_mom = attrs.momentum * mom - (1 - attrs.momentum) * (
@@ -278,13 +279,15 @@ def _multi_mp_sgd_mom_update(attrs, *args):
                       rescale_grad=attr_float(1.0),
                       clip_grad=attr_float(-1.0)),
           num_outputs=4, num_visible_outputs=1,
-          writeback={0: 0, 2: 1, 3: 2, 4: 3})
+          writeback={0: 0, 2: 1, 3: 2, 4: 3},
+          dynamic_params=("lr", "wd", "rescale_grad", "t"))
 def _ftml_update(attrs, weight, grad, d, v, z):
     """FTML optimizer step (reference optimizer_op-inl.h:633 FTMLKernel)."""
     g = attrs.rescale_grad * grad + attrs.wd * weight
     if attrs.clip_grad >= 0:
         g = jnp.clip(g, -attrs.clip_grad, attrs.clip_grad)
-    b1, b2, t = attrs.beta1, attrs.beta2, float(attrs.t)
+    # t is a traced per-step input (dynamic_params): no float()
+    b1, b2, t = attrs.beta1, attrs.beta2, attrs.t * 1.0
     v_new = b2 * v + (1 - b2) * jnp.square(g)
     d_t = (1 - b1 ** t) / attrs.lr * (
         jnp.sqrt(v_new / (1 - b2 ** t)) + attrs.epsilon)

@@ -72,6 +72,7 @@ class Operator:
                  variadic: bool = False,
                  writeback: Optional[Dict[int, int]] = None,
                  aux_inputs: Sequence[int] = (),
+                 dynamic_params: Sequence[str] = (),
                  doc: str = ""):
         self.name = name
         self.fn = fn
@@ -94,6 +95,10 @@ class Operator:
         # ListAuxiliaryStates): not arguments, not differentiated, updated
         # via writeback.  E.g. BatchNorm's moving_mean/moving_var.
         self.aux_inputs = tuple(aux_inputs)
+        # Scalar attrs traced as jit INPUTS instead of cache-key statics:
+        # per-step values (scheduled lr, Adam's bias-corrected lr, wd)
+        # must not recompile the op on every step.
+        self.dynamic_params = tuple(dynamic_params)
         self.doc = doc
 
     # -- schema ----------------------------------------------------------
@@ -153,7 +158,7 @@ class Operator:
 def register(name: str, *, params=None, inputs=("data",), num_outputs=1,
              num_visible_outputs=None, needs_rng=False, mode_dependent=False,
              mutate_inputs=(), variadic=False, writeback=None, aux_inputs=(),
-             aliases=()):
+             dynamic_params=(), aliases=()):
     """Decorator registering ``fn(attrs, *arrays)`` as operator `name`."""
 
     def deco(fn):
@@ -163,6 +168,7 @@ def register(name: str, *, params=None, inputs=("data",), num_outputs=1,
                       needs_rng=needs_rng, mode_dependent=mode_dependent,
                       mutate_inputs=mutate_inputs, variadic=variadic,
                       writeback=writeback, aux_inputs=aux_inputs,
+                      dynamic_params=dynamic_params,
                       doc=fn.__doc__ or "")
         if name in _REGISTRY:
             raise MXNetError("Operator %s already registered" % name)
@@ -208,9 +214,34 @@ def _jitted(op_name: str, attr_key) -> Callable:
     return jax.jit(call)
 
 
+@functools.lru_cache(maxsize=None)
+def _jitted_dynamic(op_name: str, static_key, dyn_names) -> Callable:
+    """Jitted closure where the named scalar attrs arrive as traced
+    arguments: one compile serves every value of a per-step hyperparam
+    (scheduled lr, Adam bias correction), where keying them statically
+    would compile a fresh program EVERY optimizer step."""
+    op = get_op(op_name)
+    base = AttrDict(static_key)
+
+    def call(dyn_vals, *arrays):
+        attrs = AttrDict(base)
+        attrs.update(zip(dyn_names, dyn_vals))
+        return op.fn(attrs, *arrays)
+
+    return jax.jit(call)
+
+
 def jitted_apply(op: Operator, attrs: AttrDict) -> Callable:
     """Cached jitted callable for (op, attrs)."""
-    return _jitted(op.name, attrs.key())
+    dyn_names = tuple(n for n in op.dynamic_params
+                      if isinstance(attrs.get(n), (int, float))
+                      and not isinstance(attrs.get(n), bool))
+    if not dyn_names:
+        return _jitted(op.name, attrs.key())
+    dyn_vals = tuple(float(attrs[n]) for n in dyn_names)
+    static = AttrDict({k: v for k, v in attrs.items() if k not in dyn_names})
+    fn = _jitted_dynamic(op.name, static.key(), dyn_names)
+    return functools.partial(fn, dyn_vals)
 
 
 def apply_op(op: Operator, attrs: AttrDict, *arrays):

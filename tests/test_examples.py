@@ -25,6 +25,13 @@ EXAMPLES = [
     ("detection/train_ssd_toy.py", "train_ssd_toy example OK"),
     ("detection/train_frcnn_toy.py", "train_frcnn_toy example OK"),
     ("speech_recognition/train_ctc_toy.py", "train_ctc_toy example OK"),
+    ("neural_style/neural_style.py", "neural_style example OK"),
+    ("reinforcement_learning/dqn_gridworld.py", "dqn_gridworld example OK"),
+    ("cnn_text_classification/text_cnn.py", "text_cnn example OK"),
+    ("adversary/fgsm.py", "fgsm example OK"),
+    ("multi_task/multi_task_digits.py", "multi_task example OK"),
+    ("autoencoder/autoencoder_digits.py", "autoencoder example OK"),
+    ("bi_lstm_sort/bi_lstm_sort.py", "bi_lstm_sort example OK"),
 ]
 
 
