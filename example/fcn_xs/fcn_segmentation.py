@@ -1,0 +1,99 @@
+"""Fully-convolutional segmentation (reference example/fcn-xs/ role,
+CI-sized): conv encoder downsamples 4x, Deconvolution (transposed conv,
+bilinear-initialized like the reference fcn-xs init scheme) upsamples
+back to full resolution, per-pixel SoftmaxOutput (multi_output) trains
+the mask.
+
+Synthetic scenes: bright squares and dark discs on noise; each pixel
+labeled background/square/disc.  CI bar: >= 0.9 held-out mean pixel
+accuracy.
+
+Run: python example/fcn_xs/fcn_segmentation.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+HW = 32
+CLASSES = 3            # bg / square / disc
+
+
+def synthetic_scene(rs):
+    img = rs.uniform(0, 0.15, (3, HW, HW)).astype(np.float32)
+    mask = np.zeros((HW, HW), np.float32)
+    for cls in (1, 2):
+        size = rs.randint(HW // 4, HW // 2)
+        x = rs.randint(0, HW - size)
+        y = rs.randint(0, HW - size)
+        if cls == 1:
+            img[:, y:y + size, x:x + size] += 0.7
+            mask[y:y + size, x:x + size] = 1
+        else:
+            yy, xx = np.mgrid[0:size, 0:size]
+            disc = ((yy - size / 2) ** 2 + (xx - size / 2) ** 2
+                    <= (size / 2) ** 2)
+            img[:, y:y + size, x:x + size] -= 0.5 * disc
+            mask[y:y + size, x:x + size] = np.where(
+                disc, 2, mask[y:y + size, x:x + size])
+    return img, mask
+
+
+def get_symbol():
+    sym = mx.sym
+    data = sym.Variable("data")
+    body = sym.Activation(
+        sym.Convolution(data, kernel=(3, 3), pad=(1, 1), num_filter=24,
+                        name="conv1"), act_type="relu")
+    body = sym.Pooling(body, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    body = sym.Activation(
+        sym.Convolution(body, kernel=(3, 3), pad=(1, 1), num_filter=48,
+                        name="conv2"), act_type="relu")
+    body = sym.Pooling(body, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    score = sym.Convolution(body, kernel=(1, 1), num_filter=CLASSES,
+                            name="score")
+    # 4x transposed-conv upsample back to full resolution (fcn-xs
+    # bigscore layer; weights bilinear-initialized below)
+    up = sym.Deconvolution(score, kernel=(8, 8), stride=(4, 4), pad=(2, 2),
+                           num_filter=CLASSES, num_group=CLASSES,
+                           no_bias=True, name="bigscore")
+    return sym.SoftmaxOutput(up, multi_output=True, use_ignore=True,
+                             ignore_label=-1, normalization="valid",
+                             name="softmax")
+
+
+def main():
+    mx.random.seed(0)
+    rs = np.random.RandomState(0)
+    n, batch = 96, 8
+    scenes = [synthetic_scene(rs) for _ in range(n)]
+    data = np.stack([i for i, _ in scenes])
+    masks = np.stack([m for _, m in scenes])
+    n_tr = 80
+    it_tr = mx.io.NDArrayIter(data[:n_tr], masks[:n_tr], batch_size=batch,
+                              shuffle=True, label_name="softmax_label")
+    it_va = mx.io.NDArrayIter(data[n_tr:], masks[n_tr:], batch_size=batch,
+                              label_name="softmax_label")
+
+    mod = mx.mod.Module(get_symbol(), context=mx.context.current_context())
+    mod.fit(it_tr, num_epoch=50, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.2, "momentum": 0.9,
+                              "wd": 1e-4},
+            initializer=mx.init.Mixed(
+                ["bigscore_weight", ".*"],
+                [mx.init.Bilinear(), mx.init.Xavier()]),
+            eval_metric="acc")
+
+    acc = dict(mod.score(it_va, "acc"))["accuracy"]
+    print("held-out mean pixel accuracy: %.3f" % acc)
+    assert acc >= 0.9, acc
+    print("fcn_segmentation example OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -120,6 +120,14 @@ def _deconvolution(attrs, x, w, bias=None):
     the reference shares with Convolution ((C_in, C_out/g, kH, kW))."""
     nd, stride, dilate, pad = _conv_nd(attrs, x)
     spatial = "DHW"[-nd:]
+    g = attrs.num_group
+    if g > 1:
+        # XLA grouped conv wants rhs (C_in/g, g*C_out/g, ...): regroup the
+        # reference's (C_in, C_out/g, ...) block layout along the O dim
+        cin = w.shape[0]
+        w = w.reshape((g, cin // g) + w.shape[1:]) \
+            .transpose((1, 0, 2) + tuple(range(3, 3 + nd))) \
+            .reshape((cin // g, g * w.shape[1]) + w.shape[2:])
     dn = jax.lax.conv_dimension_numbers(
         x.shape, w.shape, ("NC" + spatial, "IO" + spatial, "NC" + spatial))
     adj = attrs.adj or (0,) * nd

@@ -32,6 +32,9 @@ EXAMPLES = [
     ("multi_task/multi_task_digits.py", "multi_task example OK"),
     ("autoencoder/autoencoder_digits.py", "autoencoder example OK"),
     ("bi_lstm_sort/bi_lstm_sort.py", "bi_lstm_sort example OK"),
+    ("svm/svm_digits.py", "svm_digits example OK"),
+    ("fcn_xs/fcn_segmentation.py", "fcn_segmentation example OK"),
+    ("vae/vae_digits.py", "vae example OK"),
 ]
 
 

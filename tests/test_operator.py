@@ -436,3 +436,23 @@ def test_ctc_loss_empty_label_row():
     probs = np.exp(acts) / np.exp(acts).sum(-1, keepdims=True)
     want = -np.log(np.prod(probs[:, 1, 0]))           # all-blank path
     np.testing.assert_allclose(out[1], want, rtol=1e-5)
+
+
+def test_grouped_deconvolution_matches_per_group():
+    """Grouped transposed conv (reference deconvolution-inl.h group
+    semantics: block-diagonal (C_in, C_out/g) weights) must equal
+    running each group densely and concatenating."""
+    rs = np.random.RandomState(0)
+    x = mx.nd.array(rs.rand(2, 4, 5, 5).astype(np.float32))
+    w = mx.nd.array(rs.rand(4, 2, 3, 3).astype(np.float32))
+    got = mx.nd.Deconvolution(x, w, kernel=(3, 3), stride=(2, 2),
+                              pad=(1, 1), num_filter=4, num_group=2,
+                              no_bias=True).asnumpy()
+    parts = []
+    for i in range(2):
+        xi = mx.nd.array(x.asnumpy()[:, i * 2:(i + 1) * 2])
+        wi = mx.nd.array(w.asnumpy()[i * 2:(i + 1) * 2])
+        parts.append(mx.nd.Deconvolution(
+            xi, wi, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+            num_filter=2, num_group=1, no_bias=True).asnumpy())
+    np.testing.assert_allclose(got, np.concatenate(parts, 1), atol=1e-5)
