@@ -1,0 +1,99 @@
+"""Noise-contrastive estimation for large-vocabulary softmax (reference
+example/nce-loss/ role, CI-sized): instead of a full-vocab softmax, each
+step scores the true next token against k sampled noise tokens with a
+shared output embedding, trained as binary logistic discrimination —
+the cheap large-V trick.
+
+A bigram language ("every token deterministically selects its
+successor" plus noise) is learned with NCE; evaluation then runs the
+FULL softmax ranking with the same trained embeddings and must place
+the true successor in the top-1 for >= 80% of contexts — proving the
+NCE-trained embeddings encode the full-vocab distribution.
+
+Run: python example/nce_loss/nce_lm.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+VOCAB, EMBED, K_NOISE = 200, 24, 8
+
+
+def make_bigram_data(rs, n=6000):
+    succ = rs.permutation(VOCAB)          # token v -> succ[v]
+    ctx = rs.randint(0, VOCAB, n)
+    nxt = np.where(rs.rand(n) < 0.9, succ[ctx],
+                   rs.randint(0, VOCAB, n))
+    return ctx.astype(np.int64), nxt.astype(np.int64), succ
+
+
+class NCEModel(gluon.Block):
+    def __init__(self):
+        super().__init__()
+        self.in_embed = gluon.nn.Embedding(VOCAB, EMBED)
+        self.out_embed = gluon.nn.Embedding(VOCAB, EMBED)
+
+    def scores(self, ctx_tok, cand_toks):
+        """(N,) contexts x (N, C) candidates -> (N, C) dot scores."""
+        h = self.in_embed(ctx_tok)                      # (N, E)
+        w = self.out_embed(cand_toks)                   # (N, C, E)
+        return mx.nd.sum(w * h.reshape((-1, 1, EMBED)), axis=2)
+
+    def forward(self, ctx_tok, cand_toks):
+        return self.scores(ctx_tok, cand_toks)
+
+
+def main():
+    mx.random.seed(0)
+    rs = np.random.RandomState(0)
+    ctx_toks, nxt_toks, succ = make_bigram_data(rs)
+
+    model = NCEModel()
+    model.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(model.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    bce = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+
+    batch = 128
+    for epoch in range(8):
+        perm = rs.permutation(len(ctx_toks))
+        total = 0.0
+        for i in range(0, len(perm) - batch + 1, batch):
+            rows = perm[i:i + batch]
+            # candidates: column 0 = true token, then K noise draws
+            cands = np.concatenate(
+                [nxt_toks[rows][:, None],
+                 rs.randint(0, VOCAB, (batch, K_NOISE))], axis=1)
+            target = np.zeros((batch, 1 + K_NOISE), np.float32)
+            target[:, 0] = 1.0
+            c = mx.nd.array(ctx_toks[rows].astype(np.float32))
+            cd = mx.nd.array(cands.astype(np.float32))
+            with autograd.record():
+                s = model(c, cd)
+                loss = bce(s, mx.nd.array(target))
+            loss.backward()
+            trainer.step(batch)
+            total += float(loss.mean().asscalar())
+        print("epoch %d nce loss %.4f" % (epoch, total / (len(perm) // batch)))
+
+    # full-softmax evaluation with the SAME embeddings
+    all_ids = mx.nd.array(np.arange(VOCAB, dtype=np.float32))
+    out_w = model.out_embed(all_ids).asnumpy()          # (V, E)
+    ctx_eval = np.arange(VOCAB, dtype=np.float32)
+    h = model.in_embed(mx.nd.array(ctx_eval)).asnumpy()  # (V, E)
+    ranks = (h @ out_w.T).argmax(1)
+    top1 = float((ranks == succ).mean())
+    print("full-vocab top-1 successor accuracy: %.3f" % top1)
+    assert top1 >= 0.8, top1
+    print("nce_lm example OK")
+
+
+if __name__ == "__main__":
+    main()

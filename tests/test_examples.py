@@ -35,6 +35,8 @@ EXAMPLES = [
     ("svm/svm_digits.py", "svm_digits example OK"),
     ("fcn_xs/fcn_segmentation.py", "fcn_segmentation example OK"),
     ("vae/vae_digits.py", "vae example OK"),
+    ("time_series/lstm_forecast.py", "lstm_forecast example OK"),
+    ("nce_loss/nce_lm.py", "nce_lm example OK"),
 ]
 
 
