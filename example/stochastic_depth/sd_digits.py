@@ -1,0 +1,110 @@
+"""Stochastic-depth residual training (reference
+example/stochastic-depth/sd_cifar10.py role, CI-sized): residual blocks
+are randomly bypassed during training (in-graph Bernoulli via the
+framework's RNG-carrying uniform op), scaled by survival probability at
+test time — regularization that also shortens the effective backprop
+path.
+
+Like the reference, train and eval use DIFFERENT symbols over shared
+weights: the stochastic graph trains (inverted scaling by the survival
+probability), and a deterministic expectation graph — plain residual —
+evaluates.  CI bars: >= 0.93 held-out accuracy through the eval graph,
+and the training graph's forwards must actually vary (the gate is
+live).
+
+Run: python example/stochastic_depth/sd_digits.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+BLOCKS, HIDDEN = 4, 96
+SURVIVE = 0.8
+
+
+def residual_block(body, idx, batch_size):
+    sym = mx.sym
+    branch = sym.Activation(
+        sym.FullyConnected(body, num_hidden=HIDDEN,
+                           name="blk%d_fc" % idx), act_type="relu")
+    # per-sample Bernoulli gate, drawn in-graph; scaled like inverted
+    # dropout so eval (gate==expectation) needs no weight rescale
+    u = sym.random.uniform(0.0, 1.0, shape=(batch_size, 1),
+                           name="blk%d_gate" % idx)
+    gate = u < SURVIVE                              # per-sample Bernoulli
+    branch = sym.broadcast_mul(branch, gate / SURVIVE)
+    return body + branch
+
+
+def get_symbol(batch_size, stochastic=True):
+    sym = mx.sym
+    body = sym.Activation(
+        sym.FullyConnected(sym.Variable("data"), num_hidden=HIDDEN,
+                           name="stem"), act_type="relu")
+    for i in range(BLOCKS):
+        if stochastic:
+            body = residual_block(body, i, batch_size)
+        else:
+            branch = sym.Activation(
+                sym.FullyConnected(body, num_hidden=HIDDEN,
+                                   name="blk%d_fc" % i), act_type="relu")
+            body = body + branch
+    head = sym.FullyConnected(body, num_hidden=10, name="head")
+    return sym.SoftmaxOutput(head, name="softmax")
+
+
+def main():
+    mx.random.seed(0)
+    np.random.seed(0)   # NDArrayIter(shuffle=True) uses the global RNG
+    from sklearn.datasets import load_digits
+    raw = load_digits()
+    x = (raw.images.astype(np.float32) / 16.0).reshape(len(raw.target), -1)
+    y = raw.target.astype(np.float32)
+    order = np.random.RandomState(6).permutation(len(y))
+    x, y = x[order], y[order]
+    n_tr, batch = 1400, 64
+
+    it_tr = mx.io.NDArrayIter(x[:n_tr], y[:n_tr], batch_size=batch,
+                              shuffle=True, label_name="softmax_label")
+    it_va = mx.io.NDArrayIter(x[n_tr:], y[n_tr:], batch_size=batch,
+                              label_name="softmax_label")
+    mod = mx.mod.Module(get_symbol(batch),
+                        context=mx.context.current_context())
+    mod.fit(it_tr, num_epoch=35, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.2, "momentum": 0.9,
+                              "wd": 1e-4},
+            initializer=mx.init.Xavier(), eval_metric="acc")
+
+    # deterministic expectation graph over the SAME weights for eval
+    args, auxs = mod.get_params()
+    emod = mx.mod.Module(get_symbol(batch, stochastic=False),
+                         context=mx.context.current_context())
+    emod.bind(data_shapes=it_va.provide_data,
+              label_shapes=it_va.provide_label, for_training=False)
+    emod.set_params(args, auxs)
+    acc = dict(emod.score(it_va, "acc"))["accuracy"]
+
+    # the training graph's gate must be LIVE (forwards vary)
+    it_va.reset()
+    probe = next(iter(it_va))
+    outs = []
+    for _ in range(3):
+        mod.forward(probe, is_train=True)
+        outs.append(mod.get_outputs()[0].asnumpy())
+    train_var = float(np.var(np.stack(outs), axis=0).mean())
+
+    print("held-out acc %.3f (deterministic eval graph); "
+          "train-fwd variance %.2e" % (acc, train_var))
+    assert acc >= 0.93, acc
+    assert train_var > 1e-8, train_var
+    print("sd_digits example OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -37,6 +37,8 @@ EXAMPLES = [
     ("vae/vae_digits.py", "vae example OK"),
     ("time_series/lstm_forecast.py", "lstm_forecast example OK"),
     ("nce_loss/nce_lm.py", "nce_lm example OK"),
+    ("stochastic_depth/sd_digits.py", "sd_digits example OK"),
+    ("bayesian_methods/sgld_regression.py", "sgld_regression example OK"),
 ]
 
 
