@@ -1,0 +1,120 @@
+"""Captcha-style OCR (reference example/captcha/ role): variable-length
+digit strings rendered as ONE image (real bundled scanned digits
+composed side by side), read by a conv encoder whose column features
+feed CTCLoss — image-to-sequence transcription with no per-character
+segmentation labels.
+
+CI bar: greedy CTC decoding must exactly transcribe >= 80% of held-out
+strings.
+
+Run: python example/captcha/ocr_ctc.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+MAX_DIGITS = 4
+CELL = 8                       # each scanned digit is 8x8
+WIDTH = MAX_DIGITS * CELL      # fixed canvas, right-padded
+CLASSES = 11                   # blank + digits 0-9 (labels 1..10)
+
+
+def build_strings(rs, images, targets, n):
+    rows = []
+    for _ in range(n):
+        k = rs.randint(2, MAX_DIGITS + 1)
+        picks = rs.randint(0, len(targets), k)
+        canvas = np.zeros((CELL, WIDTH), np.float32)
+        for j, p in enumerate(picks):
+            canvas[:, j * CELL:(j + 1) * CELL] = images[p]
+        label = np.zeros(MAX_DIGITS, np.float32)
+        label[:k] = targets[picks] + 1          # 1-based; 0 = blank/pad
+        rows.append((canvas[None], label))
+    return (np.stack([c for c, _ in rows]),
+            np.stack([l for _, l in rows]))
+
+
+def get_symbol():
+    sym = mx.sym
+    data = sym.Variable("data")                 # (N, 1, CELL, WIDTH)
+    body = sym.Activation(
+        sym.Convolution(data, kernel=(3, 3), pad=(1, 1), num_filter=16,
+                        name="conv1"), act_type="relu")
+    body = sym.Activation(
+        sym.Convolution(body, kernel=(3, 3), pad=(1, 1), num_filter=32,
+                        name="conv2"), act_type="relu")
+    # pool the height away entirely; width (reading order) survives
+    body = sym.Pooling(body, kernel=(CELL, 1), pool_type="max")
+    # (N, C, 1, W) -> per-column feature sequence (N, W, C)
+    seq = sym.transpose(sym.Reshape(body, shape=(0, 0, -1)),
+                        axes=(0, 2, 1))
+    cell = mx.rnn.BidirectionalCell(mx.rnn.LSTMCell(48, prefix="readf_"),
+                                    mx.rnn.LSTMCell(48, prefix="readb_"))
+    outputs, _ = cell.unroll(WIDTH, seq, layout="NTC", merge_outputs=True)
+    head = sym.FullyConnected(outputs, num_hidden=CLASSES, flatten=False,
+                              name="head")
+    acts = sym.swapaxes(head, dim1=0, dim2=1)   # (T, N, C)
+    ctc = sym.MakeLoss(sym.CTCLoss(acts, sym.Variable("label"),
+                                   name="ctc"), name="ctc_loss")
+    probs = sym.BlockGrad(sym.softmax(head, axis=-1), name="frame_probs")
+    return mx.sym.Group([ctc, probs])
+
+
+def greedy_decode(prob_tc):
+    path = prob_tc.argmax(-1)
+    out, prev = [], -1
+    for p in path:
+        if p != prev and p != 0:
+            out.append(int(p))
+        prev = p
+    return out
+
+
+def main():
+    mx.random.seed(0)
+    np.random.seed(0)
+    rs = np.random.RandomState(0)
+    from sklearn.datasets import load_digits
+    raw = load_digits()
+    images = raw.images.astype(np.float32) / 16.0
+    data, labels = build_strings(rs, images, raw.target, 1024)
+    n_tr = 896
+
+    it = mx.io.NDArrayIter(data[:n_tr], {"label": labels[:n_tr]},
+                           batch_size=32, shuffle=True)
+    mod = mx.mod.Module(get_symbol(), data_names=("data",),
+                        label_names=("label",),
+                        context=mx.context.current_context())
+    mod.fit(it, num_epoch=30, optimizer="adam",
+            optimizer_params={"learning_rate": 4e-3},
+            initializer=mx.init.Xavier(),
+            eval_metric=mx.metric.Loss(output_names=["ctc_loss_output"],
+                                       label_names=[]))
+
+    ev = mx.io.NDArrayIter(data[n_tr:], {"label": labels[n_tr:]},
+                           batch_size=32)
+    hit = total = 0
+    for batch in ev:
+        mod.forward(batch, is_train=False)
+        probs = mod.get_outputs()[1].asnumpy()
+        labs = batch.label[0].asnumpy()
+        pad = batch.pad or 0
+        for n in range(probs.shape[0] - pad):
+            want = [int(v) for v in labs[n] if v > 0]
+            hit += greedy_decode(probs[n]) == want
+            total += 1
+    acc = hit / max(total, 1)
+    print("held-out exact transcription: %.3f over %d strings"
+          % (acc, total))
+    assert acc >= 0.80, acc
+    print("ocr_ctc example OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -39,6 +39,7 @@ EXAMPLES = [
     ("nce_loss/nce_lm.py", "nce_lm example OK"),
     ("stochastic_depth/sd_digits.py", "sd_digits example OK"),
     ("bayesian_methods/sgld_regression.py", "sgld_regression example OK"),
+    ("captcha/ocr_ctc.py", "ocr_ctc example OK"),
 ]
 
 
