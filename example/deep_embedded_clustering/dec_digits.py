@@ -1,0 +1,149 @@
+"""Deep Embedded Clustering (reference
+example/deep-embedded-clustering/ role): pretrain an autoencoder on the
+real bundled scanned digits, seed centroids from label-free k-means,
+then refine encoder + centroids jointly by matching the Student-t soft
+assignment to its own sharpened target distribution (the DEC KL
+objective) through the imperative autograd engine.
+
+CI bars: the DEC refinement must lift cluster accuracy by >= 3 points
+over its own initialization and reach >= 0.70 (best one-to-one
+cluster->digit mapping; labels are used for EVALUATION only).
+
+Run: python example/deep_embedded_clustering/dec_digits.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+LATENT, K = 10, 10
+
+
+def cluster_accuracy(assign, truth):
+    """Greedy best one-to-one cluster->digit mapping accuracy."""
+    counts = np.zeros((K, 10), np.int64)
+    for a, t in zip(assign, truth):
+        counts[a, int(t)] += 1
+    remaining = set(range(10))
+    total = 0
+    for k in np.argsort(-counts.max(1)):
+        if not remaining:
+            break
+        best = max(remaining, key=lambda d: counts[k, d])
+        total += counts[k, best]
+        remaining.discard(best)
+    return total / len(assign)
+
+
+def kmeans(points, rs, iters=30):
+    centers = points[rs.choice(len(points), K, replace=False)]
+    for _ in range(iters):
+        d = ((points[:, None] - centers[None]) ** 2).sum(-1)
+        assign = d.argmin(1)
+        for k in range(K):
+            mine = points[assign == k]
+            if len(mine):
+                centers[k] = mine.mean(0)
+    return centers, assign
+
+
+def pretrain_autoencoder(x, rs):
+    enc = gluon.nn.HybridSequential()
+    enc.add(gluon.nn.Dense(128, activation="relu"),
+            gluon.nn.Dense(LATENT))
+    dec = gluon.nn.HybridSequential()
+    dec.add(gluon.nn.Dense(128, activation="relu"),
+            gluon.nn.Dense(x.shape[1]))
+    enc.initialize(mx.init.Xavier())
+    dec.initialize(mx.init.Xavier())
+    params = list(enc.collect_params().values()) + \
+        list(dec.collect_params().values())
+    trainer = gluon.Trainer({p.name: p for p in params}, "adam",
+                            {"learning_rate": 2e-3})
+    l2 = gluon.loss.L2Loss()
+    batch = 128
+    for epoch in range(60):
+        perm = rs.permutation(len(x))
+        for i in range(0, len(perm) - batch + 1, batch):
+            xb = mx.nd.array(x[perm[i:i + batch]])
+            with autograd.record():
+                loss = l2(dec(enc(xb)), xb)
+            loss.backward()
+            trainer.step(batch)
+    return enc
+
+
+def refine(enc, x, centers, iters=120, target_every=20):
+    """DEC: minimize KL(P || Q) with Q the Student-t assignment and P
+    its sharpened (squared, cluster-normalized) self-target."""
+    mu = mx.nd.array(centers.astype(np.float32))
+    mu.attach_grad()
+    trainer = gluon.Trainer(enc.collect_params(), "adam",
+                            {"learning_rate": 1e-3})
+    p_nd = logp_nd = None
+    xb = mx.nd.array(x)          # loop-invariant: one h2d transfer
+    for it in range(iters):
+        with autograd.record():
+            z = enc(xb)
+            d2 = mx.nd.sum(
+                (z.reshape((-1, 1, LATENT)) -
+                 mu.reshape((1, K, LATENT))) ** 2, axis=2)
+            q = 1.0 / (1.0 + d2)
+            q = q / mx.nd.sum(q, axis=1, keepdims=True)
+            if it % target_every == 0:       # refresh the fixed target
+                qn = q.asnumpy()
+                p = (qn ** 2) / qn.sum(0, keepdims=True)
+                p = p / p.sum(1, keepdims=True)
+                p_nd = mx.nd.array(p)
+                logp_nd = mx.nd.array(np.log(p + 1e-12))
+            kl = mx.nd.sum(p_nd * (logp_nd
+                                   - mx.nd.log(q + 1e-12))) / len(x)
+        kl.backward()
+        # kl is already the per-sample mean: no further batch scaling
+        trainer.step(1)
+        mu[:] = mu - 0.1 * mu.grad
+        mu.attach_grad()
+    return mu.asnumpy()
+
+
+def main():
+    mx.random.seed(0)
+    np.random.seed(0)
+    rs = np.random.RandomState(0)
+    from sklearn.datasets import load_digits
+    raw = load_digits()
+    x = (raw.images.astype(np.float32) / 16.0).reshape(len(raw.target), -1)
+    y = raw.target
+
+    enc = pretrain_autoencoder(x, rs)
+    codes = enc(mx.nd.array(x)).asnumpy()
+
+    # label-free centroid seed: k-means in pixel space, means in code space
+    _, assign_raw = kmeans(x.copy(), rs)
+    centers = np.stack([
+        codes[assign_raw == k].mean(0) if (assign_raw == k).any()
+        else codes[rs.randint(len(codes))]            # empty-cluster guard
+        for k in range(K)])
+    base_assign = ((codes[:, None] - centers[None]) ** 2).sum(-1).argmin(1)
+    base_acc = cluster_accuracy(base_assign, y)
+
+    mu = refine(enc, x, centers)
+
+    codes = enc(mx.nd.array(x)).asnumpy()
+    final_assign = ((codes[:, None] - mu[None]) ** 2).sum(-1).argmin(1)
+    final_acc = cluster_accuracy(final_assign, y)
+    print("cluster accuracy: init %.3f -> DEC-refined %.3f"
+          % (base_acc, final_acc))
+    assert final_acc >= 0.70 and final_acc >= base_acc + 0.03, \
+        (base_acc, final_acc)
+    print("dec_digits example OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -40,6 +40,7 @@ EXAMPLES = [
     ("stochastic_depth/sd_digits.py", "sd_digits example OK"),
     ("bayesian_methods/sgld_regression.py", "sgld_regression example OK"),
     ("captcha/ocr_ctc.py", "ocr_ctc example OK"),
+    ("deep_embedded_clustering/dec_digits.py", "dec_digits example OK"),
 ]
 
 
