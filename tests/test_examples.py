@@ -41,6 +41,7 @@ EXAMPLES = [
     ("bayesian_methods/sgld_regression.py", "sgld_regression example OK"),
     ("captcha/ocr_ctc.py", "ocr_ctc example OK"),
     ("deep_embedded_clustering/dec_digits.py", "dec_digits example OK"),
+    ("dsd/dsd_digits.py", "dsd_digits example OK"),
 ]
 
 
