@@ -1,12 +1,14 @@
 """Dense-Sparse-Dense training (reference example/dsd/ role): train
 dense, prune the smallest half of each weight matrix to exact zero and
-retrain under the sparsity mask (applied after every update), then
-restore dense training from the sparse solution — the DSD
-regularization schedule (Han et al. 2016).
+retrain with the mask re-applied after every UPDATE (a batch-end
+callback zeroes the pruned slots, so the sparse phase genuinely trains
+under the mask), then restore dense training from the sparse solution —
+the DSD regularization schedule (Han et al. 2016).
 
 CI bars: the sparse phase must hold >= 50% exact zeros while still
 classifying (>= 0.9), and the final re-densified model must be at least
-as accurate as the first dense pass on held-out real digit scans.
+as accurate as the first dense pass on held-out real digit scans
+(within 0.5 points, the run-to-run wobble of the 397-sample val set).
 
 Run: python example/dsd/dsd_digits.py
 """
@@ -33,20 +35,21 @@ def get_symbol():
 
 
 def fit_phase(mod, it, epochs, masks=None):
-    """One training phase; masks (name -> 0/1 array) re-applied after
-    every epoch so pruned weights stay exactly zero."""
-    for _ in range(epochs):
-        mod.fit(it, num_epoch=1, optimizer="sgd",
-                optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
-                                  "wd": 1e-4},
-                initializer=mx.init.Xavier(), force_init=False,
-                force_rebind=False, eval_metric="acc")
-        if masks:
-            args, auxs = mod.get_params()
-            pruned = {n: (mx.nd.array(a.asnumpy() * masks[n])
-                          if n in masks else a)
-                      for n, a in args.items()}
-            mod.set_params(pruned, auxs)
+    """One training phase; with masks (name -> 0/1 array), a batch-end
+    callback zeroes the pruned weight slots after EVERY update."""
+    def reapply(_param):
+        args, auxs = mod.get_params()
+        pruned = {n: (mx.nd.array(a.asnumpy() * masks[n])
+                      if n in masks else a)
+                  for n, a in args.items()}
+        mod.set_params(pruned, auxs)
+
+    mod.fit(it, num_epoch=epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                              "wd": 1e-4},
+            initializer=mx.init.Xavier(), force_init=False,
+            force_rebind=False, eval_metric="acc",
+            batch_end_callback=reapply if masks else None)
 
 
 def accuracy(mod, it):
@@ -93,7 +96,7 @@ def main():
 
     print("dense %.3f -> sparse %.3f (%.0f%% zeros) -> re-dense %.3f"
           % (dense_acc, sparse_acc, 100 * zero_frac, final_acc))
-    assert zero_frac >= 0.45, zero_frac
+    assert zero_frac >= 0.5, zero_frac
     assert sparse_acc >= 0.9, sparse_acc
     assert final_acc >= dense_acc - 0.005, (dense_acc, final_acc)
     print("dsd_digits example OK")
