@@ -1,0 +1,121 @@
+"""Capsule network with dynamic routing (reference example/capsnet/
+role, CI-sized): conv features -> primary capsules (8-d vectors,
+squashed) -> digit capsules (16-d) via 3 iterations of routing by
+agreement, margin loss on capsule lengths — all in imperative Gluon
+autograd (the routing loop is plain tensor code).
+
+CI bar: >= 0.9 held-out accuracy on the real bundled scanned digits,
+with capsule length as the class score.
+
+Run: python example/capsnet/capsnet_digits.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+PRIMARY, PDIM = 16, 8       # primary capsules x their dimension
+NCLASS, DDIM = 10, 16       # digit capsules x their dimension
+ROUTING_ITERS = 3
+
+
+def squash(v, axis):
+    n2 = mx.nd.sum(v * v, axis=axis, keepdims=True)
+    return v * (n2 / (1.0 + n2)) / mx.nd.sqrt(n2 + 1e-9)
+
+
+class CapsNet(gluon.Block):
+    def __init__(self):
+        super().__init__()
+        self.conv = gluon.nn.Conv2D(32, kernel_size=3, padding=1,
+                                    activation="relu")
+        self.primary = gluon.nn.Dense(PRIMARY * PDIM)
+        # one (PDIM -> DDIM) transform per (primary, digit) pair
+        self.W = gluon.Parameter(
+            "caps_W", shape=(1, PRIMARY, NCLASS, DDIM, PDIM),
+            init=mx.init.Normal(0.05))
+        self.W.initialize()
+
+    def forward(self, x):
+        n = x.shape[0]
+        feats = self.conv(x).reshape((n, -1))
+        u = squash(self.primary(feats).reshape((n, PRIMARY, PDIM)), axis=2)
+        # prediction vectors u_hat[n, i, j, :] = W_ij @ u[n, i]
+        u_exp = u.reshape((n, PRIMARY, 1, 1, PDIM))
+        u_hat = mx.nd.sum(self.W.data() * u_exp, axis=4)  # (n,P,C,D)
+        # routing by agreement
+        b = mx.nd.zeros((n, PRIMARY, NCLASS, 1))
+        for it in range(ROUTING_ITERS):
+            c = mx.nd.softmax(b, axis=2)
+            s = mx.nd.sum(c * u_hat, axis=1)              # (n,C,D)
+            v = squash(s, axis=2)
+            if it < ROUTING_ITERS - 1:
+                agree = mx.nd.sum(
+                    u_hat * v.reshape((n, 1, NCLASS, DDIM)),
+                    axis=3, keepdims=True)
+                b = b + agree
+        return mx.nd.sqrt(mx.nd.sum(v * v, axis=2) + 1e-9)  # lengths
+
+
+def margin_loss(lengths, onehot):
+    pos = mx.nd.relu(0.9 - lengths) ** 2
+    neg = mx.nd.relu(lengths - 0.1) ** 2
+    return mx.nd.sum(onehot * pos + 0.5 * (1 - onehot) * neg, axis=1)
+
+
+def main():
+    mx.random.seed(0)
+    np.random.seed(0)
+    rs = np.random.RandomState(0)
+    from sklearn.datasets import load_digits
+    raw = load_digits()
+    x = (raw.images.astype(np.float32) / 16.0)[:, None, :, :]
+    y = raw.target
+    order = rs.permutation(len(y))
+    x, y = x[order], y[order]
+    n_tr, batch = 1400, 64
+
+    net = CapsNet()
+    net.conv.initialize(mx.init.Xavier())
+    net.primary.initialize(mx.init.Xavier())
+    params = {}
+    for blk in (net.conv, net.primary):
+        params.update(blk.collect_params())
+    params[net.W.name] = net.W
+    trainer = gluon.Trainer(params, "adam", {"learning_rate": 2e-3})
+
+    onehot = np.eye(NCLASS, dtype=np.float32)
+    for epoch in range(12):
+        perm = rs.permutation(n_tr)
+        total = 0.0
+        for i in range(0, n_tr - batch + 1, batch):
+            rows = perm[i:i + batch]
+            xb = mx.nd.array(x[rows])
+            tb = mx.nd.array(onehot[y[rows]])
+            with autograd.record():
+                lengths = net(xb)
+                loss = margin_loss(lengths, tb)
+            loss.backward()
+            trainer.step(batch)
+            total += float(loss.mean().asscalar())
+        print("epoch %d margin loss %.4f" % (epoch, total / (n_tr // batch)))
+
+    hits = 0
+    for i in range(n_tr, len(y), batch):
+        xb = mx.nd.array(x[i:i + batch])
+        pred = net(xb).asnumpy().argmax(1)
+        hits += int((pred == y[i:i + batch]).sum())
+    acc = hits / (len(y) - n_tr)
+    print("held-out accuracy (capsule lengths): %.3f" % acc)
+    assert acc >= 0.9, acc
+    print("capsnet example OK")
+
+
+if __name__ == "__main__":
+    main()

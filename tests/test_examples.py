@@ -42,6 +42,7 @@ EXAMPLES = [
     ("captcha/ocr_ctc.py", "ocr_ctc example OK"),
     ("deep_embedded_clustering/dec_digits.py", "dec_digits example OK"),
     ("dsd/dsd_digits.py", "dsd_digits example OK"),
+    ("capsnet/capsnet_digits.py", "capsnet example OK"),
 ]
 
 
