@@ -104,6 +104,7 @@ def _transformer_main(as_dict=False, batch=None, iters=None):
         from mxnet_tpu.parallel.trainer import sgd_step_fn
         step = sgd_step_fn(trainer)
     keys = trainer._keys()
+    guard = trainer._guard_arrays()
     key = jax.random.PRNGKey(0)
     data = jax.device_put(
         jax.random.randint(key, (gb, seq_len), 0, vocab)
@@ -113,11 +114,11 @@ def _transformer_main(as_dict=False, batch=None, iters=None):
         .astype(jnp.float32), spec.batch_sharding())
     batch_dict = {"data": data, "softmax_label": label}
     for _ in range(warmup):
-        params, mom, aux, loss = step(params, mom, aux, batch_dict, keys)
+        params, mom, aux, loss, _ok, guard = step(params, mom, aux, batch_dict, keys, guard)
     float(loss)
     t0 = time.perf_counter()
     for _ in range(iters):
-        params, mom, aux, loss = step(params, mom, aux, batch_dict, keys)
+        params, mom, aux, loss, _ok, guard = step(params, mom, aux, batch_dict, keys, guard)
     float(loss)
     dt = time.perf_counter() - t0
     tok_s = gb * seq_len * iters / dt / n_dev
@@ -184,6 +185,7 @@ def main():
         from mxnet_tpu.parallel.trainer import sgd_step_fn
         step = sgd_step_fn(trainer)
     keys = trainer._keys()
+    guard = trainer._guard_arrays()
     if not io_mode:
         # data generated on device — the tunnel must not be in the loop
         key = jax.random.PRNGKey(0)
@@ -243,6 +245,7 @@ def main():
             # this dev tunnel the shipping is the bottleneck (h2d collapses
             # to ~20MB/s once a large program has run — see PERF.md); on a
             # real TPU-VM host (PCIe DMA) the same loop is decode-bound.
+            nonlocal guard
             if n_iters <= 0:
                 return params, mom, aux
             done = 0
@@ -257,9 +260,9 @@ def main():
                 todo = host[0].shape[0]
                 for i in range(todo):
                     d, l = pick(X, L, jnp.int32(i))
-                    params, mom, aux, loss = step(
+                    params, mom, aux, loss, _ok, guard = step(
                         params, mom, aux,
-                        {"data": d, "softmax_label": l}, keys)
+                        {"data": d, "softmax_label": l}, keys, guard)
                 done += todo
                 if done < n_iters:
                     # overlaps device compute
@@ -273,13 +276,13 @@ def main():
         dt = time.perf_counter() - t0
     else:
         for _ in range(warmup):
-            params, mom, aux, loss = step(params, mom, aux, batch_dict, keys)
+            params, mom, aux, loss, _ok, guard = step(params, mom, aux, batch_dict, keys, guard)
         float(loss)  # full sync: block_until_ready alone does not drain the
         # remote-execution tunnel, giving impossibly fast (fake) timings
 
         t0 = time.perf_counter()
         for _ in range(iters):
-            params, mom, aux, loss = step(params, mom, aux, batch_dict, keys)
+            params, mom, aux, loss, _ok, guard = step(params, mom, aux, batch_dict, keys, guard)
         float(loss)  # end-of-chain sync; one tunnel round-trip amortized
         dt = time.perf_counter() - t0
 

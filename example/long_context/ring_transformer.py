@@ -18,7 +18,12 @@ import jax
 # must happen BEFORE the backend initializes (probing jax.default_backend
 # or jax.devices first would lock in a single CPU device)
 if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-    jax.config.update("jax_num_cpu_devices", 8)
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:   # pre-0.4.34 jax: only XLA_FLAGS works
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") +
+            " --xla_force_host_platform_device_count=8")
 
 import jax.numpy as jnp
 import numpy as np

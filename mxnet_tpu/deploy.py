@@ -3,34 +3,50 @@
 The TPU deploy unit (docs/deploy.md) is a compiled XLA executable plus
 its weights — the analog of the reference's amalgamation predictor
 (a single .so + symbol JSON + params blob).  ``export_compiled`` AOT-
-compiles an inference program and writes ONE self-describing file:
-
-    { magic, version, payload (serialized executable), in/out pytrees,
-      arg/aux names + input slots, params/aux as host numpy, out names }
+compiles an inference program and writes ONE self-describing file in the
+resilience container format (JSON header + raw numpy buffers + the
+serialized-executable bytes as an opaque blob, CRC32 everywhere —
+resilience/container.py).  There is NO pickle in the artifact: loading an
+untrusted file parses JSON and copies buffers, and the loader explicitly
+refuses pickle streams, so nothing in the container can execute code.
+The executable payload itself is only handed to XLA's deserializer after
+the container's integrity checks pass.
 
 ``ServedProgram.load`` deserializes and runs it WITHOUT the symbol
 layer, graph builder, or any tracing — jax.experimental
 .serialize_executable.deserialize_and_load hands back the executable
-directly.  The C ABI reaches this through MXPredCreateFromServed
-(capi.py pred_create_served), so a C consumer can run a trained model
-from the artifact alone.
+directly.  The input/output pytree structures are NOT stored in the file
+(they would need pickle); they are reconstructed from the arity counts in
+the header, which is possible because the compiled signature is always
+``fwd(params_tuple, inputs_tuple) -> outputs_tuple``.  The C ABI reaches
+this through MXPredCreateFromServed (capi.py pred_create_served), so a C
+consumer can run a trained model from the artifact alone.
 
 Caveat (inherent to XLA AOT): the artifact is compiled for a specific
 device kind + topology; load on matching hardware.
 """
 from __future__ import annotations
 
-import pickle
-
 import numpy as np
 
 from .base import MXNetError
+from .resilience.container import read_container, write_container
 
-_MAGIC = "mxnet_tpu-served-v1"
+_MAGIC = "mxnet_tpu-served-v2"
 
 
 def _to_host(arr):
     return np.asarray(arr)
+
+
+def _arity_trees(n_params, n_inputs, n_outputs):
+    """Rebuild the (in_tree, out_tree) pytree defs of the fixed compiled
+    signature from arity counts alone — the pickle-free treedef story."""
+    import jax
+    in_tree = jax.tree_util.tree_structure(
+        (((0,) * n_params, (0,) * n_inputs), {}))
+    out_tree = jax.tree_util.tree_structure((0,) * n_outputs)
+    return in_tree, out_tree
 
 
 def export_compiled(prog, const_args, aux, input_names, input_shapes,
@@ -76,52 +92,66 @@ def export_compiled(prog, const_args, aux, input_names, input_shapes,
     compiled = jax.jit(fwd).lower(param_structs, input_structs).compile()
     payload, in_tree, out_tree = serialize_executable.serialize(compiled)
 
-    bundle = {
+    # the loader rebuilds the treedefs from arity; prove at EXPORT time
+    # that the reconstruction matches what serialize() actually saw, so a
+    # mismatch fails loudly here, never at serving time
+    want_in, want_out = _arity_trees(len(param_names), len(input_names),
+                                     len(out_structs))
+    if (want_in, want_out) != (in_tree, out_tree):
+        raise MXNetError(
+            "export_compiled: compiled pytree structure %r/%r is not the "
+            "flat-tuple signature the served container encodes"
+            % (in_tree, out_tree))
+
+    meta = {
         "magic": _MAGIC,
-        "payload": payload,
-        "in_tree": in_tree,
-        "out_tree": out_tree,
         "param_names": param_names,
-        "params": {n: _to_host(const_args[n]) for n in param_names},
         "input_names": list(input_names),
-        "input_shapes": {n: tuple(input_shapes[n]) for n in input_names},
+        "input_shapes": {n: list(input_shapes[n]) for n in input_names},
         "input_dtypes": {n: np.dtype(input_dtypes.get(n, np.float32)).name
                          for n in input_names},
         "output_names": list(prog.out_names)
         if hasattr(prog, "out_names") else None,
         # static output schema: consumers size buffers before any forward
-        "output_shapes": [tuple(s.shape) for s in out_structs],
+        "output_shapes": [list(s.shape) for s in out_structs],
         "output_dtypes": [np.dtype(s.dtype).name for s in out_structs],
+        "n_outputs": len(out_structs),
     }
-    with open(path, "wb") as f:
-        pickle.dump(bundle, f)
+    arrays = {"param/%s" % n: _to_host(const_args[n]) for n in param_names}
+    write_container(path, arrays=arrays, meta=meta,
+                    blobs={"executable": payload})
     return path
 
 
 class ServedProgram:
     """A deserialized AOT executable + its weights; no tracing anywhere."""
 
-    def __init__(self, bundle):
+    def __init__(self, arrays, meta, blobs):
         import jax
         from jax.experimental import serialize_executable
-        if bundle.get("magic") != _MAGIC:
-            raise MXNetError("not a mxnet_tpu served-program file")
+        if meta.get("magic") != _MAGIC:
+            raise MXNetError("not a mxnet_tpu served-program file "
+                             "(magic %r)" % meta.get("magic"))
+        in_tree, out_tree = _arity_trees(
+            len(meta["param_names"]), len(meta["input_names"]),
+            int(meta["n_outputs"]))
         self._compiled = serialize_executable.deserialize_and_load(
-            bundle["payload"], bundle["in_tree"], bundle["out_tree"])
-        self.input_names = bundle["input_names"]
-        self.input_shapes = bundle["input_shapes"]
+            blobs["executable"], in_tree, out_tree)
+        self.input_names = meta["input_names"]
+        self.input_shapes = {n: tuple(s)
+                             for n, s in meta["input_shapes"].items()}
         self.input_dtypes = {n: np.dtype(d) for n, d
-                             in bundle["input_dtypes"].items()}
-        self.output_names = bundle.get("output_names")
+                             in meta["input_dtypes"].items()}
+        self.output_names = meta.get("output_names")
         self.output_shapes = [tuple(s) for s in
-                              bundle.get("output_shapes") or []]
-        self._params = tuple(jax.device_put(bundle["params"][n])
-                             for n in bundle["param_names"])
+                              meta.get("output_shapes") or []]
+        self._params = tuple(jax.device_put(arrays["param/%s" % n])
+                             for n in meta["param_names"])
 
     @classmethod
     def load(cls, path):
-        with open(path, "rb") as f:
-            return cls(pickle.load(f))
+        arrays, meta, blobs = read_container(path)
+        return cls(arrays, meta, blobs)
 
     def forward(self, **inputs):
         """Run the compiled program; returns a list of host numpy outputs."""

@@ -42,8 +42,13 @@ class Trainer:
 
     def __init__(self, params, optimizer, optimizer_params=None,
                  kvstore="device", compression_params=None,
-                 update_on_kvstore=None):
+                 update_on_kvstore=None, grad_guard=None):
         self._params = _as_param_list(params)
+        # resilience.GradientGuard (beyond-reference): skip non-finite
+        # steps, back a dynamic loss scale off, abort after a budget of
+        # consecutive bad steps.  Users scale their loss by guard.scale;
+        # the matching 1/scale lands in rescale_grad below.
+        self._grad_guard = grad_guard
         self._compression_params = compression_params
         kwargs = dict(optimizer_params or {})
         self._scale = float(kwargs.get("rescale_grad", 1.0))
@@ -94,9 +99,16 @@ class Trainer:
 
     def step(self, batch_size, ignore_stale_grad=False):
         """Reduce gradients (kvstore hop, when one exists) then apply the
-        optimizer — reference trainer.py:156."""
+        optimizer — reference trainer.py:156.  With a grad_guard, a
+        non-finite gradient step is skipped entirely (no reduce, no
+        update) and the guard's loss scale backs off."""
         store, on_kv = self._ready
-        self._optimizer.rescale_grad = self._scale / batch_size
+        guard = self._grad_guard
+        scale = guard.scale if guard is not None else 1.0
+        self._optimizer.rescale_grad = self._scale / batch_size / scale
+        if guard is not None and not guard.step(
+                [p.grad() for p in self._params if p.grad_req != "null"]):
+            return
         if not on_kv:
             self._reduce(store)
         self._apply(store, on_kv)

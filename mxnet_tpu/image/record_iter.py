@@ -76,12 +76,17 @@ class ImageRecordIter(DataIter):
                     num_parts=num_parts if have_idx else 1)
                 return
 
+        from ..resilience.retry import call_with_retry
         if have_idx:
-            self._rec = recordio.MXIndexedRecordIO(idx_path, path_imgrec, "r")
+            self._rec = call_with_retry(
+                recordio.MXIndexedRecordIO, idx_path, path_imgrec, "r",
+                exceptions=(OSError,), desc="open %s" % path_imgrec)
             keys = list(self._rec.keys)
         else:
             # sequential scan to index offsets
-            self._rec = recordio.MXRecordIO(path_imgrec, "r")
+            self._rec = call_with_retry(
+                recordio.MXRecordIO, path_imgrec, "r",
+                exceptions=(OSError,), desc="open %s" % path_imgrec)
             keys = None
         self._keys = keys
         if keys is not None and num_parts > 1:
@@ -119,14 +124,28 @@ class ImageRecordIter(DataIter):
         self._cursor = 0
 
     def _read_record(self):
+        """One raw record, retried with backoff on transient IO errors
+        (network filesystems drop reads under load; see resilience/retry).
+        The chaos ``io_error`` fault fires INSIDE the retried callable so
+        fault drills prove the retry path, not a mock of it."""
+        from ..resilience import chaos
+        from ..resilience.retry import call_with_retry
         with self._lock:
             if self._order is not None:
                 if self._cursor >= len(self._order):
                     return None
                 key = self._order[self._cursor]
                 self._cursor += 1
-                return self._rec.read_idx(key)
-            return self._rec.read()
+
+                def read_one():
+                    chaos.maybe_io_error("record %s" % key)
+                    return self._rec.read_idx(key)
+            else:
+                def read_one():
+                    chaos.maybe_io_error("record stream read")
+                    return self._rec.read()
+            return call_with_retry(read_one, exceptions=(OSError,),
+                                   desc="RecordIO read")
 
     def _decode_one(self, raw):
         header, img_bytes = recordio.unpack(raw)

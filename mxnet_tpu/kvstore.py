@@ -441,14 +441,32 @@ class KVStoreTPUDistAsync(KVStoreTPUDist):
 
 
 def create(name="local") -> KVStore:
-    """reference: src/kvstore/kvstore.cc:40-75 factory."""
+    """reference: src/kvstore/kvstore.cc:40-75 factory.
+
+    Dist-store creation touches the jax.distributed coordination service,
+    which is routinely not-yet-up when a preempted worker restarts ahead
+    of its peers — so it retries with exponential backoff under the
+    shared MXNET_TPU_RETRY_* env knobs (resilience/retry.py) instead of
+    failing the whole relaunch on the first connection error."""
     if not isinstance(name, str):
         raise TypeError("name must be a string")
     if name in ("local", "local_update_cpu", "local_allreduce_cpu",
                 "local_allreduce_device", "device", "nccl", "tpu"):
         return KVStore(name)
     if name == "dist_async":
-        return KVStoreTPUDistAsync(name)
+        return _create_dist(KVStoreTPUDistAsync, name)
     if name.startswith("dist"):
-        return KVStoreTPUDist(name)
+        return _create_dist(KVStoreTPUDist, name)
     raise MXNetError("unknown KVStore type %s" % name)
+
+
+def _create_dist(cls, name):
+    from .resilience import chaos
+    from .resilience.retry import call_with_retry
+
+    def make():
+        chaos.maybe_io_error("kvstore %s creation" % name)
+        return cls(name)
+
+    return call_with_retry(make, exceptions=(OSError, RuntimeError),
+                           desc="kvstore %r creation" % name)

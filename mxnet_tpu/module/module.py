@@ -81,7 +81,7 @@ class Module(BaseModule):
         for slot in ("_arg_params", "_aux_params", "_optimizer",
                      "_kvstore", "_update_on_kvstore", "_updater",
                      "_preload_opt_states", "_grad_req", "_exec_group",
-                     "_data_shapes", "_label_shapes"):
+                     "_data_shapes", "_label_shapes", "_grad_guard"):
             setattr(self, slot, None)
         self._params_dirty = False
 
@@ -291,9 +291,15 @@ class Module(BaseModule):
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
-                       force_init=False):
-        """reference module.py:472"""
+                       force_init=False, grad_guard=None):
+        """reference module.py:472.  ``grad_guard`` (beyond-reference): a
+        resilience.GradientGuard; when set, update() checks gradient
+        finiteness first, skips the optimizer step on a bad batch, and
+        aborts with diagnostics after the guard's consecutive-bad
+        budget."""
         self._require(bound=True, params=True)
+        if grad_guard is not None:
+            self._grad_guard = grad_guard
         if self.optimizer_initialized and not force_init:
             self.logger.warning("optimizer already initialized, ignoring...")
             return
@@ -401,8 +407,15 @@ class Module(BaseModule):
 
     def update(self):
         """Apply one optimizer step to every parameter (reference
-        module.py:629)."""
+        module.py:629).  With a grad_guard installed, a step whose
+        gradients are non-finite applies NOTHING — params, optimizer
+        state and kvstore all keep their previous values."""
         self._require(bound=True, params=True, optimizer=True)
+        if self._grad_guard is not None:
+            grads = [g for glist in self._exec_group_grad_arrays()
+                     for g in glist if g is not None]
+            if not self._grad_guard.step(grads):
+                return
         self._params_dirty = True
         if self._update_on_kvstore:
             _update_params_on_kvstore(self._exec_group_param_arrays(),

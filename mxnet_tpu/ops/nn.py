@@ -685,6 +685,13 @@ def _identity_attach_kl_sparse_reg(attrs, x):
 # ops/pallas_kernels.fused_attention to Symbol/Gluon models.
 # ---------------------------------------------------------------------------
 
+# Resolved ONCE at import: the op body runs inside jit traces whose cache
+# key does not include the environment, so a post-first-trace change to
+# MXNET_FLASH_MIN_SEQ would be silently ignored — freezing it here makes
+# that explicit.  Per-call control stays available via the op's
+# flash_min_seq attr (which IS part of the jit cache key).
+_FLASH_MIN_SEQ = int(os.environ.get("MXNET_FLASH_MIN_SEQ", "8192"))
+
 @register("_contrib_fused_attention", inputs=("query", "key", "value"),
           params=dict(causal=attr_bool(False), scale=attr_float(0.0),
                       block_q=attr_int(128), flash_min_seq=attr_int(0)),
@@ -715,8 +722,7 @@ def _contrib_fused_attention(attrs, q, k, v):
         p = jax.nn.softmax(s, axis=-1)
         return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
-    flash_min = attrs.flash_min_seq or int(
-        os.environ.get("MXNET_FLASH_MIN_SEQ", "8192"))
+    flash_min = attrs.flash_min_seq or _FLASH_MIN_SEQ
     if q.shape[1] < flash_min:
         return naive(q, k, v)
 

@@ -200,7 +200,8 @@ def _multi_inputs(stride, names):
 @register("multi_sgd_update", inputs=_multi_inputs(2, ("weight", "grad")),
           params=_multi_attrs(), variadic=True,
           num_outputs=lambda a: _nw(a, 2),
-          writeback=lambda a: {2 * i: i for i in range(_nw(a, 2))})
+          writeback=lambda a: {2 * i: i for i in range(_nw(a, 2))},
+          dynamic_params=("lrs", "wds", "rescale_grad"))
 def _multi_sgd_update(attrs, *args):
     out = []
     for i in range(_nw(attrs, 2)):
@@ -216,7 +217,8 @@ def _multi_sgd_update(attrs, *args):
           num_visible_outputs=lambda a: _nw(a, 3),
           writeback=lambda a: dict(
               [(3 * i, i) for i in range(_nw(a, 3))] +
-              [(3 * i + 2, _nw(a, 3) + i) for i in range(_nw(a, 3))]))
+              [(3 * i + 2, _nw(a, 3) + i) for i in range(_nw(a, 3))]),
+          dynamic_params=("lrs", "wds", "rescale_grad"))
 def _multi_sgd_mom_update(attrs, *args):
     ws, ms = [], []
     n = _nw(attrs, 3)
@@ -235,7 +237,8 @@ def _multi_sgd_mom_update(attrs, *args):
           num_visible_outputs=lambda a: _nw(a, 3),
           writeback=lambda a: dict(
               [(3 * i, i) for i in range(_nw(a, 3))] +
-              [(3 * i + 2, _nw(a, 3) + i) for i in range(_nw(a, 3))]))
+              [(3 * i + 2, _nw(a, 3) + i) for i in range(_nw(a, 3))]),
+          dynamic_params=("lrs", "wds", "rescale_grad"))
 def _multi_mp_sgd_update(attrs, *args):
     ws, w32s = [], []
     for i in range(_nw(attrs, 3)):
@@ -256,7 +259,8 @@ def _multi_mp_sgd_update(attrs, *args):
               [(4 * i, i) for i in range(_nw(a, 4))] +
               [(4 * i + 2, _nw(a, 4) + i) for i in range(_nw(a, 4))] +
               [(4 * i + 3, 2 * _nw(a, 4) + i)
-               for i in range(_nw(a, 4))]))
+               for i in range(_nw(a, 4))]),
+          dynamic_params=("lrs", "wds", "rescale_grad"))
 def _multi_mp_sgd_mom_update(attrs, *args):
     ws, ms, w32s = [], [], []
     n = _nw(attrs, 4)

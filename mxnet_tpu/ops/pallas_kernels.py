@@ -28,6 +28,11 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax.enable_x64 graduated from jax.experimental after 0.4.37; accept both
+_enable_x64 = getattr(jax, "enable_x64", None)
+if _enable_x64 is None:   # pragma: no cover - version-dependent
+    from jax.experimental import enable_x64 as _enable_x64
+
 __all__ = ["two_bit_compress", "fused_attention", "pallas_available"]
 
 
@@ -57,7 +62,11 @@ _LANES = 1024          # flattened row width: 8 sublanes x 128 lanes
 
 def _two_bit_kernel(g_ref, r_ref, q_ref, nr_ref, *, t):
     comp = g_ref[:] + r_ref[:]
-    q = jnp.where(comp >= t, t, jnp.where(comp <= -t, -t, 0.0))
+    # exact f32 scalars: a weak python float would promote to f64 under
+    # jax_enable_x64 and the Mosaic/interpret lowering rejects f64 here
+    t32 = jnp.float32(t)
+    q = jnp.where(comp >= t32, t32,
+                  jnp.where(comp <= -t32, -t32, jnp.float32(0.0)))
     q_ref[:] = q.astype(g_ref.dtype)
     nr_ref[:] = (comp - q).astype(g_ref.dtype)
 
@@ -106,7 +115,7 @@ def _two_bit_jit(grad, residual, threshold, interpret):
     r2 = jnp.pad(residual.reshape(-1).astype(jnp.float32), (0, pad)) \
         .reshape(rows, _LANES)
     kern = functools.partial(_two_bit_kernel, t=float(threshold))
-    with jax.enable_x64(False):   # Mosaic cannot take i64 grid indices
+    with _enable_x64(False):   # Mosaic cannot take i64 grid indices
         q2, nr2 = pl.pallas_call(
             kern,
             grid=(rows // _BLOCK_ROWS,),
@@ -214,7 +223,7 @@ def fused_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     # this package runs with jax_enable_x64 on (mxnet int64 parity); grid
     # index maps would then trace their literals as i64, which Mosaic
     # cannot legalize — trace the kernel in an x64-off scope
-    with jax.enable_x64(False):
+    with _enable_x64(False):
         out = pl.pallas_call(
             kern,
             grid=(B * H, Tq // bq, nk),

@@ -231,14 +231,32 @@ def _jitted_dynamic(op_name: str, static_key, dyn_names) -> Callable:
     return jax.jit(call)
 
 
+def _dyn_scalar(v):
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def _dynamic_value(v):
+    """Traced-argument form of a dynamic attr value, or None if the value
+    must stay static.  Scalars trace as one argument; non-empty tuples of
+    scalars (multi_sgd's per-tensor lrs/wds) trace as a tuple of scalar
+    leaves — jit keys on the PYTREE STRUCTURE (the tuple length), not the
+    values, so an lr schedule stops recompiling the fused update every
+    step."""
+    if _dyn_scalar(v):
+        return float(v)
+    if isinstance(v, (tuple, list)) and v and all(_dyn_scalar(x) for x in v):
+        return tuple(float(x) for x in v)
+    return None
+
+
 def jitted_apply(op: Operator, attrs: AttrDict) -> Callable:
     """Cached jitted callable for (op, attrs)."""
-    dyn_names = tuple(n for n in op.dynamic_params
-                      if isinstance(attrs.get(n), (int, float))
-                      and not isinstance(attrs.get(n), bool))
-    if not dyn_names:
+    dyn = [(n, _dynamic_value(attrs.get(n))) for n in op.dynamic_params]
+    dyn = [(n, v) for n, v in dyn if v is not None]
+    if not dyn:
         return _jitted(op.name, attrs.key())
-    dyn_vals = tuple(float(attrs[n]) for n in dyn_names)
+    dyn_names = tuple(n for n, _ in dyn)
+    dyn_vals = tuple(v for _, v in dyn)
     static = AttrDict({k: v for k, v in attrs.items() if k not in dyn_names})
     fn = _jitted_dynamic(op.name, static.key(), dyn_names)
     return functools.partial(fn, dyn_vals)

@@ -57,10 +57,19 @@ def collective_accounting(hlo_text):
             continue
         slot = out.setdefault(base, {"count": 0, "bytes": 0})
         slot["count"] += 1
-        # async -start types repeat (operand, result) shapes; halve
-        payload = _shape_bytes(type_expr)
         if op.endswith("-start"):
-            payload //= 2
+            # async -start result types bundle (operand, result[, scratch])
+            # shapes.  Halving that tuple was only right for symmetric ops
+            # (all-reduce); for all-gather/reduce-scatter operand and
+            # result differ, so sum the OPERAND shapes from the call args
+            # instead — payload is what the collective is fed.
+            call = re.search(re.escape(op) + r"\((.*?)\)", line)
+            if call:
+                payload = _shape_bytes(call.group(1))
+            else:   # malformed line: fall back to the symmetric estimate
+                payload = _shape_bytes(type_expr) // 2
+        else:
+            payload = _shape_bytes(type_expr)
         slot["bytes"] += payload
     return out
 

@@ -21,7 +21,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+try:   # jax >= 0.5 exports shard_map at top level
+    from jax import shard_map
+except ImportError:   # 0.4.x: experimental namespace
+    from jax.experimental.shard_map import shard_map
 
 __all__ = ["pipeline_apply", "PipelineRunner"]
 
@@ -66,9 +69,11 @@ def pipeline_apply(stage_fn: Callable, num_stages: int, mesh: Mesh,
                 outputs)
             return (buf_next, outputs), None
 
-        buf0 = jax.lax.pvary(jnp.zeros(mb_shape, x_all.dtype), (axis,))
-        outs0 = jax.lax.pvary(jnp.zeros((M,) + mb_shape, x_all.dtype),
-                              (axis,))
+        # lax.pvary (varying-axis annotation for check_vma) only exists on
+        # jax >= 0.6; on older versions zeros are already unvarying-safe
+        pvary = getattr(jax.lax, "pvary", lambda x, axes: x)
+        buf0 = pvary(jnp.zeros(mb_shape, x_all.dtype), (axis,))
+        outs0 = pvary(jnp.zeros((M,) + mb_shape, x_all.dtype), (axis,))
         (_, outputs), _ = jax.lax.scan(tick, (buf0, outs0), jnp.arange(T))
         # only the last stage holds real outputs; broadcast them ring-wide
         outputs = jax.lax.psum(
@@ -78,8 +83,11 @@ def pipeline_apply(stage_fn: Callable, num_stages: int, mesh: Mesh,
 
     in_specs = (P(axis), P())       # params sharded by stage; x replicated
     out_specs = P()
+    # pre-pvary jax (< 0.6) cannot prove the scan carry's replication;
+    # its own error message prescribes check_rep=False as the workaround
+    compat = {} if hasattr(jax.lax, "pvary") else {"check_rep": False}
     mapped = shard_map(per_device, mesh=mesh, in_specs=in_specs,
-                       out_specs=out_specs)
+                       out_specs=out_specs, **compat)
     params_sharded = jax.device_put(
         params_stacked, NamedSharding(mesh, P(axis)))
     x_rep = jax.device_put(x_micro, NamedSharding(mesh, P()))

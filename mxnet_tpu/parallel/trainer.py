@@ -47,7 +47,9 @@ class ShardedTrainer:
     def __init__(self, symbol, spec: MeshSpec, data_names=("data",),
                  label_names=("softmax_label",), lr=0.01, momentum=0.9,
                  wd=0.0001, loss_scale=1.0, param_dtype=None,
-                 shard_optimizer_state=False):
+                 shard_optimizer_state=False, dynamic_loss_scale=False,
+                 loss_scale_growth_interval=2000, nonfinite_budget=None,
+                 guard_nonfinite=True):
         self.symbol = symbol
         self.spec = spec
         self.prog = GraphProgram(symbol)
@@ -85,6 +87,22 @@ class ShardedTrainer:
         # all-gather new weights (cf. "Automatic Cross-Replica Sharding of
         # Weight Update in Data-Parallel Training").
         self.shard_optimizer_state = bool(shard_optimizer_state)
+        # -- resilience (resilience/guards.py): the non-finite detector and
+        # the loss-scale automaton live INSIDE the jitted step; the host
+        # only tracks the consecutive-bad-step budget and chaos hooks.
+        from ..resilience import guards as _guards
+        self.init_loss_scale = float(loss_scale)
+        self.dynamic_loss_scale = bool(dynamic_loss_scale)
+        self.loss_scale_growth_interval = int(loss_scale_growth_interval)
+        self.guard_nonfinite = bool(guard_nonfinite)
+        self.nonfinite_budget = (_guards.default_budget()
+                                 if nonfinite_budget is None
+                                 else int(nonfinite_budget))
+        self._guard_state = None     # (scale f32, good-streak i32) on device
+        self._bad_streak = 0
+        self._skipped_steps = 0
+        self._step_count = 0
+        self._last_ok = True
 
     # -- tensor-parallel sharding rules -----------------------------------
     def param_sharding(self, name: str, shape) -> NamedSharding:
@@ -211,11 +229,25 @@ class ShardedTrainer:
 
     # -- the step ---------------------------------------------------------
     def _make_step_fn(self):
-        """The raw (un-jitted) fused fwd+bwd+SGD step."""
+        """The raw (un-jitted) fused fwd+bwd+SGD step, with the non-finite
+        guard and loss-scale automaton compiled in.
+
+        ``guard`` is ``(scale f32, good-streak i32)``.  The loss is
+        multiplied by ``scale`` before the backward and the gradients
+        divided back in the update, so under- and overflow in low-precision
+        graphs are steerable; the ``isfinite`` verdict reduces over the loss
+        and every (already psum-reduced) gradient inside the same program —
+        every dp replica computes the identical verdict from the identical
+        reduced gradients, so the skip/keep select stays SPMD-consistent
+        with no extra collective.  A bad step keeps params/mom/aux
+        unchanged and halves the scale; good steps grow it back."""
+        from ..resilience import guards as _guards
         prog = self.prog
         param_idx = list(self.param_idx)
         input_idx = dict(self.input_idx)
         lr, momentum, wd = self.lr, self.momentum, self.wd
+        dynamic = self.dynamic_loss_scale
+        growth_interval = self.loss_scale_growth_interval
 
         def loss_fn(params, inputs, aux, keys):
             args = [None] * len(prog.arg_names)
@@ -232,12 +264,28 @@ class ShardedTrainer:
         from ..executor import _remat_wrap
         loss_fn = _remat_wrap(loss_fn, self._built_remat)
 
-        def step_fn(params, mom, aux, inputs, keys):
-            (loss, (outs, new_aux)), grads = jax.value_and_grad(
-                loss_fn, argnums=0, has_aux=True)(params, inputs, aux, keys)
+        def scaled_loss_fn(params, inputs, aux, keys, scale):
+            loss, extra = loss_fn(params, inputs, aux, keys)
+            return loss * scale, (loss, extra)
+
+        def step_fn(params, mom, aux, inputs, keys, guard):
+            scale, good = guard
+            (_, (loss, (outs, new_aux))), grads = jax.value_and_grad(
+                scaled_loss_fn, argnums=0, has_aux=True)(
+                    params, inputs, aux, keys, scale)
             new_params, new_mom = _tree_sgd(
-                params, grads, mom, lr, momentum, wd, 1.0)
-            return new_params, new_mom, new_aux, loss
+                params, grads, mom, lr, momentum, wd, 1.0 / scale)
+            ok = _guards.all_finite(loss, grads)
+            new_params = tuple(jnp.where(ok, np_, p)
+                               for np_, p in zip(new_params, params))
+            new_mom = tuple(jnp.where(ok, nm, m)
+                            for nm, m in zip(new_mom, mom))
+            new_aux = tuple(jnp.where(ok, na, a)
+                            for na, a in zip(new_aux, aux))
+            new_scale, new_good = _guards.scale_update(
+                scale, good, ok, growth_interval, dynamic=dynamic)
+            return (new_params, new_mom, new_aux, loss, ok,
+                    (new_scale, new_good))
 
         return step_fn
 
@@ -257,12 +305,13 @@ class ShardedTrainer:
             ashard,                                 # aux
             {n: bat for n in self.input_names},     # batch
             rep,                                    # keys
+            (rep, rep),                             # guard (scale, streak)
         )
-        out_shardings = (pshard, mshard, ashard, rep)
+        out_shardings = (pshard, mshard, ashard, rep, rep, (rep, rep))
         with self.spec.mesh:
             return jax.jit(step_fn, in_shardings=in_shardings,
                            out_shardings=out_shardings,
-                           donate_argnums=(0, 1, 2) if donate else ())
+                           donate_argnums=(0, 1, 2, 5) if donate else ())
 
     def build_step_auto_layout(self, params, mom, aux, batch_shapes,
                                input_dtypes=None):
@@ -291,8 +340,9 @@ class ShardedTrainer:
             return tuple(Format(Layout.AUTO, s) for s in shardings)
 
         in_shardings = (auto(pshard), auto(mshard), auto(ashard),
-                        {n: bat for n in self.input_names}, rep)
-        out_shardings = (auto(pshard), auto(mshard), auto(ashard), rep)
+                        {n: bat for n in self.input_names}, rep, (rep, rep))
+        out_shardings = (auto(pshard), auto(mshard), auto(ashard), rep, rep,
+                         (rep, rep))
 
         def sds(x):
             return jax.ShapeDtypeStruct(x.shape, x.dtype)
@@ -304,13 +354,15 @@ class ShardedTrainer:
                                           dts.get(n, jnp.float32))
                   for n in self.input_names}
         keys = self._keys()
+        guard = self._guard_arrays()
         with self.spec.mesh:
             jitted = jax.jit(step_fn, in_shardings=in_shardings,
                              out_shardings=out_shardings,
-                             donate_argnums=(0, 1, 2))
+                             donate_argnums=(0, 1, 2, 5))
             compiled = jitted.lower(
                 tuple(sds(p) for p in params), tuple(sds(m) for m in mom),
-                tuple(sds(a) for a in aux), inputs, sds(keys)).compile()
+                tuple(sds(a) for a in aux), inputs, sds(keys),
+                (sds(guard[0]), sds(guard[1]))).compile()
         p_fmt, m_fmt, a_fmt = compiled.input_formats[0][:3]
         params = tuple(jax.device_put(p, f) for p, f in zip(params, p_fmt))
         mom = tuple(jax.device_put(m, f) for m, f in zip(mom, m_fmt))
@@ -319,16 +371,100 @@ class ShardedTrainer:
 
     def step(self, params, mom, aux, batch: Dict[str, np.ndarray]):
         """One synchronous data-parallel SGD step.  batch arrays are global
-        (host) arrays; they get sharded over dp."""
+        (host) arrays; they get sharded over dp.
+
+        Resilience semantics: a non-finite loss/grad step applies NO
+        update (params/mom/aux come back unchanged), backs the loss scale
+        off, and — after ``nonfinite_budget`` consecutive bad steps —
+        raises :class:`~mxnet_tpu.resilience.guards.NonFiniteError` with
+        diagnostics.  Chaos faults (`preempt`, `nan_grad`) are honored
+        here so fault drills exercise this exact code path."""
         from ..executor import backward_mirror_policy
+        from ..resilience import chaos as _chaos
         remat = backward_mirror_policy()
         if self._step is None or remat != self._built_remat:
             self._built_remat = remat
             self._step = self._build_step()
+        self._step_count += 1
+        _chaos.maybe_preempt(self._step_count)
+        if _chaos.fire("nan_grad", self._step_count) is not None:
+            # poison the batch so the REAL in-step detector trips — the
+            # drill proves detection, not a shortcut flag
+            poison = self.data_names[0]
+            batch = dict(batch)
+            batch[poison] = np.full_like(np.asarray(batch[poison]), np.nan)
         inputs = {n: jax.device_put(v, self.spec.batch_sharding())
                   for n, v in batch.items()}
         keys = self._keys()
-        return self._step(params, mom, aux, inputs, keys)
+        params, mom, aux, loss, ok, guard = self._step(
+            params, mom, aux, inputs, keys, self._guard_arrays())
+        self._guard_state = guard
+        if self.guard_nonfinite:
+            self._note_step_result(bool(ok), loss)
+        return params, mom, aux, loss
+
+    def _note_step_result(self, ok, loss):
+        """Host half of the guard: budget tracking + graceful abort."""
+        self._last_ok = ok
+        if ok:
+            self._bad_streak = 0
+            return
+        self._bad_streak += 1
+        self._skipped_steps += 1
+        if self._bad_streak > self.nonfinite_budget:
+            from ..resilience.guards import NonFiniteError
+            raise NonFiniteError(
+                "aborting training: %d consecutive non-finite steps "
+                "exceeded the budget of %d at step %d (loss=%r, loss "
+                "scale now %.4g; %d steps skipped in total).  Restore "
+                "the latest checkpoint with a lower lr, or raise "
+                "MXNET_TPU_NONFINITE_BUDGET."
+                % (self._bad_streak, self.nonfinite_budget,
+                   self._step_count, float(loss), self.loss_scale,
+                   self._skipped_steps),
+                diagnostics={"step": self._step_count,
+                             "loss_scale": self.loss_scale,
+                             "bad_streak": self._bad_streak,
+                             "skipped_steps": self._skipped_steps})
+
+    # -- resilience state --------------------------------------------------
+    def _guard_arrays(self):
+        """(scale, good-streak) device scalars, created on first use."""
+        if self._guard_state is None:
+            rep = self.spec.replicated()
+            self._guard_state = (
+                jax.device_put(jnp.float32(self.init_loss_scale), rep),
+                jax.device_put(jnp.int32(0), rep))
+        return self._guard_state
+
+    @property
+    def loss_scale(self) -> float:
+        return float(self._guard_state[0]) if self._guard_state is not None \
+            else self.init_loss_scale
+
+    @property
+    def skipped_steps(self) -> int:
+        return self._skipped_steps
+
+    def resilience_meta(self) -> Dict[str, float]:
+        """Guard/progress state a checkpoint must carry to resume
+        faithfully (consumed by resilience.checkpoint.save_trainer)."""
+        good = int(self._guard_state[1]) if self._guard_state is not None \
+            else 0
+        return {"loss_scale": self.loss_scale, "good_streak": good,
+                "step_count": self._step_count,
+                "skipped_steps": self._skipped_steps}
+
+    def set_resilience_state(self, meta):
+        """Restore the guard automaton from checkpoint meta."""
+        rep = self.spec.replicated()
+        self._guard_state = (
+            jax.device_put(jnp.float32(meta.get("loss_scale",
+                                                self.init_loss_scale)), rep),
+            jax.device_put(jnp.int32(meta.get("good_streak", 0)), rep))
+        self._step_count = int(meta.get("step_count", 0))
+        self._skipped_steps = int(meta.get("skipped_steps", 0))
+        self._bad_streak = 0
 
     def _keys(self):
         from .. import rng as _rng
@@ -341,9 +477,12 @@ class ShardedTrainer:
 
 
 def sgd_step_fn(trainer: ShardedTrainer):
-    """Expose the raw jitted step (bench/dryrun path).  Buffers are donated
-    — params/mom/aux update in place in HBM; callers must rebind their
-    references to the returned state every call."""
+    """Expose the raw jitted step (bench/dryrun path).  Signature:
+    ``step(params, mom, aux, inputs, keys, guard) -> (params, mom, aux,
+    loss, ok, guard)`` where ``guard`` comes from
+    ``trainer._guard_arrays()``.  Buffers are donated — params/mom/aux/
+    guard update in place in HBM; callers must rebind their references to
+    the returned state every call."""
     if trainer._step is None:
         trainer._step = trainer._build_step()
     return trainer._step

@@ -1,0 +1,42 @@
+"""Fault tolerance for TPU training (SURVEY §5.3: the TPU failure model).
+
+The reference MXNet survives multi-host runs through ps-lite heartbeats and
+Module save/load; a collectives-over-ICI backend has neither a parameter
+server to re-pull from nor per-worker restart — a preempted host kills the
+whole program and recovery is *checkpoint restart*.  This package supplies
+that layer (cf. "TensorFlow: a system for large-scale ML", arXiv:1605.08695
+§4.3, and the weight-update-sharding recovery story of arXiv:2004.13336):
+
+* ``container``  — atomic, non-executable on-disk format: JSON header +
+  raw numpy buffers + CRC32 integrity footers.  No pickle anywhere; the
+  loader refuses pickle bytes outright.
+* ``checkpoint`` — ``CheckpointManager``: versioned snapshots with
+  write-temp → fsync → rename atomicity, retention, and a ``latest()``
+  that quarantines corrupt files and falls back to the newest VALID one.
+  Adapters cover ``ShardedTrainer``, ``Module``/``FeedForward`` and
+  ``gluon.Trainer`` (params + optimizer slots + loss scale + step).
+* ``guards``     — non-finite loss/grad detection, dynamic loss scaling
+  (grow-after-N-good / halve-on-bad) and a consecutive-bad-step budget
+  that aborts with diagnostics instead of silently training on NaNs.
+* ``retry``      — exponential-backoff retry with a wall-clock timeout
+  for flaky external surfaces (dist kvstore creation, RecordIO reads).
+* ``chaos``      — fault injection (env or context manager): simulated
+  preemption, checkpoint corruption, NaN gradients, transient IO errors.
+  The resilience tests use it to prove recovery end-to-end.
+"""
+from .container import (CorruptContainer, peek_header, read_container,
+                        write_container)
+from .checkpoint import (Checkpoint, CheckpointManager, restore_gluon_trainer,
+                         restore_module, restore_trainer, save_gluon_trainer,
+                         save_module, save_trainer)
+from .guards import GradientGuard, NonFiniteError
+from .retry import call_with_retry, retry_config
+from . import chaos
+
+__all__ = [
+    "CorruptContainer", "write_container", "read_container", "peek_header",
+    "Checkpoint", "CheckpointManager", "save_trainer", "restore_trainer",
+    "save_module", "restore_module", "save_gluon_trainer",
+    "restore_gluon_trainer", "GradientGuard", "NonFiniteError",
+    "call_with_retry", "retry_config", "chaos",
+]

@@ -1,0 +1,167 @@
+"""Chaos harness: deterministic fault injection for resilience testing.
+
+Production TPU fleets lose hosts to preemption, corrupt checkpoints on
+the way to disk, and occasionally emit NaN gradients (bad batch, overflow
+under fp16/bf16).  This module simulates those faults on demand so the
+recovery machinery is *proven* by tests instead of trusted:
+
+* ``preempt``      — raise :class:`SimulatedPreemption` out of the train
+  step, mimicking the coordinator tearing the program down mid-epoch.
+* ``nan_grad``     — poison the step's input batch with NaN so the real
+  in-step non-finite detection path fires (not a shortcut flag).
+* ``io_error``     — raise ``OSError`` from an IO read; exercises the
+  retry/backoff path in RecordIO readers and kvstore creation.
+* ``corrupt_ckpt`` — :func:`corrupt_latest` truncates or garbages the
+  newest checkpoint, exercising ``CheckpointManager.latest()`` fallback.
+
+Faults are armed either with the :func:`inject` context manager (tests)
+or the ``MXNET_TPU_CHAOS`` env var (whole-run drills), a comma list of
+``kind[@step][xcount]`` — e.g. ``"nan_grad@3,preempt@7,io_errorx2"``.
+``@step`` fires when the consumer's step counter hits that value;
+``xcount`` fires on the next ``count`` opportunities (default 1).
+
+The hot-path cost when no fault is armed is one falsy check.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+__all__ = ["SimulatedPreemption", "inject", "fire", "maybe_preempt",
+           "maybe_io_error", "corrupt_latest", "active", "reset"]
+
+
+class SimulatedPreemption(RuntimeError):
+    """A chaos-injected host preemption; recovery = checkpoint restart."""
+
+
+class _Fault:
+    __slots__ = ("kind", "at_step", "remaining", "params")
+
+    def __init__(self, kind, at_step=None, count=1, **params):
+        self.kind = kind
+        self.at_step = None if at_step is None else int(at_step)
+        self.remaining = int(count)
+        self.params = params
+
+    def __repr__(self):
+        return "_Fault(%s, at_step=%s, remaining=%d)" % (
+            self.kind, self.at_step, self.remaining)
+
+
+_FAULTS: List[_Fault] = []
+_ENV_PARSED = False
+
+
+def _parse_env():
+    global _ENV_PARSED
+    if _ENV_PARSED:
+        return
+    _ENV_PARSED = True
+    spec = os.environ.get("MXNET_TPU_CHAOS", "").strip()
+    if not spec:
+        return
+    for tok in spec.split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        count = 1
+        if "x" in tok.rsplit("@", 1)[-1] or ("@" not in tok and "x" in tok):
+            tok, _, c = tok.rpartition("x")
+            count = int(c)
+        kind, _, step = tok.partition("@")
+        _FAULTS.append(_Fault(kind, at_step=step or None, count=count))
+
+
+def reset():
+    """Drop every armed fault (tests) and re-read the env next time."""
+    global _ENV_PARSED
+    del _FAULTS[:]
+    _ENV_PARSED = False
+
+
+def active() -> bool:
+    _parse_env()
+    return bool(_FAULTS)
+
+
+class inject:
+    """Context manager arming one fault::
+
+        with chaos.inject("preempt", at_step=4):
+            train(...)   # raises SimulatedPreemption at step 4
+    """
+
+    def __init__(self, kind, at_step=None, count=1, **params):
+        self._fault = _Fault(kind, at_step=at_step, count=count, **params)
+
+    def __enter__(self):
+        _parse_env()
+        _FAULTS.append(self._fault)
+        return self._fault
+
+    def __exit__(self, *exc):
+        try:
+            _FAULTS.remove(self._fault)
+        except ValueError:
+            pass
+        return False
+
+
+def fire(kind: str, step: Optional[int] = None) -> Optional[dict]:
+    """Consume one firing of ``kind`` if armed for this ``step``; returns
+    the fault's params dict (possibly empty) or None.  Cheap when idle."""
+    if not _FAULTS and _ENV_PARSED:
+        return None
+    _parse_env()
+    for f in _FAULTS:
+        if f.kind != kind or f.remaining <= 0:
+            continue
+        if f.at_step is not None and step != f.at_step:
+            continue
+        f.remaining -= 1
+        return dict(f.params)
+    return None
+
+
+def maybe_preempt(step: Optional[int] = None):
+    """Raise SimulatedPreemption if a ``preempt`` fault fires now."""
+    if fire("preempt", step) is not None:
+        raise SimulatedPreemption(
+            "chaos: simulated host preemption at step %s" % step)
+
+
+def maybe_io_error(desc: str = ""):
+    """Raise OSError if an ``io_error`` fault fires now (inside retried
+    IO callables, so the retry path absorbs it)."""
+    if fire("io_error") is not None:
+        raise OSError("chaos: injected transient IO failure (%s)" % desc)
+
+
+def corrupt_latest(directory: str, prefix: str = "ckpt",
+                   mode: str = "truncate") -> Optional[str]:
+    """Damage the newest checkpoint file under ``directory`` in place.
+
+    ``mode='truncate'`` chops the file mid-buffer (the preemption-during-
+    write failure shape — though the atomic writer makes this unreachable
+    in normal operation, bit rot and partial copies are not); ``'garbage'``
+    overwrites bytes inside a buffer so only CRC validation can catch it.
+    Returns the damaged path, or None if no checkpoint exists.
+    """
+    names = [n for n in os.listdir(directory)
+             if n.startswith(prefix + "-") and not n.endswith(".corrupt")]
+    if not names:
+        return None
+    path = os.path.join(directory, sorted(names)[-1])
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        if mode == "truncate":
+            f.truncate(max(16, size // 2))
+        elif mode == "garbage":
+            f.seek(max(16, size // 2))
+            f.write(b"\xde\xad\xbe\xef" * 8)
+        else:
+            raise ValueError("unknown corruption mode %r" % mode)
+        f.flush()
+        os.fsync(f.fileno())
+    return path

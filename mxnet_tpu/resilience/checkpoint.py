@@ -1,0 +1,368 @@
+"""CheckpointManager: atomic, versioned, self-validating training snapshots.
+
+One checkpoint = one container file ``<prefix>-<step:010d>.mxtck`` holding
+the ENTIRE training state — params, optimizer slots, loss-scale automaton,
+and position (epoch/step) — because resuming with any piece missing
+silently changes training dynamics (momentum restarting from zero is the
+classic one).  Guarantees:
+
+* **Atomic**: container writes are temp → fsync → rename; a preemption
+  mid-save leaves the previous checkpoint untouched.
+* **Validated**: ``latest()``/``restore()`` fully CRC-check a candidate
+  before returning it; a corrupt file is quarantined (renamed
+  ``*.corrupt``) and the next-newest valid snapshot is used instead.
+* **Bounded**: a retention policy keeps the newest ``keep`` checkpoints.
+
+Adapters map the three training front-ends onto flat array dicts:
+:func:`save_trainer`/:func:`restore_trainer` (ShardedTrainer — state is
+re-``device_put`` with the trainer's own shardings, so a restore onto a
+different mesh layout reshards correctly), :func:`save_module`/
+:func:`restore_module` (Module/FeedForward arg/aux params + optimizer
+state), and :func:`save_gluon_trainer`/:func:`restore_gluon_trainer`.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import re
+from collections import namedtuple
+from typing import Optional
+
+import numpy as np
+
+from ..base import MXNetError
+from .container import CorruptContainer, read_container, write_container
+
+__all__ = ["Checkpoint", "CheckpointManager", "save_trainer",
+           "restore_trainer", "save_module", "restore_module",
+           "save_gluon_trainer", "restore_gluon_trainer"]
+
+_SUFFIX = ".mxtck"
+
+Checkpoint = namedtuple("Checkpoint", ["step", "path", "arrays", "meta",
+                                       "blobs"])
+
+
+class CheckpointManager:
+    """Versioned checkpoints under one directory."""
+
+    def __init__(self, directory: str, prefix: str = "ckpt", keep: int = 3):
+        self.directory = os.fspath(directory)
+        self.prefix = prefix
+        self.keep = int(keep)
+        os.makedirs(self.directory, exist_ok=True)
+        self._pat = re.compile(
+            re.escape(prefix) + r"-(\d{10})" + re.escape(_SUFFIX) + r"$")
+
+    def path_for(self, step: int) -> str:
+        return os.path.join(self.directory,
+                            "%s-%010d%s" % (self.prefix, int(step), _SUFFIX))
+
+    def steps(self):
+        """Steps with an (unquarantined) checkpoint file, ascending."""
+        out = []
+        for name in os.listdir(self.directory):
+            m = self._pat.match(name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    # -- write -----------------------------------------------------------
+    def save(self, step: int, arrays, meta=None, blobs=None) -> str:
+        meta = dict(meta or {})
+        meta["step"] = int(step)
+        path = write_container(self.path_for(step), arrays, meta, blobs)
+        self._retain()
+        return path
+
+    def _retain(self):
+        steps = self.steps()
+        for s in steps[:-self.keep] if self.keep > 0 else []:
+            try:
+                os.unlink(self.path_for(s))
+            except OSError:
+                pass
+
+    # -- read ------------------------------------------------------------
+    def restore(self, step: Optional[int] = None) -> Optional[Checkpoint]:
+        """Load ``step`` (exact, no fallback) or — with ``step=None`` —
+        the newest snapshot that VALIDATES, quarantining any corrupt
+        files found on the way down.  Returns None when nothing valid
+        exists."""
+        if step is not None:
+            arrays, meta, blobs = read_container(self.path_for(step))
+            return Checkpoint(int(step), self.path_for(step), arrays, meta,
+                              blobs)
+        for s in reversed(self.steps()):
+            path = self.path_for(s)
+            try:
+                arrays, meta, blobs = read_container(path)
+                return Checkpoint(s, path, arrays, meta, blobs)
+            except (CorruptContainer, OSError) as e:
+                self._quarantine(path, e)
+        return None
+
+    def latest(self) -> Optional[Checkpoint]:
+        """Newest valid snapshot (corrupt ones quarantined), or None."""
+        return self.restore(None)
+
+    def _quarantine(self, path: str, err):
+        logging.warning("checkpoint %s failed validation (%s) — "
+                        "quarantining and falling back", path, err)
+        try:
+            os.replace(path, path + ".corrupt")
+        except OSError:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# Structure (de)flattening for optimizer state: nested dict/tuple/list of
+# arrays + scalars <-> flat named buffers + a JSON tree spec.  No pickle.
+# ---------------------------------------------------------------------------
+
+def _is_ndarraylike(v):
+    return hasattr(v, "asnumpy") or hasattr(v, "__array__")
+
+
+def _flatten(obj, prefix, arrays):
+    if obj is None:
+        return {"t": "none"}
+    if isinstance(obj, (bool, int, float, str)):
+        return {"t": "py", "v": obj}
+    if isinstance(obj, dict):
+        items = []
+        for k in obj:
+            ktype = "int" if isinstance(k, int) else "str"
+            items.append([str(k), ktype,
+                          _flatten(obj[k], "%s/%s" % (prefix, k), arrays)])
+        return {"t": "dict", "items": items}
+    if isinstance(obj, (tuple, list)):
+        return {"t": "tuple" if isinstance(obj, tuple) else "list",
+                "items": [_flatten(v, "%s/%d" % (prefix, i), arrays)
+                          for i, v in enumerate(obj)]}
+    if _is_ndarraylike(obj):
+        host = obj.asnumpy() if hasattr(obj, "asnumpy") else np.asarray(obj)
+        arrays[prefix] = host
+        return {"t": "arr", "name": prefix,
+                "nd": bool(hasattr(obj, "asnumpy"))}
+    raise MXNetError("cannot checkpoint a %s without pickling it; "
+                     "optimizer state must be arrays/scalars/containers"
+                     % type(obj).__name__)
+
+
+def _unflatten(spec, arrays):
+    t = spec["t"]
+    if t == "none":
+        return None
+    if t == "py":
+        return spec["v"]
+    if t == "dict":
+        out = {}
+        for k, ktype, sub in spec["items"]:
+            out[int(k) if ktype == "int" else k] = _unflatten(sub, arrays)
+        return out
+    if t in ("tuple", "list"):
+        vals = [_unflatten(s, arrays) for s in spec["items"]]
+        return tuple(vals) if t == "tuple" else vals
+    if t == "arr":
+        host = arrays[spec["name"]]
+        if spec.get("nd"):
+            from ..ndarray.ndarray import array as nd_array
+            return nd_array(host)
+        return host
+    raise CorruptContainer("unknown tree node type %r" % t)
+
+
+def _updater_state_io(updater):
+    """(flatten, restore) closure pair over an optimizer Updater's slot
+    dict — the pickle-free replacement for Updater.get/set_states."""
+    def dump(arrays, meta):
+        meta["opt_tree"] = _flatten(updater.states, "opt", arrays)
+        opt = updater.optimizer
+        meta["opt_counts"] = {str(k): int(v) for k, v
+                              in opt._index_update_count.items()}
+        meta["opt_num_update"] = int(getattr(opt, "num_update", 0))
+
+    def load(arrays, meta):
+        if "opt_tree" not in meta:
+            return
+        updater.set_states(_unflatten(meta["opt_tree"], arrays))
+        opt = updater.optimizer
+        opt._index_update_count = {
+            _int_key(k): v for k, v in meta.get("opt_counts", {}).items()}
+        opt.num_update = meta.get("opt_num_update", opt.num_update)
+
+    return dump, load
+
+
+def _int_key(k):
+    try:
+        return int(k)
+    except (TypeError, ValueError):
+        return k
+
+
+# ---------------------------------------------------------------------------
+# ShardedTrainer adapter
+# ---------------------------------------------------------------------------
+
+def save_trainer(manager, trainer, params, mom, aux, step, extra_meta=None):
+    """Snapshot a ShardedTrainer's full state (params, momentum, aux,
+    loss-scale automaton, input shapes) as one atomic checkpoint."""
+    arrays = {}
+    for n, p in zip(trainer.param_names, params):
+        arrays["param/" + n] = np.asarray(p)
+    for n, m in zip(trainer.param_names, mom):
+        arrays["mom/" + n] = np.asarray(m)
+    for n, a in zip(trainer.prog.aux_names, aux):
+        arrays["aux/" + n] = np.asarray(a)
+    meta = dict(extra_meta or {})
+    meta["kind"] = "sharded_trainer"
+    meta["shapes"] = {k: list(v) for k, v
+                      in (getattr(trainer, "_last_shapes", None) or {}).items()}
+    meta.update(trainer.resilience_meta())
+    return manager.save(step, arrays, meta)
+
+
+def restore_trainer(manager, trainer, step=None):
+    """Restore (params, mom, aux) onto ``trainer``'s mesh — each tensor is
+    ``device_put`` with the trainer's OWN sharding rule, so the snapshot
+    reshards correctly even if the mesh/topology changed across restarts.
+    Returns ``(params, mom, aux, step, meta)`` or None if no valid
+    checkpoint exists."""
+    import jax
+    ck = manager.restore(step) if step is not None else manager.latest()
+    if ck is None:
+        return None
+    meta = ck.meta
+    if meta.get("kind") != "sharded_trainer":
+        raise MXNetError("checkpoint %s holds %r state, not a "
+                         "sharded_trainer" % (ck.path, meta.get("kind")))
+    if meta.get("shapes"):
+        trainer._last_shapes = {k: tuple(v)
+                                for k, v in meta["shapes"].items()}
+        trainer._param_shapes = None
+    trainer._param_shardings()   # resolve shapes for sharding rules
+    shapes = trainer._param_shapes
+    params = tuple(
+        jax.device_put(ck.arrays["param/" + n],
+                       trainer.param_sharding(n, shapes.get(n, ())))
+        for n in trainer.param_names)
+    mom = tuple(
+        jax.device_put(ck.arrays["mom/" + n],
+                       trainer.mom_sharding(n, shapes.get(n, ())))
+        for n in trainer.param_names)
+    rep = trainer.spec.replicated()
+    aux = tuple(jax.device_put(ck.arrays["aux/" + n], rep)
+                for n in trainer.prog.aux_names)
+    trainer.set_resilience_state(meta)
+    return params, mom, aux, ck.step, meta
+
+
+# ---------------------------------------------------------------------------
+# Module / FeedForward adapter
+# ---------------------------------------------------------------------------
+
+def save_module(manager, module, step, extra_meta=None):
+    """Snapshot a bound Module: arg/aux params + optimizer slot state."""
+    arg_params, aux_params = module.get_params()
+    arrays = {}
+    for n, v in arg_params.items():
+        arrays["arg/" + n] = v.asnumpy()
+    for n, v in aux_params.items():
+        arrays["aux/" + n] = v.asnumpy()
+    meta = dict(extra_meta or {})
+    meta["kind"] = "module"
+    updater = _module_updater(module)
+    if updater is not None:
+        dump, _ = _updater_state_io(updater)
+        dump(arrays, meta)
+    _dump_guard(getattr(module, "_grad_guard", None), meta)
+    return manager.save(step, arrays, meta)
+
+
+def restore_module(manager, module, step=None):
+    """Restore params (+ optimizer state when the optimizer is already
+    initialized) into a bound Module.  Returns (step, meta) or None."""
+    ck = manager.restore(step) if step is not None else manager.latest()
+    if ck is None:
+        return None
+    meta = ck.meta
+    if meta.get("kind") != "module":
+        raise MXNetError("checkpoint %s holds %r state, not a module"
+                         % (ck.path, meta.get("kind")))
+    from ..ndarray.ndarray import array as nd_array
+    arg_params = {n[len("arg/"):]: nd_array(a)
+                  for n, a in ck.arrays.items() if n.startswith("arg/")}
+    aux_params = {n[len("aux/"):]: nd_array(a)
+                  for n, a in ck.arrays.items() if n.startswith("aux/")}
+    module.set_params(arg_params, aux_params, allow_missing=False,
+                      force_init=True)
+    updater = _module_updater(module)
+    if updater is not None:
+        _, load = _updater_state_io(updater)
+        load(ck.arrays, meta)
+    _load_guard(getattr(module, "_grad_guard", None), meta)
+    return ck.step, meta
+
+
+def _module_updater(module):
+    updater = getattr(module, "_updater", None)
+    if updater is not None:
+        return updater
+    kv = getattr(module, "_kvstore", None)
+    if kv is not None and getattr(module, "_update_on_kvstore", False):
+        return kv._updater
+    return None
+
+
+# ---------------------------------------------------------------------------
+# gluon.Trainer adapter
+# ---------------------------------------------------------------------------
+
+def save_gluon_trainer(manager, trainer, step, extra_meta=None):
+    """Snapshot a gluon.Trainer: parameter values + optimizer slots."""
+    arrays = {}
+    for p in trainer._params:
+        arrays["param/" + p.name] = p.data().asnumpy()
+    meta = dict(extra_meta or {})
+    meta["kind"] = "gluon_trainer"
+    dump, _ = _updater_state_io(trainer._updaters)
+    dump(arrays, meta)
+    _dump_guard(getattr(trainer, "_grad_guard", None), meta)
+    return manager.save(step, arrays, meta)
+
+
+def restore_gluon_trainer(manager, trainer, step=None):
+    """Restore parameters + optimizer slots into a gluon.Trainer.
+    Returns (step, meta) or None."""
+    ck = manager.restore(step) if step is not None else manager.latest()
+    if ck is None:
+        return None
+    meta = ck.meta
+    if meta.get("kind") != "gluon_trainer":
+        raise MXNetError("checkpoint %s holds %r state, not a gluon_trainer"
+                         % (ck.path, meta.get("kind")))
+    for p in trainer._params:
+        key = "param/" + p.name
+        if key in ck.arrays:
+            p.set_data(ck.arrays[key])
+    _, load = _updater_state_io(trainer._updaters)
+    load(ck.arrays, meta)
+    _load_guard(getattr(trainer, "_grad_guard", None), meta)
+    return ck.step, meta
+
+
+def _dump_guard(guard, meta):
+    if guard is not None:
+        meta["loss_scale"] = guard.scale
+        meta["good_steps"] = guard.good_steps
+
+
+def _load_guard(guard, meta):
+    if guard is not None and "loss_scale" in meta:
+        guard.scale = float(meta["loss_scale"])
+        guard.good_steps = int(meta.get("good_steps", 0))

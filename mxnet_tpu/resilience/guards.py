@@ -1,0 +1,158 @@
+"""Non-finite gradient/loss guards + dynamic loss scaling.
+
+Two halves, one policy:
+
+* **In-jit** (ShardedTrainer): :func:`all_finite` folds a ``jnp.isfinite``
+  reduction over the loss and every gradient into the compiled step — the
+  check rides the same fusion (and, under a dp mesh, the same psum-adjacent
+  reduction tree) as the gradients themselves, so it costs no extra host
+  sync.  :func:`scale_update` is the pure loss-scale transition applied in
+  the same program: grow after N consecutive good steps, halve on a bad
+  one (the standard mixed-precision dynamic scaling automaton).
+* **Host-side** (:class:`GradientGuard`): the same automaton for
+  imperative paths (Module, gluon.Trainer) where gradients are visible on
+  host, plus the consecutive-bad-step *budget*: after ``budget`` skipped
+  steps in a row the run aborts with :class:`NonFiniteError` carrying
+  diagnostics, instead of silently burning accelerator-hours on NaNs.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["NonFiniteError", "GradientGuard", "all_finite", "scale_update",
+           "default_budget"]
+
+GROWTH_FACTOR = 2.0
+BACKOFF_FACTOR = 0.5
+MIN_SCALE = 1.0
+MAX_SCALE = float(2 ** 24)
+
+
+def default_budget() -> int:
+    """Consecutive non-finite steps tolerated before aborting
+    (``MXNET_TPU_NONFINITE_BUDGET``, default 20)."""
+    return int(os.environ.get("MXNET_TPU_NONFINITE_BUDGET", "20"))
+
+
+class NonFiniteError(MXNetError):
+    """Training aborted: the non-finite step budget was exhausted."""
+
+    def __init__(self, message, diagnostics=None):
+        super().__init__(message)
+        self.diagnostics = dict(diagnostics or {})
+
+
+# -- in-jit half (pure jax, traced inside the step) -------------------------
+
+def all_finite(loss, grads):
+    """Scalar bool: loss and every gradient are finite.  Pure; call under
+    jit — per-tensor reductions fuse into the backward's own epilogue."""
+    import jax.numpy as jnp
+    ok = jnp.isfinite(loss)
+    for g in grads:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(g)))
+    return ok
+
+
+def scale_update(scale, good, ok, growth_interval, dynamic=True):
+    """One transition of the loss-scale automaton (pure, traced).
+
+    ``scale``/``good`` are f32/i32 scalars; ``ok`` the step verdict from
+    :func:`all_finite`.  Good step: good+1, doubling scale (and resetting
+    the streak) once ``good`` reaches ``growth_interval``.  Bad step:
+    halve scale (floored at MIN_SCALE), streak to 0.  With
+    ``dynamic=False`` the scale is constant and only the streak moves.
+    """
+    import jax.numpy as jnp
+    good2 = jnp.where(ok, good + 1, 0).astype(good.dtype)
+    if not dynamic:
+        return scale, good2
+    grow = jnp.logical_and(ok, good2 >= growth_interval)
+    scale2 = jnp.where(
+        ok,
+        jnp.where(grow, jnp.minimum(scale * GROWTH_FACTOR, MAX_SCALE), scale),
+        jnp.maximum(scale * BACKOFF_FACTOR, MIN_SCALE))
+    good2 = jnp.where(grow, 0, good2).astype(good.dtype)
+    return scale2.astype(scale.dtype), good2
+
+
+# -- host-side half (imperative Module / gluon paths) -----------------------
+
+class GradientGuard:
+    """Host-side non-finite guard for imperative training loops.
+
+    ``guard.step(arrays)`` returns True when every array is finite (the
+    caller applies the update) or False (skip it).  Tracks the consecutive
+    bad-step streak and raises :class:`NonFiniteError` with diagnostics
+    once ``budget`` is exceeded.  With ``dynamic_loss_scale=True`` it also
+    runs the grow/halve automaton; callers scale their loss by
+    ``guard.scale`` and divide gradients back (gluon.Trainer does the
+    divide through ``rescale_grad`` automatically).
+    """
+
+    def __init__(self, budget=None, loss_scale=1.0,
+                 dynamic_loss_scale=False, growth_interval=2000):
+        self.budget = default_budget() if budget is None else int(budget)
+        self.scale = float(loss_scale)
+        self.dynamic = bool(dynamic_loss_scale)
+        self.growth_interval = int(growth_interval)
+        self.good_steps = 0          # current consecutive-good streak
+        self.bad_streak = 0          # current consecutive-bad streak
+        self.total_steps = 0
+        self.skipped_steps = 0
+        self._last_bad = None        # name of first offending array
+
+    def check(self, arrays) -> bool:
+        """Finiteness only; no state change.  ``arrays`` may be NDArray,
+        jax or numpy."""
+        for i, a in enumerate(arrays):
+            if a is None:
+                continue
+            host = a.asnumpy() if hasattr(a, "asnumpy") else np.asarray(a)
+            if not np.all(np.isfinite(host)):
+                self._last_bad = getattr(a, "name", None) or "array[%d]" % i
+                return False
+        return True
+
+    def note(self, ok: bool):
+        """Advance the automaton with an externally computed verdict."""
+        self.total_steps += 1
+        if ok:
+            self.good_steps += 1
+            self.bad_streak = 0
+            if self.dynamic and self.good_steps >= self.growth_interval:
+                self.scale = min(self.scale * GROWTH_FACTOR, MAX_SCALE)
+                self.good_steps = 0
+            return
+        self.skipped_steps += 1
+        self.bad_streak += 1
+        self.good_steps = 0
+        if self.dynamic:
+            self.scale = max(self.scale * BACKOFF_FACTOR, MIN_SCALE)
+        if self.bad_streak > self.budget:
+            raise NonFiniteError(
+                "aborting: %d consecutive non-finite steps exceeded the "
+                "budget of %d (first offender this step: %s; loss scale "
+                "now %.4g after backoff; %d/%d steps skipped overall). "
+                "Lower the learning rate, raise "
+                "MXNET_TPU_NONFINITE_BUDGET, or restore an earlier "
+                "checkpoint." % (self.bad_streak, self.budget,
+                                 self._last_bad, self.scale,
+                                 self.skipped_steps, self.total_steps),
+                diagnostics=self.diagnostics())
+
+    def step(self, arrays) -> bool:
+        """check + note in one call; returns the verdict."""
+        ok = self.check(arrays)
+        self.note(ok)
+        return ok
+
+    def diagnostics(self) -> dict:
+        return {"loss_scale": self.scale, "bad_streak": self.bad_streak,
+                "skipped_steps": self.skipped_steps,
+                "total_steps": self.total_steps,
+                "last_bad_array": self._last_bad}

@@ -11,10 +11,28 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Older jax (< 0.4.34) has no jax_num_cpu_devices config option; there the
+# virtual-device count must be forced through XLA_FLAGS BEFORE the backend
+# initializes.  Set it pre-import, and on the old path immediately
+# initialize the backend and RESTORE the env var — test_dist's worker
+# subprocesses inherit os.environ, and 8 virtual devices per rank breaks
+# the 4-rank gloo topology they self-configure.
+_prev_xla_flags = os.environ.get("XLA_FLAGS")
+if "--xla_force_host_platform_device_count" not in (_prev_xla_flags or ""):
+    os.environ["XLA_FLAGS"] = ((_prev_xla_flags or "") +
+                               " --xla_force_host_platform_device_count=8")
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:   # pre-0.4.34 jax: XLA_FLAGS above already did it
+    jax.devices()        # force CPU client init while the flag is active
+    if _prev_xla_flags is None:
+        del os.environ["XLA_FLAGS"]
+    else:
+        os.environ["XLA_FLAGS"] = _prev_xla_flags
 
 
 def pytest_terminal_summary(terminalreporter, exitstatus, config):

@@ -38,8 +38,8 @@ def test_dp_allreduce_payload_matches_grad_bytes():
             "softmax_label": jax.device_put(np.zeros((8,), np.float32),
                                             spec.batch_sharding())}
     jitted = tr._build_step(donate=False)
-    txt = jitted.lower(params, mom, aux, feed, tr._keys()) \
-        .compile().as_text()
+    txt = jitted.lower(params, mom, aux, feed, tr._keys(),
+                       tr._guard_arrays()).compile().as_text()
 
     acct = collective_accounting(txt)
     assert "all-reduce" in acct, sorted(acct)
@@ -54,3 +54,25 @@ def test_dp_allreduce_payload_matches_grad_bytes():
 def test_ring_wire_model():
     assert ring_allreduce_wire_bytes(1000, 8) == 2 * 7 * 1000 // 8
     assert ring_allreduce_wire_bytes(1000, 1) == 0
+
+
+def test_async_start_counts_operand_shapes_only():
+    """-start accounting (audit.py): all-gather/reduce-scatter are
+    asymmetric — halving the (operand, result) tuple overstated the
+    all-gather payload by (1+n)/2; the operand shapes alone are what the
+    collective is fed."""
+    hlo = "\n".join([
+        "  %ag = (f32[4]{0}, f32[16]{0}) all-gather-start(f32[4]{0} %x), "
+        "replica_groups={{0,1,2,3}}, dimensions={0}",
+        "  %rs = (f32[16]{0}, f32[4]{0}) reduce-scatter-start(f32[16]{0} "
+        "%y), replica_groups={{0,1,2,3}}",
+        "  %ar = (f32[8]{0}, f32[8]{0}) all-reduce-start(f32[8]{0} %z), "
+        "replica_groups={}",
+        "  %done = f32[16]{0} all-gather-done(%ag)",
+    ])
+    acct = collective_accounting(hlo)
+    assert acct["all-gather"]["bytes"] == 4 * 4      # operand, not result
+    assert acct["reduce-scatter"]["bytes"] == 16 * 4
+    # symmetric op: operand == result == old halved-tuple accounting
+    assert acct["all-reduce"]["bytes"] == 8 * 4
+    assert acct["all-gather"]["count"] == 1          # -done not re-counted

@@ -210,3 +210,33 @@ def test_updater_fused_batch_falls_back_for_adam():
     up.update_batch([(0, g, w)])
     up_ref(0, g, w_ref)
     assert_almost_equal(w.asnumpy(), w_ref.asnumpy(), rtol=1e-6)
+
+
+def test_multi_sgd_lr_schedule_does_not_recompile():
+    """lrs/wds are tuple-of-float dynamic params (ops/registry.py): a
+    scheduled lr must reuse ONE compiled program across steps instead of
+    recompiling the fused multi-tensor update every value change."""
+    from mxnet_tpu.ops import registry
+
+    op = registry.get_op("multi_sgd_update")
+    fns = []
+    for lr in (0.1, 0.05, 0.025):
+        attrs = op.parse_attrs(dict(lrs=(lr, lr * 2), wds=(0.0, 1e-4),
+                                    num_weights=2))
+        fns.append(registry.jitted_apply(op, attrs))
+    assert all(f.func is fns[0].func for f in fns), \
+        "changing lrs must hit the same jitted closure (traced args)"
+    w = nd.ones((3,))._handle
+    g = nd.ones((3,))._handle
+    new_w = fns[1](w, g, w, g)[0]
+    assert_almost_equal(np.asarray(new_w), np.full(3, 1 - 0.05, np.float32),
+                        rtol=1e-6)
+
+    mom_op = registry.get_op("multi_mp_sgd_mom_update")
+    a1 = mom_op.parse_attrs(dict(lrs=(0.1,), wds=(0.0,), momentum=0.9,
+                                 num_weights=1))
+    a2 = mom_op.parse_attrs(dict(lrs=(0.2,), wds=(0.0,), momentum=0.9,
+                                 num_weights=1))
+    f1 = registry.jitted_apply(mom_op, a1)
+    f2 = registry.jitted_apply(mom_op, a2)
+    assert f1.func is f2.func

@@ -82,11 +82,11 @@ def run_framework(seq, batch):
         jax.random.randint(key, (batch, seq), 0, VOCAB).astype(jnp.float32),
         spec.batch_sharding())
     feed = {"data": data, "softmax_label": label}
-    state = [params, mom, aux, None]
+    state = [params, mom, aux, None, trainer._guard_arrays()]
 
     def step_once():
-        state[0], state[1], state[2], state[3] = step(
-            state[0], state[1], state[2], feed, keys)
+        state[0], state[1], state[2], state[3], _ok, state[4] = step(
+            state[0], state[1], state[2], feed, keys, state[4])
     step_once.sync = lambda: float(state[3])
     return timed_windows(step_once)
 

@@ -46,7 +46,8 @@ def dump_framework(batch):
     data = jnp.zeros((batch, 3, 224, 224), jnp.float32)
     label = jnp.zeros((batch,), jnp.float32)
     lowered = step.lower(params, mom, aux,
-                         {"data": data, "softmax_label": label}, keys)
+                         {"data": data, "softmax_label": label}, keys,
+                         trainer._guard_arrays())
     txt = lowered.compile().as_text()
     path = "/tmp/hlo_framework_bs%d.txt" % batch
     open(path, "w").write(txt)
