@@ -12,6 +12,7 @@
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
+#include <unistd.h>
 
 #include "mxnet_tpu_c_api.h"
 
@@ -226,5 +227,10 @@ int main(void) {
   }
   CHECK(MXNotifyShutdown());
   printf("C ABI LeNet training: OK\n");
-  return 0;
+  /* The shim owns an embedded CPython holding live JAX/XLA worker
+   * threads; letting main() return races static destructors against
+   * those threads and segfaults intermittently AFTER the test has
+   * passed.  Skip process teardown entirely: flush, then _exit. */
+  fflush(NULL);
+  _exit(0);
 }

@@ -7,6 +7,8 @@
  * with Symbol::Op, bind an Executor, Forward/Backward, SGD updates, and
  * verify the loss decreases.
  */
+#include <unistd.h>
+
 #include <cmath>
 #include <cstdio>
 #include <random>
@@ -101,5 +103,8 @@ int main() {
     return 1;
   }
   std::printf("cpp-package MLP training: OK\n");
-  return 0;
+  // skip static-destructor teardown: the embedded interpreter's JAX
+  // worker threads race it and segfault AFTER success (see test_lenet.c)
+  std::fflush(nullptr);
+  _exit(0);
 }

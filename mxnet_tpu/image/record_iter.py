@@ -98,6 +98,7 @@ class ImageRecordIter(DataIter):
         self._rng = random.Random(seed)
         self._order = None
         self._lock = threading.Lock()
+        self._epoch = -1      # reset() below brings it to 0
         self.reset()
 
     @property
@@ -115,6 +116,7 @@ class ImageRecordIter(DataIter):
         if self._native is not None:
             self._native.reset()
             return
+        self._epoch += 1
         if self._keys is not None:
             self._order = list(self._keys)
             if self.shuffle:
@@ -122,6 +124,53 @@ class ImageRecordIter(DataIter):
         else:
             self._rec.reset()
         self._cursor = 0
+
+    # -- exact-resume state ----------------------------------------------
+    def state_dict(self):
+        """Checkpointable position: cursor, epoch, this epoch's shuffled
+        key order, and the shuffle-RNG state (so FUTURE epochs reshuffle
+        identically).  Requires the indexed pure-Python pipeline."""
+        from ..base import MXNetError
+        if self._native is not None:
+            raise MXNetError(
+                "exact-resume iterator state needs the Python RecordIO "
+                "pipeline; set MXNET_TPU_NATIVE_IO=0")
+        if self._order is None:
+            raise MXNetError(
+                "exact-resume iterator state needs an indexed record file "
+                "(.idx) — the sequential-scan path has no cursor to save")
+        with self._lock:
+            return {"kind": "ImageRecordIter",
+                    "cursor": int(self._cursor),
+                    "epoch": int(self._epoch),
+                    "order": np.asarray(self._order, np.int64),
+                    "rng_state": self._rng.getstate()}
+
+    def load_state_dict(self, state):
+        from ..base import MXNetError
+        if state.get("kind") != "ImageRecordIter":
+            raise ValueError("state is for %r, not ImageRecordIter"
+                             % state.get("kind"))
+        if self._native is not None:
+            raise MXNetError(
+                "exact-resume iterator state needs the Python RecordIO "
+                "pipeline; set MXNET_TPU_NATIVE_IO=0")
+        order = [int(k) for k in np.asarray(state["order"])]
+        missing = set(order) - set(self._keys or [])
+        if missing:
+            raise ValueError(
+                "iterator state mismatch: %d saved record keys not in this "
+                "record file (e.g. %r)" % (len(missing),
+                                           sorted(missing)[:3]))
+        with self._lock:
+            self._order = order
+            self._cursor = int(state["cursor"])
+            self._epoch = int(state["epoch"])
+            rng_state = state.get("rng_state")
+            if rng_state is not None:
+                version, internal, gauss = rng_state
+                self._rng.setstate(
+                    (int(version), tuple(int(v) for v in internal), gauss))
 
     def _read_record(self):
         """One raw record, retried with backoff on transient IO errors

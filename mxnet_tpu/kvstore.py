@@ -326,41 +326,58 @@ class KVStoreTPUDist(KVStore):
         from .parallel import barrier as _barrier
         _barrier()
 
-    _dead_probe_counter = 0
-
     def num_dead_node(self, node_id=0, timeout_sec=60):
-        """Reference kvstore.h:338 (ps-lite heartbeat count).  In the TPU
-        failure model the coordination service heartbeats peers itself and
-        FAILS collectives when one dies (the launcher then tears the job
-        down; recovery = checkpoint restart, SURVEY §5.3) — so a healthy
-        store always reports 0.  This probe validates the coordinator is
-        reachable with a key-value roundtrip; an unreachable coordinator
-        counts as one dead node.  No collectives are issued (a timed-out
-        side-thread barrier would desynchronize later collectives)."""
+        """Reference kvstore.h:338 (ps-lite heartbeat count).  Two lanes,
+        neither issuing a collective (a timed-out side-thread barrier
+        would desynchronize later collectives):
+
+        1. coordinator probe — a bounded key-value write+read roundtrip
+           on ONE per-rank key (overwritten in place and deleted after,
+           so repeated probes hold zero keys); an unreachable coordinator
+           counts as one dead node.  The read is ``blocking_key_value_get``
+           with ``timeout_sec`` so a wedged coordinator cannot hang the
+           caller past its budget.
+        2. heartbeat lane (resilience/watchdog.HeartbeatLane) — peers
+           whose last ``rank/step/timestamp`` beat is older than
+           ``timeout_sec`` are counted dead, the ps-lite heartbeat
+           semantics this API had in the reference."""
         if self.num_workers <= 1:
             return 0
+        from .resilience import watchdog as _wd
         try:
             from jax._src import distributed
             client = getattr(distributed.global_state, "client", None)
             if client is None:
                 return 0
-            KVStoreTPUDist._dead_probe_counter += 1
-            key = "mxt_dead_probe/%d/%d" % (self.rank,
-                                            self._dead_probe_counter)
-            client.key_value_set(key, "1")
-            return 0
+            key = "mxt_dead_probe/%d" % self.rank
+            _wd.HeartbeatLane._kv_set(client, key, "1")
+            try:
+                client.blocking_key_value_get(
+                    key, max(1, int(float(timeout_sec) * 1000)))
+            finally:
+                try:
+                    client.key_value_delete(key)
+                except Exception:
+                    pass
+            coordinator_dead = 0
         except Exception:
-            return 1
+            coordinator_dead = 1
+        return coordinator_dead + _wd.lane().num_dead(timeout_sec)
 
     def _reduce(self, k, vlist):
+        from .parallel.audit import record_collective
+        from .resilience import watchdog as _wd
         merged = super()._reduce(k, vlist)
         if self.num_workers > 1:
-            if isinstance(merged, RowSparseNDArray):
-                from .parallel import allreduce_row_sparse
-                merged = allreduce_row_sparse(merged)
-            else:
-                from .parallel import allreduce_array
-                merged._handle = allreduce_array(merged._handle)
+            with _wd.watch("KVStoreTPUDist._reduce(%s)" % k,
+                           kind="collective"):
+                if isinstance(merged, RowSparseNDArray):
+                    from .parallel import allreduce_row_sparse
+                    merged = allreduce_row_sparse(merged)
+                else:
+                    from .parallel import allreduce_array
+                    merged._handle = allreduce_array(merged._handle)
+            record_collective("all-reduce", "KVStoreTPUDist._reduce(%s)" % k)
         return merged
 
 

@@ -78,10 +78,18 @@ def init_distributed(coordinator_address=None, num_processes=None,
 
 
 def barrier(name="kvstore_barrier"):
-    """Global barrier (reference KVStore::Barrier, kvstore.h:349)."""
+    """Global barrier (reference KVStore::Barrier, kvstore.h:349).
+
+    Watchdog-armed: a rank that never arrives leaves the others blocked
+    here forever, so the deadline turns that silence into a stack dump +
+    post-mortem + fail-fast (resilience/watchdog.py)."""
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices(name)
+        from ..resilience import watchdog as _wd
+        from .audit import record_collective
+        with _wd.watch("parallel.barrier(%s)" % name, kind="collective"):
+            multihost_utils.sync_global_devices(name)
+        record_collective("barrier", name)
 
 
 def allreduce_array(x):
@@ -91,8 +99,13 @@ def allreduce_array(x):
     if jax.process_count() == 1:
         return x
     from jax.experimental import multihost_utils
-    gathered = multihost_utils.process_allgather(x)
-    return jnp.sum(gathered, axis=0)
+    from ..resilience import watchdog as _wd
+    from .audit import record_collective
+    with _wd.watch("parallel.allreduce_array", kind="collective"):
+        gathered = multihost_utils.process_allgather(x)
+        out = jnp.sum(gathered, axis=0)
+    record_collective("all-reduce", "parallel.allreduce_array")
+    return out
 
 
 def allreduce_row_sparse(rs):
@@ -104,6 +117,17 @@ def allreduce_row_sparse(rs):
         return rs
     from jax.experimental import multihost_utils
     from ..ndarray.sparse import RowSparseNDArray, merge_row_sparse
+    from ..resilience import watchdog as _wd
+    from .audit import record_collective
+    with _wd.watch("parallel.allreduce_row_sparse", kind="collective"):
+        out = _allreduce_row_sparse_impl(rs, multihost_utils,
+                                         RowSparseNDArray, merge_row_sparse)
+    record_collective("all-gather", "parallel.allreduce_row_sparse")
+    return out
+
+
+def _allreduce_row_sparse_impl(rs, multihost_utils, RowSparseNDArray,
+                               merge_row_sparse):
     nnz = rs._data.shape[0]
     max_nnz = int(np.max(multihost_utils.process_allgather(
         jnp.asarray([nnz]))))

@@ -11,6 +11,9 @@ scaling at 256 GPUs rode exactly this ring-allreduce cost model
 from __future__ import annotations
 
 import re
+import threading
+import time
+from collections import deque
 
 _DTYPE_BYTES = {
     "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
@@ -23,6 +26,43 @@ _COLLECTIVES = ("all-reduce", "reduce-scatter", "all-gather", "all-to-all",
                 "collective-permute")
 
 _SHAPE_RE = re.compile(r"(pred|[sufc]\d+|bf16)\[([\d,]*)\]")
+
+# ---------------------------------------------------------------------------
+# Runtime collective trail.  The HLO accounting above is static; this is the
+# dynamic half: every collective/barrier entry point records a completion
+# event here, so when the watchdog (resilience/watchdog.py) fires on a hang
+# the post-mortem can say which collective LAST finished — i.e. where in the
+# program the ranks diverged.  Bounded deque, thread-safe, ~O(ns) per event.
+# ---------------------------------------------------------------------------
+
+_RUNTIME_LOG: "deque" = deque(maxlen=128)
+_RUNTIME_LOCK = threading.Lock()
+
+
+def record_collective(kind: str, tag: str = "", step=None):
+    """Note a completed collective (``kind`` = psum/barrier/ppermute/
+    all_to_all/..., ``tag`` = call-site label)."""
+    with _RUNTIME_LOCK:
+        _RUNTIME_LOG.append({"time": time.time(), "kind": kind,
+                             "tag": tag, "step": step})
+
+
+def last_collective():
+    """The most recent completed-collective event, or None."""
+    with _RUNTIME_LOCK:
+        return dict(_RUNTIME_LOG[-1]) if _RUNTIME_LOG else None
+
+
+def collective_log(n: int = None):
+    """The newest ``n`` (default: all retained) collective events."""
+    with _RUNTIME_LOCK:
+        items = [dict(e) for e in _RUNTIME_LOG]
+    return items[-n:] if n else items
+
+
+def clear_collective_log():
+    with _RUNTIME_LOCK:
+        _RUNTIME_LOG.clear()
 
 
 def _shape_bytes(type_expr):

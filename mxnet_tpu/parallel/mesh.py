@@ -20,7 +20,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["MeshSpec", "make_mesh", "data_parallel_mesh", "current_mesh",
-           "set_current_mesh", "shard_batch", "replicate", "P"]
+           "set_current_mesh", "shard_batch", "replicate", "P",
+           "describe_devices"]
 
 
 class MeshSpec:
@@ -82,3 +83,31 @@ def shard_batch(x, spec: MeshSpec):
 
 def replicate(x, spec: MeshSpec):
     return jax.device_put(x, spec.replicated())
+
+
+def describe_devices() -> dict:
+    """Topology snapshot for diagnostics (the watchdog post-mortem):
+    process rank/count, per-device platform/id/process, and the current
+    mesh layout if one is active.  Never raises — each field degrades to
+    an error string, because this runs while the program may be wedged."""
+    out = {}
+    try:
+        out["process_index"] = jax.process_index()
+        out["process_count"] = jax.process_count()
+    except Exception as e:
+        out["process"] = repr(e)
+    try:
+        out["devices"] = [
+            {"id": d.id, "platform": d.platform,
+             "process_index": d.process_index, "kind": str(d.device_kind)}
+            for d in jax.devices()]
+    except Exception as e:
+        out["devices"] = repr(e)
+    try:
+        spec = current_mesh()
+        if spec is not None:
+            out["mesh"] = {"shape": dict(spec.mesh.shape),
+                           "axes": list(spec.mesh.axis_names)}
+    except Exception as e:
+        out["mesh"] = repr(e)
+    return out

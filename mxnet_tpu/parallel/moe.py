@@ -126,4 +126,9 @@ def moe_ffn(x, wg, w1, w2, mesh: Mesh, axis: str = "ep",
         fn, mesh=mesh,
         in_specs=(P(axis), P(), P(axis), P(axis)),
         out_specs=(P(axis), P()), **compat)
-    return sharded(x, wg, w1, w2)
+    from ..resilience import watchdog as _wd
+    from .audit import record_collective
+    with _wd.watch("parallel.moe_ffn", kind="collective"):
+        out = sharded(x, wg, w1, w2)
+    record_collective("all-to-all", "parallel.moe_ffn")
+    return out

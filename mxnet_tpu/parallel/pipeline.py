@@ -88,10 +88,15 @@ def pipeline_apply(stage_fn: Callable, num_stages: int, mesh: Mesh,
     compat = {} if hasattr(jax.lax, "pvary") else {"check_rep": False}
     mapped = shard_map(per_device, mesh=mesh, in_specs=in_specs,
                        out_specs=out_specs, **compat)
-    params_sharded = jax.device_put(
-        params_stacked, NamedSharding(mesh, P(axis)))
-    x_rep = jax.device_put(x_micro, NamedSharding(mesh, P()))
-    return jax.jit(mapped)(params_sharded, x_rep)
+    from ..resilience import watchdog as _wd
+    from .audit import record_collective
+    with _wd.watch("parallel.pipeline_apply", kind="collective"):
+        params_sharded = jax.device_put(
+            params_stacked, NamedSharding(mesh, P(axis)))
+        x_rep = jax.device_put(x_micro, NamedSharding(mesh, P()))
+        out = jax.jit(mapped)(params_sharded, x_rep)
+    record_collective("collective-permute", "parallel.pipeline_apply")
+    return out
 
 
 class PipelineRunner:

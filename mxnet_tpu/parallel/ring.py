@@ -117,10 +117,15 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
     mapped = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, **compat)
     sharding = NamedSharding(mesh, spec)
-    q = jax.device_put(q, sharding)
-    k = jax.device_put(k, sharding)
-    v = jax.device_put(v, sharding)
-    return jax.jit(mapped)(q, k, v)
+    from ..resilience import watchdog as _wd
+    from .audit import record_collective
+    with _wd.watch("parallel.ring_attention", kind="collective"):
+        q = jax.device_put(q, sharding)
+        k = jax.device_put(k, sharding)
+        v = jax.device_put(v, sharding)
+        out = jax.jit(mapped)(q, k, v)
+    record_collective("collective-permute", "parallel.ring_attention")
+    return out
 
 
 def reference_attention(q, k, v, causal=False, scale=None):

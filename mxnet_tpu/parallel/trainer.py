@@ -381,6 +381,8 @@ class ShardedTrainer:
         here so fault drills exercise this exact code path."""
         from ..executor import backward_mirror_policy
         from ..resilience import chaos as _chaos
+        from ..resilience import watchdog as _watchdog
+        from .audit import record_collective
         remat = backward_mirror_policy()
         if self._step is None or remat != self._built_remat:
             self._built_remat = remat
@@ -393,14 +395,23 @@ class ShardedTrainer:
             poison = self.data_names[0]
             batch = dict(batch)
             batch[poison] = np.full_like(np.asarray(batch[poison]), np.nan)
-        inputs = {n: jax.device_put(v, self.spec.batch_sharding())
-                  for n, v in batch.items()}
-        keys = self._keys()
-        params, mom, aux, loss, ok, guard = self._step(
-            params, mom, aux, inputs, keys, self._guard_arrays())
-        self._guard_state = guard
-        if self.guard_nonfinite:
-            self._note_step_result(bool(ok), loss)
+        # the deadline covers everything a stall can hide in: the chaos
+        # hang drill, host->device transfer, and the jitted step with its
+        # fused gradient psum (a dead peer blocks right here)
+        with _watchdog.watch("ShardedTrainer.step", kind="step",
+                             step=self._step_count):
+            _chaos.maybe_hang(self._step_count)
+            inputs = {n: jax.device_put(v, self.spec.batch_sharding())
+                      for n, v in batch.items()}
+            keys = self._keys()
+            params, mom, aux, loss, ok, guard = self._step(
+                params, mom, aux, inputs, keys, self._guard_arrays())
+            self._guard_state = guard
+            if self.guard_nonfinite:
+                self._note_step_result(bool(ok), loss)
+        record_collective("psum", "ShardedTrainer.step dp grad all-reduce",
+                          step=self._step_count)
+        _watchdog.heartbeat(self._step_count)
         return params, mom, aux, loss
 
     def _note_step_result(self, ok, loss):

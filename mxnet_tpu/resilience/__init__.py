@@ -20,9 +20,16 @@ that layer (cf. "TensorFlow: a system for large-scale ML", arXiv:1605.08695
   that aborts with diagnostics instead of silently training on NaNs.
 * ``retry``      — exponential-backoff retry with a wall-clock timeout
   for flaky external surfaces (dist kvstore creation, RecordIO reads).
+* ``watchdog``   — hang/straggler detection: per-step + per-collective
+  deadlines enforced by a monitor thread that dumps all-thread stacks,
+  writes a post-mortem report (stuck frames, last-completed collective,
+  peer heartbeats, straggler lag) and fail-fasts so the launcher's
+  restart path kicks in; plus a coordination-KV heartbeat lane giving
+  ``num_dead_node``/straggler telemetry without issuing collectives.
 * ``chaos``      — fault injection (env or context manager): simulated
-  preemption, checkpoint corruption, NaN gradients, transient IO errors.
-  The resilience tests use it to prove recovery end-to-end.
+  preemption, checkpoint corruption, NaN gradients, transient IO
+  errors, silent hangs.  The resilience tests use it to prove recovery
+  end-to-end.
 """
 from .container import (CorruptContainer, peek_header, read_container,
                         write_container)
@@ -31,12 +38,15 @@ from .checkpoint import (Checkpoint, CheckpointManager, restore_gluon_trainer,
                          save_module, save_trainer)
 from .guards import GradientGuard, NonFiniteError
 from .retry import call_with_retry, retry_config
+from .watchdog import HeartbeatLane, Watchdog
 from . import chaos
+from . import watchdog
 
 __all__ = [
     "CorruptContainer", "write_container", "read_container", "peek_header",
     "Checkpoint", "CheckpointManager", "save_trainer", "restore_trainer",
     "save_module", "restore_module", "save_gluon_trainer",
     "restore_gluon_trainer", "GradientGuard", "NonFiniteError",
-    "call_with_retry", "retry_config", "chaos",
+    "call_with_retry", "retry_config", "chaos", "watchdog", "Watchdog",
+    "HeartbeatLane",
 ]

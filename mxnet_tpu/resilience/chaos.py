@@ -13,6 +13,10 @@ recovery machinery is *proven* by tests instead of trusted:
   retry/backoff path in RecordIO readers and kvstore creation.
 * ``corrupt_ckpt`` — :func:`corrupt_latest` truncates or garbages the
   newest checkpoint, exercising ``CheckpointManager.latest()`` fallback.
+* ``hang``         — the calling rank SLEEPS inside the step (default
+  ``MXNET_TPU_CHAOS_HANG_SECONDS``, 3600 s), simulating a silent stall:
+  peers block in the next collective and only the watchdog
+  (resilience/watchdog.py) can turn the hang into a diagnosed fail-fast.
 
 Faults are armed either with the :func:`inject` context manager (tests)
 or the ``MXNET_TPU_CHAOS`` env var (whole-run drills), a comma list of
@@ -28,7 +32,8 @@ import os
 from typing import List, Optional
 
 __all__ = ["SimulatedPreemption", "inject", "fire", "maybe_preempt",
-           "maybe_io_error", "corrupt_latest", "active", "reset"]
+           "maybe_io_error", "maybe_hang", "corrupt_latest", "active",
+           "reset"]
 
 
 class SimulatedPreemption(RuntimeError):
@@ -129,6 +134,23 @@ def maybe_preempt(step: Optional[int] = None):
     if fire("preempt", step) is not None:
         raise SimulatedPreemption(
             "chaos: simulated host preemption at step %s" % step)
+
+
+def maybe_hang(step: Optional[int] = None):
+    """Sleep in place if a ``hang`` fault fires now — the silent-stall
+    drill.  Duration comes from the fault's ``seconds`` param, falling
+    back to ``MXNET_TPU_CHAOS_HANG_SECONDS`` (default 3600).  The sleep
+    happens INSIDE the watchdog-armed step region, so the drill proves
+    detection + post-mortem + fail-fast, not a mock of them."""
+    params = fire("hang", step)
+    if params is None:
+        return
+    import time
+    seconds = float(params.get("seconds",
+                    os.environ.get("MXNET_TPU_CHAOS_HANG_SECONDS", "3600")))
+    print("chaos: rank hanging for %.1fs at step %s" % (seconds, step),
+          flush=True)
+    time.sleep(seconds)
 
 
 def maybe_io_error(desc: str = ""):

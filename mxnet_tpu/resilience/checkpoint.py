@@ -53,6 +53,10 @@ class CheckpointManager:
         os.makedirs(self.directory, exist_ok=True)
         self._pat = re.compile(
             re.escape(prefix) + r"-(\d{10})" + re.escape(_SUFFIX) + r"$")
+        # watchdog post-mortems default to landing next to the
+        # checkpoints, so recovery state and hang forensics share a dir
+        from . import watchdog as _watchdog
+        _watchdog.set_default_report_dir(self.directory)
 
     def path_for(self, step: int) -> str:
         return os.path.join(self.directory,
@@ -205,13 +209,34 @@ def _int_key(k):
         return k
 
 
+def _dump_iter_state(data_iter, arrays, meta):
+    """Fold ``data_iter.state_dict()`` into a checkpoint (exact-resume:
+    cursor/epoch/shuffle order ride along with the model state, so a
+    mid-epoch restart replays no batch and drops none)."""
+    if data_iter is None:
+        return
+    if not hasattr(data_iter, "state_dict"):
+        raise MXNetError("%s has no state_dict(); exact-resume iterator "
+                         "state needs NDArrayIter/ImageRecordIter"
+                         % type(data_iter).__name__)
+    meta["iter_tree"] = _flatten(data_iter.state_dict(), "iter", arrays)
+
+
+def _load_iter_state(data_iter, arrays, meta):
+    if data_iter is None or "iter_tree" not in meta:
+        return
+    data_iter.load_state_dict(_unflatten(meta["iter_tree"], arrays))
+
+
 # ---------------------------------------------------------------------------
 # ShardedTrainer adapter
 # ---------------------------------------------------------------------------
 
-def save_trainer(manager, trainer, params, mom, aux, step, extra_meta=None):
+def save_trainer(manager, trainer, params, mom, aux, step, extra_meta=None,
+                 data_iter=None):
     """Snapshot a ShardedTrainer's full state (params, momentum, aux,
-    loss-scale automaton, input shapes) as one atomic checkpoint."""
+    loss-scale automaton, input shapes, optional iterator position) as
+    one atomic checkpoint."""
     arrays = {}
     for n, p in zip(trainer.param_names, params):
         arrays["param/" + n] = np.asarray(p)
@@ -224,10 +249,11 @@ def save_trainer(manager, trainer, params, mom, aux, step, extra_meta=None):
     meta["shapes"] = {k: list(v) for k, v
                       in (getattr(trainer, "_last_shapes", None) or {}).items()}
     meta.update(trainer.resilience_meta())
+    _dump_iter_state(data_iter, arrays, meta)
     return manager.save(step, arrays, meta)
 
 
-def restore_trainer(manager, trainer, step=None):
+def restore_trainer(manager, trainer, step=None, data_iter=None):
     """Restore (params, mom, aux) onto ``trainer``'s mesh — each tensor is
     ``device_put`` with the trainer's OWN sharding rule, so the snapshot
     reshards correctly even if the mesh/topology changed across restarts.
@@ -259,6 +285,7 @@ def restore_trainer(manager, trainer, step=None):
     aux = tuple(jax.device_put(ck.arrays["aux/" + n], rep)
                 for n in trainer.prog.aux_names)
     trainer.set_resilience_state(meta)
+    _load_iter_state(data_iter, ck.arrays, meta)
     return params, mom, aux, ck.step, meta
 
 
@@ -266,8 +293,9 @@ def restore_trainer(manager, trainer, step=None):
 # Module / FeedForward adapter
 # ---------------------------------------------------------------------------
 
-def save_module(manager, module, step, extra_meta=None):
-    """Snapshot a bound Module: arg/aux params + optimizer slot state."""
+def save_module(manager, module, step, extra_meta=None, data_iter=None):
+    """Snapshot a bound Module: arg/aux params + optimizer slot state
+    (+ exact-resume iterator position when ``data_iter`` is given)."""
     arg_params, aux_params = module.get_params()
     arrays = {}
     for n, v in arg_params.items():
@@ -281,10 +309,11 @@ def save_module(manager, module, step, extra_meta=None):
         dump, _ = _updater_state_io(updater)
         dump(arrays, meta)
     _dump_guard(getattr(module, "_grad_guard", None), meta)
+    _dump_iter_state(data_iter, arrays, meta)
     return manager.save(step, arrays, meta)
 
 
-def restore_module(manager, module, step=None):
+def restore_module(manager, module, step=None, data_iter=None):
     """Restore params (+ optimizer state when the optimizer is already
     initialized) into a bound Module.  Returns (step, meta) or None."""
     ck = manager.restore(step) if step is not None else manager.latest()
@@ -306,6 +335,7 @@ def restore_module(manager, module, step=None):
         _, load = _updater_state_io(updater)
         load(ck.arrays, meta)
     _load_guard(getattr(module, "_grad_guard", None), meta)
+    _load_iter_state(data_iter, ck.arrays, meta)
     return ck.step, meta
 
 
@@ -323,7 +353,8 @@ def _module_updater(module):
 # gluon.Trainer adapter
 # ---------------------------------------------------------------------------
 
-def save_gluon_trainer(manager, trainer, step, extra_meta=None):
+def save_gluon_trainer(manager, trainer, step, extra_meta=None,
+                       data_iter=None):
     """Snapshot a gluon.Trainer: parameter values + optimizer slots."""
     arrays = {}
     for p in trainer._params:
@@ -333,10 +364,11 @@ def save_gluon_trainer(manager, trainer, step, extra_meta=None):
     dump, _ = _updater_state_io(trainer._updaters)
     dump(arrays, meta)
     _dump_guard(getattr(trainer, "_grad_guard", None), meta)
+    _dump_iter_state(data_iter, arrays, meta)
     return manager.save(step, arrays, meta)
 
 
-def restore_gluon_trainer(manager, trainer, step=None):
+def restore_gluon_trainer(manager, trainer, step=None, data_iter=None):
     """Restore parameters + optimizer slots into a gluon.Trainer.
     Returns (step, meta) or None."""
     ck = manager.restore(step) if step is not None else manager.latest()
@@ -353,6 +385,7 @@ def restore_gluon_trainer(manager, trainer, step=None):
     _, load = _updater_state_io(trainer._updaters)
     load(ck.arrays, meta)
     _load_guard(getattr(trainer, "_grad_guard", None), meta)
+    _load_iter_state(data_iter, ck.arrays, meta)
     return ck.step, meta
 
 

@@ -1,0 +1,553 @@
+"""Hang/straggler watchdog: per-step deadlines + stack-dump forensics.
+
+The failure mode that dominates multi-host TPU jobs is not the crash but
+the *silent hang*: one rank stalls (bad host, wedged DMA, a data loader
+deadlock) inside a collective and every other rank blocks forever with
+zero diagnostics — the job burns accelerator-hours until a human notices.
+The reference MXNet gets hang detection for free from ps-lite heartbeats
+(van.cc resender + Postoffice::UpdateHeartbeat); a collectives backend
+has no parameter server to notice a dead peer, so this module supplies
+the equivalent (cf. "TensorFlow: a system for large-scale ML",
+arXiv:1605.08695 §4.3 — health monitoring as part of the fault model):
+
+* **Deadline watchdog** — a daemon monitor thread arms a deadline around
+  every training step and every collective/barrier entry point
+  (``ShardedTrainer.step``, ``parallel.barrier``/``allreduce_*``,
+  ``KVStoreTPUDist._reduce``, ring/pipeline/moe).  On expiry it dumps
+  ALL thread stacks via :mod:`faulthandler`, writes a post-mortem report
+  (step, stuck frames, last-completed collective from
+  ``parallel.audit``, peer heartbeats, straggler lag, env, device set)
+  next to the checkpoints, and then either **aborts** the process —
+  fail-fast, so the launcher's checkpoint-restart path (tools/launch.py
+  ``--max-restarts``) kicks in — or keeps waiting, per
+  ``MXNET_TPU_WATCHDOG_ACTION``.
+
+* **Heartbeat lane** — each rank writes ``rank/step/timestamp`` to the
+  jax coordination-service KV store (the ps-lite heartbeat analog); any
+  rank can cheaply read every peer's latest beat WITHOUT issuing a
+  collective (a timed-out side-thread collective would desynchronize
+  the program).  This powers a real ``KVStore.num_dead_node`` and a
+  slowest-rank straggler report.
+
+Env knobs (all read at first use; ``reset()`` re-reads — tests):
+
+=================================  =========================================
+``MXNET_TPU_WATCHDOG``             master switch: ``1`` on, ``0`` off.
+                                   Unset: on iff a timeout knob is set.
+``MXNET_TPU_WATCHDOG_STEP_TIMEOUT``        seconds per training step
+                                           (default 300)
+``MXNET_TPU_WATCHDOG_COLLECTIVE_TIMEOUT``  seconds per collective/barrier
+                                           (default: the step timeout)
+``MXNET_TPU_WATCHDOG_ACTION``      ``abort`` (default): post-mortem then
+                                   ``os._exit(MXNET_TPU_WATCHDOG_EXIT_CODE)``;
+                                   ``wait``: post-mortem, log, keep waiting
+``MXNET_TPU_WATCHDOG_EXIT_CODE``   abort exit code (default 43)
+``MXNET_TPU_WATCHDOG_DIR``         post-mortem directory (default: the
+                                   newest CheckpointManager's directory,
+                                   else cwd)
+``MXNET_TPU_HEARTBEAT_INTERVAL``   min seconds between beats (default 0.5)
+=================================  =========================================
+
+Cost when disabled: one cached-bool check per ``watch()`` — no thread.
+"""
+from __future__ import annotations
+
+import faulthandler
+import json
+import logging
+import os
+import sys
+import threading
+import time
+import traceback
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+__all__ = ["Watchdog", "HeartbeatLane", "watch", "heartbeat", "lane",
+           "enabled", "configure", "reset", "set_default_report_dir",
+           "write_postmortem", "DEFAULT_EXIT_CODE"]
+
+DEFAULT_STEP_TIMEOUT = 300.0
+DEFAULT_EXIT_CODE = 43
+_POSTMORTEM_PREFIX = "watchdog-postmortem"
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ[name])
+    except (KeyError, ValueError):
+        return float(default)
+
+
+# ---------------------------------------------------------------------------
+# heartbeat lane over the jax coordination-service KV store
+# ---------------------------------------------------------------------------
+
+class HeartbeatLane:
+    """Per-rank ``rank -> (step, timestamp)`` over the coordination KV.
+
+    One key per rank (``mxt_hb/<rank>``), overwritten in place — the lane
+    holds O(ranks) keys total, forever.  Reads go through
+    ``key_value_dir_get`` so a single call sees every peer.  No
+    collectives are issued anywhere in this class.
+    """
+
+    PREFIX = "mxt_hb"
+
+    def __init__(self, client=None):
+        self._explicit_client = client
+        self._last_beat = 0.0
+        self._interval = _env_float("MXNET_TPU_HEARTBEAT_INTERVAL", 0.5)
+        self._lock = threading.Lock()
+
+    def _client(self):
+        if self._explicit_client is not None:
+            return self._explicit_client
+        try:
+            from jax._src import distributed
+            return getattr(distributed.global_state, "client", None)
+        except Exception:
+            return None
+
+    def _rank(self):
+        try:
+            import jax
+            return jax.process_index()
+        except Exception:
+            return 0
+
+    @staticmethod
+    def _kv_set(client, key, value):
+        """Overwrite-in-place set; never leaks one key per call."""
+        try:
+            client.key_value_set(key, value, allow_overwrite=True)
+        except TypeError:   # older client without the kwarg
+            try:
+                client.key_value_delete(key)
+            except Exception:
+                pass
+            client.key_value_set(key, value)
+
+    def beat(self, step: int, force: bool = False):
+        """Publish this rank's progress.  Throttled (default 0.5 s) so a
+        fast step loop does not hammer the coordinator; cheap no-op when
+        jax.distributed is not initialized."""
+        client = self._client()
+        if client is None:
+            return False
+        now = time.time()
+        with self._lock:
+            if not force and now - self._last_beat < self._interval:
+                return False
+            self._last_beat = now
+        try:
+            self._kv_set(client, "%s/%d" % (self.PREFIX, self._rank()),
+                         "%d:%.6f" % (int(step), now))
+            return True
+        except Exception:
+            return False
+
+    def peers(self) -> Dict[int, Dict[str, float]]:
+        """``{rank: {"step": int, "time": float}}`` for every rank that
+        has ever beaten.  Empty dict when the lane is inactive."""
+        client = self._client()
+        if client is None:
+            return {}
+        try:
+            entries = client.key_value_dir_get(self.PREFIX + "/")
+        except Exception:
+            return {}
+        out = {}
+        for key, value in entries:
+            try:
+                rank = int(str(key).rsplit("/", 1)[-1])
+                step_s, _, t_s = str(value).partition(":")
+                out[rank] = {"step": int(step_s), "time": float(t_s)}
+            except (ValueError, TypeError):
+                continue
+        return out
+
+    def num_dead(self, timeout_sec: float = 60.0) -> int:
+        """Ranks whose last heartbeat is older than ``timeout_sec`` (or
+        that never beat while peers did) — the ps-lite
+        ``GetNumDeadNode`` analog, computed from KV reads only."""
+        beats = self.peers()
+        if not beats:
+            return 0      # lane not in use: no evidence either way
+        try:
+            import jax
+            world = jax.process_count()
+        except Exception:
+            world = 1
+        # beats can name ranks beyond process_count (an injected client in
+        # tests, or keys from a larger prior incarnation): believe the lane
+        world = max(world, max(beats) + 1)
+        now = time.time()
+        dead = 0
+        for rank in range(world):
+            b = beats.get(rank)
+            if b is None or now - b["time"] > timeout_sec:
+                dead += 1
+        return dead
+
+    def straggler_report(self, stale_sec: float = 60.0) -> Optional[dict]:
+        """Slowest-rank lag report: per-rank step/age plus the lag (in
+        steps and seconds) of the slowest rank behind the fastest."""
+        beats = self.peers()
+        if not beats:
+            return None
+        now = time.time()
+        fastest = max(beats, key=lambda r: beats[r]["step"])
+        slowest = min(beats, key=lambda r: beats[r]["step"])
+        return {
+            "ranks": {str(r): {"step": beats[r]["step"],
+                               "age_sec": round(now - beats[r]["time"], 3)}
+                      for r in sorted(beats)},
+            "fastest_rank": fastest,
+            "slowest_rank": slowest,
+            "lag_steps": beats[fastest]["step"] - beats[slowest]["step"],
+            "lag_seconds": round(now - beats[slowest]["time"], 3),
+            "stale_ranks": [r for r in sorted(beats)
+                            if now - beats[r]["time"] > stale_sec],
+        }
+
+
+# ---------------------------------------------------------------------------
+# post-mortem report
+# ---------------------------------------------------------------------------
+
+def _thread_stacks(stuck_thread_id=None):
+    """Human-readable frames for every live thread; the stuck thread's
+    frames are returned separately for the report's headline."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    all_threads, stuck = {}, None
+    for tid, frame in sys._current_frames().items():
+        frames = [{"file": fs.filename, "line": fs.lineno,
+                   "function": fs.name, "code": (fs.line or "").strip()}
+                  for fs in traceback.extract_stack(frame)]
+        label = "%s (tid=%d)" % (names.get(tid, "?"), tid)
+        all_threads[label] = frames
+        if tid == stuck_thread_id:
+            stuck = frames
+    return all_threads, stuck
+
+
+def _env_snapshot():
+    keep = ("MXNET_TPU_", "MXNET_", "DMLC_", "JAX_", "XLA_FLAGS",
+            "TPU_", "MEGASCALE_")
+    return {k: v for k, v in sorted(os.environ.items())
+            if any(k.startswith(p) for p in keep)}
+
+
+def _device_snapshot():
+    """Device/topology facts for the report — guarded: jax may be wedged
+    or uninitialized, and the monitor thread must never raise."""
+    try:
+        from ..parallel.mesh import describe_devices
+        return describe_devices()
+    except Exception as e:
+        return {"error": repr(e)}
+
+
+def write_postmortem(report_dir: str, tag: str, step=None, deadline=None,
+                     armed_at=None, stuck_thread_id=None, action="abort",
+                     heartbeats=None, extra=None):
+    """Write ``<prefix>-r<rank>-<pid>.json`` + a faulthandler ``.stack``
+    dump into ``report_dir``.  Returns the JSON path (or None on total
+    failure — forensics must never mask the original hang)."""
+    try:
+        os.makedirs(report_dir, exist_ok=True)
+        try:
+            import jax
+            rank = jax.process_index()
+        except Exception:
+            rank = 0
+        base = os.path.join(report_dir, "%s-r%d-%d"
+                            % (_POSTMORTEM_PREFIX, rank, os.getpid()))
+        stack_path = base + ".stack"
+        # faulthandler first: async-signal-safe, works even if the
+        # interpreter state is too damaged for the pretty JSON below
+        with open(stack_path, "w") as f:
+            f.write("watchdog stack dump: tag=%s step=%s pid=%d\n"
+                    % (tag, step, os.getpid()))
+            faulthandler.dump_traceback(file=f, all_threads=True)
+
+        from ..parallel import audit
+        threads, stuck = _thread_stacks(stuck_thread_id)
+        lane_ = lane()
+        report = {
+            "kind": "watchdog_postmortem",
+            "tag": tag,
+            "step": step,
+            "rank": rank,
+            "pid": os.getpid(),
+            "time": time.time(),
+            "armed_at": armed_at,
+            "deadline_sec": deadline,
+            "action": action,
+            "stuck_frames": stuck,
+            "threads": threads,
+            "stack_dump": stack_path,
+            "last_collective": audit.last_collective(),
+            "collective_log": audit.collective_log(16),
+            "heartbeats": heartbeats if heartbeats is not None
+            else lane_.peers(),
+            "straggler": lane_.straggler_report(),
+            "devices": _device_snapshot(),
+            "env": _env_snapshot(),
+        }
+        if extra:
+            report.update(extra)
+        path = base + ".json"
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(report, f, indent=2, default=repr)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return path
+    except Exception:
+        logging.exception("watchdog: post-mortem write failed")
+        return None
+
+
+# ---------------------------------------------------------------------------
+# the watchdog proper
+# ---------------------------------------------------------------------------
+
+class _Armed:
+    __slots__ = ("tag", "kind", "step", "armed_at", "expires_at",
+                 "deadline", "thread_id", "fired")
+
+    def __init__(self, tag, kind, step, deadline, thread_id):
+        self.tag = tag
+        self.kind = kind
+        self.step = step
+        self.deadline = deadline
+        self.armed_at = time.monotonic()
+        self.expires_at = self.armed_at + deadline
+        self.thread_id = thread_id
+        self.fired = False
+
+
+class Watchdog:
+    """Deadline monitor.  ``watch()`` arms a deadline for the calling
+    thread; a daemon thread fires expiries.  One instance per process is
+    the norm (module-level :func:`watch`), but instances are independent
+    and tests may build their own."""
+
+    def __init__(self, step_timeout=None, collective_timeout=None,
+                 action=None, report_dir=None, exit_code=None, poll=0.25,
+                 on_expire=None):
+        self.step_timeout = (
+            _env_float("MXNET_TPU_WATCHDOG_STEP_TIMEOUT",
+                       DEFAULT_STEP_TIMEOUT)
+            if step_timeout is None else float(step_timeout))
+        self.collective_timeout = (
+            _env_float("MXNET_TPU_WATCHDOG_COLLECTIVE_TIMEOUT",
+                       self.step_timeout)
+            if collective_timeout is None else float(collective_timeout))
+        self.action = (action or
+                       os.environ.get("MXNET_TPU_WATCHDOG_ACTION", "abort"))
+        if self.action not in ("abort", "wait"):
+            raise ValueError("MXNET_TPU_WATCHDOG_ACTION must be 'abort' or "
+                             "'wait', got %r" % self.action)
+        self.report_dir = report_dir
+        self.exit_code = int(exit_code if exit_code is not None else
+                             os.environ.get("MXNET_TPU_WATCHDOG_EXIT_CODE",
+                                            DEFAULT_EXIT_CODE))
+        self.poll = float(poll)
+        self.on_expire = on_expire       # tests: called with the report path
+        self._armed: Dict[int, _Armed] = {}
+        self._next_token = 0
+        self._lock = threading.Lock()
+        self._thread = None
+        self._wake = threading.Event()
+        self._stop = False
+
+    # -- arming ----------------------------------------------------------
+    def arm(self, tag, kind="step", step=None, timeout=None) -> int:
+        deadline = timeout if timeout is not None else (
+            self.collective_timeout if kind == "collective"
+            else self.step_timeout)
+        entry = _Armed(tag, kind, step, float(deadline),
+                       threading.get_ident())
+        with self._lock:
+            self._next_token += 1
+            token = self._next_token
+            self._armed[token] = entry
+            self._ensure_thread()
+        self._wake.set()
+        return token
+
+    def disarm(self, token: int):
+        with self._lock:
+            self._armed.pop(token, None)
+
+    @contextmanager
+    def watch(self, tag, kind="step", step=None, timeout=None):
+        token = self.arm(tag, kind=kind, step=step, timeout=timeout)
+        try:
+            yield
+        finally:
+            self.disarm(token)
+
+    def stop(self):
+        """Tear the monitor thread down (tests)."""
+        with self._lock:
+            self._stop = True
+            self._armed.clear()
+        self._wake.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+        self._thread = None
+        self._stop = False
+
+    # -- monitor ---------------------------------------------------------
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._run, name="mxt-watchdog", daemon=True)
+            self._thread.start()
+
+    def _run(self):
+        while True:
+            with self._lock:
+                if self._stop:
+                    return
+                now = time.monotonic()
+                expired = [e for e in self._armed.values()
+                           if not e.fired and now >= e.expires_at]
+                for e in expired:
+                    e.fired = True
+            for e in expired:
+                try:
+                    self._expire(e)
+                except Exception:
+                    logging.exception("watchdog: expiry handling failed")
+            self._wake.wait(timeout=self.poll)
+            self._wake.clear()
+
+    def _report_dir(self):
+        return (self.report_dir
+                or os.environ.get("MXNET_TPU_WATCHDOG_DIR")
+                or _DEFAULT_REPORT_DIR
+                or os.getcwd())
+
+    def _expire(self, e: _Armed):
+        waited = time.monotonic() - e.armed_at
+        logging.error(
+            "watchdog: %r (kind=%s, step=%s) exceeded its %.1fs deadline "
+            "(waited %.1fs) — dumping stacks and writing post-mortem",
+            e.tag, e.kind, e.step, e.deadline, waited)
+        path = write_postmortem(
+            self._report_dir(), e.tag, step=e.step, deadline=e.deadline,
+            armed_at=e.armed_at, stuck_thread_id=e.thread_id,
+            action=self.action)
+        if self.on_expire is not None:
+            self.on_expire(path)
+        if self.action == "abort":
+            logging.error(
+                "watchdog: aborting (exit %d) so the launcher's "
+                "checkpoint-restart path can recover; post-mortem: %s",
+                self.exit_code, path)
+            sys.stderr.flush()
+            sys.stdout.flush()
+            os._exit(self.exit_code)
+        # action == "wait": leave the process blocked but observable;
+        # the entry stays fired so we report once per arm.
+
+
+# ---------------------------------------------------------------------------
+# module-level singleton plumbing
+# ---------------------------------------------------------------------------
+
+_LOCK = threading.Lock()
+_INSTANCE: Optional[Watchdog] = None
+_LANE: Optional[HeartbeatLane] = None
+_ENABLED: Optional[bool] = None
+_DEFAULT_REPORT_DIR: Optional[str] = None
+
+
+def enabled() -> bool:
+    """Cheap cached master-switch check (re-evaluated after reset())."""
+    global _ENABLED
+    if _ENABLED is None:
+        flag = os.environ.get("MXNET_TPU_WATCHDOG")
+        if flag is not None:
+            _ENABLED = flag not in ("0", "false", "off", "")
+        else:
+            _ENABLED = ("MXNET_TPU_WATCHDOG_STEP_TIMEOUT" in os.environ or
+                        "MXNET_TPU_WATCHDOG_COLLECTIVE_TIMEOUT" in os.environ)
+    return _ENABLED
+
+
+def configure(**kwargs) -> Watchdog:
+    """Build (or rebuild) the process watchdog with explicit settings and
+    enable it.  Accepts the :class:`Watchdog` constructor arguments."""
+    global _INSTANCE, _ENABLED
+    with _LOCK:
+        if _INSTANCE is not None:
+            _INSTANCE.stop()
+        _INSTANCE = Watchdog(**kwargs)
+        _ENABLED = True
+        return _INSTANCE
+
+
+def _instance() -> Watchdog:
+    global _INSTANCE
+    with _LOCK:
+        if _INSTANCE is None:
+            _INSTANCE = Watchdog()
+        return _INSTANCE
+
+
+def lane() -> HeartbeatLane:
+    global _LANE
+    with _LOCK:
+        if _LANE is None:
+            _LANE = HeartbeatLane()
+        return _LANE
+
+
+def reset():
+    """Tear down the singleton + cached config (tests)."""
+    global _INSTANCE, _LANE, _ENABLED, _DEFAULT_REPORT_DIR
+    with _LOCK:
+        inst, _INSTANCE = _INSTANCE, None
+        _LANE = None
+        _ENABLED = None
+        _DEFAULT_REPORT_DIR = None
+    if inst is not None:
+        inst.stop()
+
+
+def set_default_report_dir(path: str):
+    """Post-mortems land next to the checkpoints by default —
+    CheckpointManager calls this so forensics and recovery state share a
+    directory (explicit MXNET_TPU_WATCHDOG_DIR still wins)."""
+    global _DEFAULT_REPORT_DIR
+    _DEFAULT_REPORT_DIR = os.fspath(path)
+
+
+@contextmanager
+def watch(tag, kind="step", step=None, timeout=None):
+    """Arm the process watchdog around a block::
+
+        with watchdog.watch("ShardedTrainer.step", step=n):
+            ...                      # hang here -> stack dump + abort
+
+    No-op (one cached-bool check) when the watchdog is disabled.
+    """
+    if not enabled():
+        yield
+        return
+    with _instance().watch(tag, kind=kind, step=step, timeout=timeout):
+        yield
+
+
+def heartbeat(step: int, force: bool = False):
+    """Publish this rank's progress on the heartbeat lane (throttled;
+    no-op outside jax.distributed runs)."""
+    return lane().beat(step, force=force)

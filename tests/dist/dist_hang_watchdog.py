@@ -1,0 +1,140 @@
+"""Hang-detection + checkpoint-restart e2e drill (the watchdog analog of
+dist_elastic_train.py).
+
+Failure model (ISSUE 2 / SURVEY §5.3): one rank silently stalls inside
+the step; every peer blocks in the next collective with zero diagnostics.
+This script is run via `tools/launch.py --max-restarts 1` with the
+watchdog armed (MXNET_TPU_WATCHDOG_STEP_TIMEOUT small):
+
+  incarnation 0: all ranks train with per-epoch checkpoints; rank 1
+    HANGS (chaos `hang` fault: sleeps inside the fit step) after the
+    epoch-2 checkpoint exists.  Rank 1's watchdog fires on the step
+    deadline — stack dump + post-mortem into the checkpoint dir — and
+    fail-fasts (exit 43); peers blocked in the gradient collective are
+    reaped by the launcher, which relaunches the gang;
+  incarnation 1: every rank resumes from the checkpoint (begin_epoch
+    >= 2), finishes, and checks convergence + cross-rank agreement.
+
+The pytest wrapper (tests/test_dist.py) additionally asserts the
+post-mortem exists and its stack dump names the stuck frame.
+"""
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import parallel  # noqa: E402
+from mxnet_tpu.resilience import chaos, watchdog  # noqa: E402
+
+CKPT_DIR = os.environ.get("HANG_CKPT_DIR", "/tmp/mxt_hang")
+TOTAL_EPOCHS = 12
+HANG_AFTER_EPOCH = 2    # rank 1 stalls on the first step of epoch 3
+BATCHES_PER_EPOCH = 2   # 64 samples / batch 32
+
+
+def latest_checkpoint(prefix):
+    eps = []
+    for p in glob.glob(prefix + "-*.params"):
+        try:
+            eps.append(int(p.rsplit("-", 1)[1].split(".")[0]))
+        except ValueError:
+            pass
+    return max(eps) if eps else None
+
+
+def main():
+    parallel.init_distributed()
+    kv = mx.kv.create("dist_sync")
+    rank, nworker = kv.rank, kv.num_workers
+    incarnation = int(os.environ.get("MXNET_TPU_RESTART_COUNT", "0"))
+    prefix = os.path.join(CKPT_DIR, "mlp")
+    if rank == 0 and incarnation == 0:
+        os.makedirs(CKPT_DIR, exist_ok=True)
+        for p in glob.glob(os.path.join(CKPT_DIR, "*")):
+            os.remove(p)
+    kv.barrier()
+
+    # arm the watchdog explicitly: short step deadline, fail-fast abort,
+    # post-mortems next to the checkpoints
+    watchdog.configure(step_timeout=float(
+        os.environ.get("MXNET_TPU_WATCHDOG_STEP_TIMEOUT", "8")),
+        action="abort", report_dir=CKPT_DIR, poll=0.2)
+
+    rs = np.random.RandomState(0)
+    X = rs.randn(256, 16).astype(np.float32)
+    w_true = rs.randn(16).astype(np.float32)
+    y = (X @ w_true > 0).astype(np.float32)
+    shard = slice(rank * 64, (rank + 1) * 64)
+    it = mx.io.NDArrayIter(X[shard], y[shard], batch_size=32, shuffle=False)
+
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=2, name="fc2")
+    net = mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+    begin_epoch = 0
+    arg_params = aux_params = None
+    resumed_from = latest_checkpoint(prefix)
+    if resumed_from is not None:
+        _, arg_params, aux_params = mx.model.load_checkpoint(
+            prefix, resumed_from)
+        begin_epoch = resumed_from
+    if incarnation > 0:
+        assert resumed_from is not None and resumed_from >= HANG_AFTER_EPOCH, \
+            "restarted incarnation must find the pre-hang checkpoint"
+        pm = glob.glob(os.path.join(CKPT_DIR, "watchdog-postmortem-*.json"))
+        assert pm, "incarnation 1 must find the watchdog post-mortem"
+        with open(sorted(pm)[0]) as f:
+            report = json.load(f)
+        assert report["kind"] == "watchdog_postmortem", report
+
+    # incarnation 0, rank 1: stall inside the fit step after the epoch-2
+    # checkpoint is durable — the chaos sleep far outlives the watchdog
+    # deadline, so only the watchdog can end this incarnation
+    if incarnation == 0 and rank == 1:
+        chaos.inject("hang", at_step=HANG_AFTER_EPOCH * BATCHES_PER_EPOCH + 1,
+                     seconds=300).__enter__()
+
+    mod = mx.mod.Module(net, context=mx.cpu())
+
+    def checkpoint_cb(epoch, symbol, args_p, aux_p):
+        if rank == 0:
+            mx.model.save_checkpoint(prefix, epoch + 1, symbol, args_p, aux_p)
+        kv.barrier()   # peers wait until the checkpoint is durable
+
+    metric = mx.metric.Accuracy()
+    mod.fit(it, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.3},
+            initializer=mx.init.Xavier(),
+            arg_params=arg_params, aux_params=aux_params,
+            begin_epoch=begin_epoch, num_epoch=TOTAL_EPOCHS,
+            eval_metric=metric, kvstore=kv,
+            epoch_end_callback=checkpoint_cb)
+
+    args_p, _ = mod.get_params()
+    for name, arr in sorted(args_p.items()):
+        mine = arr.asnumpy().astype(np.float64)
+        total = np.asarray(parallel.allreduce_array(jax.numpy.asarray(mine)))
+        np.testing.assert_allclose(total, mine * nworker, rtol=1e-5)
+
+    it.reset()
+    metric.reset()
+    mod.score(it, metric)
+    acc = dict(metric.get_name_value())["accuracy"]
+    assert acc > 0.9, "rank %d accuracy %.3f" % (rank, acc)
+    assert incarnation == 1, "must be the restarted incarnation to succeed"
+    assert begin_epoch >= HANG_AFTER_EPOCH
+    print("dist_hang rank %d/%d OK resumed_at=%d acc=%.3f"
+          % (rank, nworker, begin_epoch, acc), flush=True)
+
+
+if __name__ == "__main__":
+    main()

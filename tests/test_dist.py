@@ -59,3 +59,35 @@ def test_dist_async_train_4proc():
     different speeds: no deadlock, per-rank convergence, identical params
     after sync_weights (reference kvstore_dist_server.h:503 semantics)."""
     _run_dist("dist_async_train.py")
+
+
+def test_dist_hang_watchdog_4proc(tmp_path):
+    """Silent-hang e2e drill (ISSUE 2 acceptance): rank 1 stalls inside
+    the fit step; the watchdog fires within its deadline, dumps stacks +
+    a post-mortem naming the stuck frame into the checkpoint dir, and
+    fail-fasts; the launcher relaunches and training resumes from the
+    newest checkpoint and converges."""
+    import glob
+    import json
+
+    out = _run_dist("dist_hang_watchdog.py",
+                    launch_args=("--max-restarts", "1"),
+                    extra_env={"HANG_CKPT_DIR": str(tmp_path)})
+    assert "chaos: rank hanging" in out
+    assert "restart 1/1" in out
+
+    reports = sorted(glob.glob(str(tmp_path / "watchdog-postmortem-*.json")))
+    assert reports, "watchdog must leave a post-mortem next to the ckpts"
+    stalled = []
+    for path in reports:
+        with open(path) as f:
+            rep = json.load(f)
+        assert rep["kind"] == "watchdog_postmortem"
+        assert rep["action"] == "abort"
+        assert os.path.isfile(rep["stack_dump"])
+        funcs = [f["function"] for f in (rep["stuck_frames"] or [])]
+        if "maybe_hang" in funcs:       # the stalled rank's report
+            stalled.append(rep)
+            assert rep["tag"] == "Module.fit step"
+            assert "maybe_hang" in open(rep["stack_dump"]).read()
+    assert stalled, "the hung rank's report must name the stuck frame"

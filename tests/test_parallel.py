@@ -127,6 +127,8 @@ def test_mesh_helpers():
     assert spec.dp_size == 8
 
 
+@pytest.mark.slow   # ~70 s: full multichip dryrun; the trainer/mesh paths
+                    # it rides stay covered by the rest of this file
 def test_dryrun_entry():
     import __graft_entry__ as ge
     fn, args = ge.entry()

@@ -8,12 +8,15 @@ import json
 import os
 
 import numpy as np
+import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import nd, sym
 from mxnet_tpu import profiler
 
 
+@pytest.mark.slow   # ~25 s: exhaustive per-op trace; the fit-batch and
+                    # monitor profiler tests below keep the subsystem covered
 def test_profiler_records_op_and_executor_events(tmp_path):
     fname = str(tmp_path / "profile.json")
     profiler.set_config(filename=fname)

@@ -1,0 +1,329 @@
+"""Hang/straggler watchdog (mxnet_tpu/resilience/watchdog.py): deadline
+arming, stack-dump + post-mortem forensics, the chaos `hang` fault, the
+coordination-KV heartbeat lane, and the fixed KVStore.num_dead_node.
+
+The multi-process end-to-end drill (watchdog fires on a hung rank, gang
+fail-fasts, relaunch resumes from checkpoint) lives in
+tests/test_dist.py::test_dist_hang_watchdog_4proc; these are the
+single-process seams.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.parallel import audit
+from mxnet_tpu.resilience import chaos, watchdog
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    chaos.reset()
+    watchdog.reset()
+    audit.clear_collective_log()
+    yield
+    chaos.reset()
+    watchdog.reset()
+    audit.clear_collective_log()
+
+
+# ---------------------------------------------------------------------------
+# deadline watchdog
+# ---------------------------------------------------------------------------
+
+def test_watch_is_noop_when_disabled(monkeypatch):
+    monkeypatch.delenv("MXNET_TPU_WATCHDOG", raising=False)
+    monkeypatch.delenv("MXNET_TPU_WATCHDOG_STEP_TIMEOUT", raising=False)
+    assert not watchdog.enabled()
+    with watchdog.watch("idle", step=1):
+        pass   # no monitor thread, no deadline
+    assert watchdog._INSTANCE is None
+
+
+def test_env_master_switch(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_WATCHDOG_STEP_TIMEOUT", "120")
+    watchdog.reset()
+    assert watchdog.enabled()
+    monkeypatch.setenv("MXNET_TPU_WATCHDOG", "0")
+    watchdog.reset()
+    assert not watchdog.enabled()
+
+
+def test_deadline_fires_and_postmortem_names_stuck_frame(tmp_path):
+    """The headline contract: a step that stalls past its deadline gets a
+    stack dump + post-mortem that names the stuck frame and carries the
+    last-completed collective from the audit trail."""
+    fired = []
+    watchdog.configure(step_timeout=0.25, action="wait",
+                       report_dir=str(tmp_path), poll=0.05,
+                       on_expire=fired.append)
+    audit.record_collective("psum", "unit.grad_allreduce", step=41)
+
+    def innocent_looking_stall():
+        time.sleep(0.7)
+
+    with watchdog.watch("unit.step", step=42):
+        innocent_looking_stall()
+
+    assert fired and fired[0] is not None
+    rep = json.load(open(fired[0]))
+    assert rep["kind"] == "watchdog_postmortem"
+    assert rep["tag"] == "unit.step" and rep["step"] == 42
+    assert rep["action"] == "wait"
+    funcs = [f["function"] for f in rep["stuck_frames"]]
+    assert "innocent_looking_stall" in funcs, funcs
+    assert rep["last_collective"]["tag"] == "unit.grad_allreduce"
+    assert rep["last_collective"]["step"] == 41
+    # the faulthandler all-thread dump exists and names the frame too
+    stack = open(rep["stack_dump"]).read()
+    assert "innocent_looking_stall" in stack
+    assert "mxt-watchdog" not in funcs   # stuck thread, not the monitor
+
+
+def test_disarm_in_time_means_no_report(tmp_path):
+    fired = []
+    watchdog.configure(step_timeout=0.5, action="wait",
+                       report_dir=str(tmp_path), poll=0.05,
+                       on_expire=fired.append)
+    for step in range(5):
+        with watchdog.watch("fast.step", step=step):
+            time.sleep(0.01)
+    time.sleep(0.3)
+    assert not fired
+    assert not list(tmp_path.glob("watchdog-postmortem-*"))
+
+
+def test_collective_timeout_is_independent(tmp_path):
+    fired = []
+    watchdog.configure(step_timeout=30.0, collective_timeout=0.2,
+                       action="wait", report_dir=str(tmp_path), poll=0.05,
+                       on_expire=fired.append)
+    with watchdog.watch("slow.collective", kind="collective"):
+        time.sleep(0.5)
+    assert fired, "collective deadline must fire independently of step's"
+    rep = json.load(open(fired[0]))
+    assert rep["tag"] == "slow.collective"
+
+
+def test_abort_action_fail_fasts_subprocess(tmp_path):
+    """action=abort must end the process with the configured exit code
+    (so the launcher's restart path sees a dead gang, not a hang) after
+    writing the post-mortem."""
+    code = (
+        "from mxnet_tpu.resilience import watchdog\n"
+        "import time\n"
+        "watchdog.configure(step_timeout=0.3, action='abort',\n"
+        "                   report_dir=%r, poll=0.05, exit_code=43)\n"
+        "with watchdog.watch('sub.step', step=1):\n"
+        "    time.sleep(30)\n"
+        "print('UNREACHABLE')\n" % str(tmp_path))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, env=env, timeout=240, cwd=REPO)
+    assert r.returncode == 43, (r.returncode, r.stdout, r.stderr)
+    assert "UNREACHABLE" not in r.stdout
+    reports = list(tmp_path.glob("watchdog-postmortem-*.json"))
+    assert reports, "abort must still leave the post-mortem behind"
+    assert json.load(open(reports[0]))["tag"] == "sub.step"
+
+
+def test_chaos_hang_fault_is_caught_by_watchdog(tmp_path):
+    """The chaos drill wiring: a `hang` fault sleeping inside the armed
+    region trips the watchdog, and the report's stuck frame IS the chaos
+    sleep — detection proven end to end, no shortcut flag."""
+    fired = []
+    watchdog.configure(step_timeout=0.25, action="wait",
+                       report_dir=str(tmp_path), poll=0.05,
+                       on_expire=fired.append)
+    with chaos.inject("hang", at_step=3, seconds=0.8):
+        for step in (1, 2, 3):
+            with watchdog.watch("drill.step", step=step):
+                chaos.maybe_hang(step)
+    assert len(fired) == 1
+    rep = json.load(open(fired[0]))
+    assert rep["step"] == 3
+    assert "maybe_hang" in [f["function"] for f in rep["stuck_frames"]]
+
+
+def test_trainer_step_is_armed(tmp_path):
+    """ShardedTrainer.step runs under the watchdog: a hang inside the
+    step produces a post-mortem tagged with the trainer step."""
+    from mxnet_tpu.models.mlp import get_symbol
+    from mxnet_tpu.parallel.mesh import MeshSpec, make_mesh
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+    tr = ShardedTrainer(get_symbol(num_classes=4),
+                        MeshSpec(make_mesh((4,), ("dp",))), lr=0.1)
+    params, mom, aux = tr.init_state({"data": (16, 8),
+                                      "softmax_label": (16,)})
+    rs = np.random.RandomState(0)
+    batch = {"data": rs.rand(16, 8).astype(np.float32),
+             "softmax_label": rs.randint(0, 4, 16).astype(np.float32)}
+    fired = []
+    watchdog.configure(step_timeout=1.0, action="wait",
+                       report_dir=str(tmp_path), poll=0.05,
+                       on_expire=fired.append)
+    with chaos.inject("hang", at_step=2, seconds=2.0):
+        for _ in range(2):
+            params, mom, aux, _ = tr.step(params, mom, aux, batch)
+    assert fired
+    rep = json.load(open(fired[0]))
+    assert rep["tag"] == "ShardedTrainer.step" and rep["step"] == 2
+    # the step's gradient psum landed in the runtime collective trail
+    last = audit.last_collective()
+    assert last["kind"] == "psum" and "ShardedTrainer" in last["tag"]
+
+
+# ---------------------------------------------------------------------------
+# heartbeat lane + num_dead_node
+# ---------------------------------------------------------------------------
+
+class FakeKVClient:
+    """In-memory stand-in for the jax coordination-service client."""
+
+    def __init__(self):
+        self.kv = {}
+
+    def key_value_set(self, key, value, allow_overwrite=False):
+        if not allow_overwrite and key in self.kv:
+            raise RuntimeError("key exists: " + key)
+        self.kv[key] = value
+
+    def key_value_delete(self, key):
+        self.kv.pop(key, None)
+
+    def key_value_dir_get(self, prefix):
+        return [(k, v) for k, v in self.kv.items() if k.startswith(prefix)]
+
+    def blocking_key_value_get(self, key, timeout_ms):
+        if key not in self.kv:
+            raise TimeoutError(key)
+        return self.kv[key]
+
+
+def test_heartbeat_lane_noop_without_distributed():
+    assert watchdog.heartbeat(1) is False
+    assert watchdog.lane().peers() == {}
+    assert watchdog.lane().num_dead(1) == 0
+    assert watchdog.lane().straggler_report() is None
+
+
+def test_heartbeat_lane_overwrites_one_key_per_rank():
+    client = FakeKVClient()
+    lane = watchdog.HeartbeatLane(client=client)
+    for step in range(5):
+        assert lane.beat(step, force=True)
+    keys = [k for k in client.kv if k.startswith(lane.PREFIX)]
+    assert len(keys) == 1, "heartbeats must overwrite, not leak keys"
+    assert lane.peers()[0]["step"] == 4
+
+
+def test_straggler_report_and_num_dead():
+    client = FakeKVClient()
+    lane = watchdog.HeartbeatLane(client=client)
+    now = time.time()
+    client.kv["mxt_hb/0"] = "10:%f" % now
+    client.kv["mxt_hb/1"] = "9:%f" % now
+    client.kv["mxt_hb/2"] = "4:%f" % (now - 120)   # stalled 2 min ago
+    rep = lane.straggler_report(stale_sec=60)
+    assert rep["fastest_rank"] == 0 and rep["slowest_rank"] == 2
+    assert rep["lag_steps"] == 6
+    assert rep["stale_ranks"] == [2]
+    assert lane.num_dead(timeout_sec=60) == 1
+    assert lane.num_dead(timeout_sec=600) == 0
+
+
+def test_heartbeat_throttling():
+    client = FakeKVClient()
+    lane = watchdog.HeartbeatLane(client=client)
+    lane._interval = 10.0
+    assert lane.beat(1) is True
+    assert lane.beat(2) is False          # throttled
+    assert lane.beat(3, force=True) is True
+    assert lane.peers()[0]["step"] == 3
+
+
+def test_num_dead_node_bounded_and_leak_free(monkeypatch):
+    """The kvstore.py:338 fix: the probe honors timeout_sec, reuses ONE
+    key, and deletes it afterwards; stale heartbeat peers are counted."""
+    from mxnet_tpu import kvstore as kvstore_mod
+    from mxnet_tpu.parallel import Topology
+
+    client = FakeKVClient()
+    monkeypatch.setattr(
+        "jax._src.distributed.global_state.client", client, raising=False)
+    kv = kvstore_mod.KVStoreTPUDist.__new__(kvstore_mod.KVStoreTPUDist)
+    kvstore_mod.KVStore.__init__(kv, "dist_sync")
+    kv._topo = Topology(0, 4, 1, 4)
+
+    assert kv.num_dead_node(timeout_sec=5) == 0
+    assert not [k for k in client.kv if k.startswith("mxt_dead_probe")], \
+        "probe keys must be deleted, not leaked"
+    # probe repeatedly: still zero leftover keys (the old code leaked one
+    # per probe, forever)
+    for _ in range(3):
+        kv.num_dead_node(timeout_sec=5)
+    assert not [k for k in client.kv if k.startswith("mxt_dead_probe")]
+
+    # a peer with a stale heartbeat counts as dead
+    client.kv["mxt_hb/3"] = "7:%f" % (time.time() - 999)
+    client.kv["mxt_hb/0"] = "9:%f" % time.time()
+    client.kv["mxt_hb/1"] = "9:%f" % time.time()
+    client.kv["mxt_hb/2"] = "9:%f" % time.time()
+    assert kv.num_dead_node(timeout_sec=60) == 1
+
+    # an unreachable coordinator counts as one dead node and stays
+    # within the timeout budget (blocking get raises, probe catches)
+    class DeadClient(FakeKVClient):
+        def key_value_set(self, *a, **k):
+            raise RuntimeError("coordinator gone")
+
+    monkeypatch.setattr("jax._src.distributed.global_state.client",
+                        DeadClient(), raising=False)
+    assert kv.num_dead_node(timeout_sec=1) >= 1
+
+
+# ---------------------------------------------------------------------------
+# runtime collective trail (parallel/audit.py)
+# ---------------------------------------------------------------------------
+
+def test_collective_trail_records_and_bounds():
+    for i in range(200):
+        audit.record_collective("psum", "step", step=i)
+    last = audit.last_collective()
+    assert last["step"] == 199
+    log = audit.collective_log()
+    assert len(log) == 128, "trail must stay bounded"
+    assert audit.collective_log(5)[-1]["step"] == 199
+
+
+def test_postmortem_tool_renders_report(tmp_path, capsys):
+    """tools/postmortem.py digests a real report end to end."""
+    fired = []
+    watchdog.configure(step_timeout=0.2, action="wait",
+                       report_dir=str(tmp_path), poll=0.05,
+                       on_expire=fired.append)
+    audit.record_collective("barrier", "epoch_end", step=12)
+    with watchdog.watch("tool.step", step=13):
+        time.sleep(0.5)
+    assert fired
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import postmortem
+        rc = postmortem.main([str(tmp_path)])
+    finally:
+        sys.path.pop(0)
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "POST-MORTEM" in out
+    assert "tool.step" in out
+    assert "epoch_end" in out
+    assert "STUCK FRAMES" in out

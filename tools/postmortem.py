@@ -1,0 +1,150 @@
+#!/usr/bin/env python3
+"""Pretty-print watchdog post-mortem reports after a failed run.
+
+The hang watchdog (mxnet_tpu/resilience/watchdog.py) leaves one
+``watchdog-postmortem-r<rank>-<pid>.json`` (+ ``.stack`` faulthandler
+dump) per firing rank, next to the checkpoints.  This tool renders them
+for a human: what was armed, where each rank was stuck, which collective
+last completed, every peer's last heartbeat, and the straggler lag table.
+
+Usage:
+    python tools/postmortem.py <report.json | directory> [--frames N]
+
+Stdlib only — it must work on a bare recovery box.
+"""
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+
+
+def find_reports(target):
+    if os.path.isfile(target):
+        return [target]
+    pat = os.path.join(target, "watchdog-postmortem-*.json")
+    return sorted(glob.glob(pat))
+
+
+def fmt_ts(ts):
+    if not isinstance(ts, (int, float)):
+        return str(ts)
+    return time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(ts))
+
+
+def hrule(ch="-", n=72):
+    print(ch * n)
+
+
+def print_frames(frames, limit, indent="    "):
+    if not frames:
+        print(indent + "(no frames captured)")
+        return
+    # innermost frames are the interesting ones
+    shown = frames[-limit:] if limit else frames
+    if len(shown) < len(frames):
+        print(indent + "... %d outer frames elided ..."
+              % (len(frames) - len(shown)))
+    for f in shown:
+        print("%s%s:%s in %s" % (indent, f.get("file"), f.get("line"),
+                                 f.get("function")))
+        code = f.get("code")
+        if code:
+            print("%s    %s" % (indent, code))
+
+
+def print_report(path, frame_limit):
+    with open(path) as f:
+        rep = json.load(f)
+    hrule("=")
+    print("POST-MORTEM %s" % path)
+    hrule("=")
+    print("rank %s  pid %s  fired %s  action=%s" % (
+        rep.get("rank"), rep.get("pid"), fmt_ts(rep.get("time")),
+        rep.get("action")))
+    print("armed: %r (step %s), deadline %ss" % (
+        rep.get("tag"), rep.get("step"), rep.get("deadline_sec")))
+
+    print()
+    print("STUCK FRAMES (innermost last):")
+    print_frames(rep.get("stuck_frames"), frame_limit)
+    stack = rep.get("stack_dump")
+    if stack:
+        print("    full all-thread dump: %s%s"
+              % (stack, "" if os.path.isfile(stack) else "  [missing]"))
+
+    last = rep.get("last_collective")
+    print()
+    if last:
+        print("LAST COMPLETED COLLECTIVE: %s %r (step %s) at %s" % (
+            last.get("kind"), last.get("tag"), last.get("step"),
+            fmt_ts(last.get("time"))))
+    else:
+        print("LAST COMPLETED COLLECTIVE: none recorded")
+    log = rep.get("collective_log") or []
+    for e in log[-8:]:
+        print("    %s  %-18s %s (step %s)" % (
+            fmt_ts(e.get("time")), e.get("kind"), e.get("tag"),
+            e.get("step")))
+
+    beats = rep.get("heartbeats") or {}
+    print()
+    if beats:
+        print("PER-RANK HEARTBEATS (at report time):")
+        ref = rep.get("time")
+        print("    %-6s %-10s %s" % ("rank", "step", "age"))
+        for rank in sorted(beats, key=lambda r: int(r)):
+            b = beats[rank]
+            age = "%.1fs" % (ref - b["time"]) \
+                if isinstance(ref, (int, float)) else "?"
+            print("    %-6s %-10s %s" % (rank, b.get("step"), age))
+    else:
+        print("PER-RANK HEARTBEATS: none (heartbeat lane inactive)")
+
+    strag = rep.get("straggler")
+    if strag:
+        print("STRAGGLER: rank %s lags %s steps (%.1fs); stale ranks: %s"
+              % (strag.get("slowest_rank"), strag.get("lag_steps"),
+                 strag.get("lag_seconds") or 0.0,
+                 strag.get("stale_ranks") or "none"))
+
+    dev = rep.get("devices") or {}
+    print()
+    print("TOPOLOGY: process %s/%s, %d device(s)" % (
+        dev.get("process_index", "?"), dev.get("process_count", "?"),
+        len(dev.get("devices", [])) if isinstance(dev.get("devices"), list)
+        else 0))
+    env = rep.get("env") or {}
+    wd_env = {k: v for k, v in env.items() if "WATCHDOG" in k or
+              "CHAOS" in k or k.startswith("DMLC_")}
+    if wd_env:
+        print("ENV (watchdog/chaos/launcher):")
+        for k in sorted(wd_env):
+            print("    %s=%s" % (k, wd_env[k]))
+    print()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("target", help="a post-mortem .json or a directory "
+                                   "holding watchdog-postmortem-*.json")
+    ap.add_argument("--frames", type=int, default=8,
+                    help="stuck frames to show per report (0 = all)")
+    args = ap.parse_args(argv)
+    reports = find_reports(args.target)
+    if not reports:
+        print("no watchdog post-mortem reports under %r" % args.target,
+              file=sys.stderr)
+        return 1
+    for path in reports:
+        try:
+            print_report(path, args.frames)
+        except (ValueError, KeyError) as e:
+            print("unreadable report %s: %r" % (path, e), file=sys.stderr)
+    print("%d report(s)." % len(reports))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
