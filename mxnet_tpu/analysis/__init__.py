@@ -1,0 +1,26 @@
+"""Static analysis: pre-flight program checks + repo footgun lint.
+
+Two engines over one finding/report model (``report.py``):
+
+* :mod:`~mxnet_tpu.analysis.graphcheck` — jaxpr-level SPMD/perf lint:
+  trace any jittable program and statically reject mismatched collective
+  schedules, replicated-memory and donation hazards, dtype/precision
+  mistakes, and recompile-per-step attrs BEFORE anything runs on a pod.
+* :mod:`~mxnet_tpu.analysis.srclint` — AST-level scan of the source tree
+  for host-side footguns inside traced functions (host numpy / clocks /
+  env reads / Python RNG / tracer leaks) and unarmed collective entry
+  points.
+
+Wired into ``ShardedTrainer.step`` / ``Module.bind`` as an opt-in
+pre-flight (``MXNET_TPU_PREFLIGHT=1``, see
+:mod:`~mxnet_tpu.analysis.preflight`), into CI via
+``tests/test_analysis.py``, and onto the command line as
+``tools/tpulint.py``.  Rule catalog: ``docs/static-analysis.md``.
+"""
+from __future__ import annotations
+
+from .report import Finding, PreflightError, Report, SEVERITIES
+from . import graphcheck, preflight, srclint
+
+__all__ = ["Finding", "Report", "PreflightError", "SEVERITIES",
+           "graphcheck", "preflight", "srclint"]

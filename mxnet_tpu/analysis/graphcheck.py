@@ -1,0 +1,613 @@
+"""Graph checker: jaxpr-level SPMD/perf lint (pre-flight Engine 1).
+
+Compiler-style analysis passes over the *traced* program — the spirit of
+TVM's graph-level passes (arXiv:1802.04799) applied to correctness: trace
+any jittable program (a ShardedTrainer step, a Module forward/backward,
+the ring/pipeline/moe entry points) to a ClosedJaxpr and run rule passes
+over it.  Everything here is static — no device execution, no compile —
+so a mismatched collective schedule is rejected at trace time instead of
+burning a pod launch before the PR-2 watchdog turns the hang into a
+post-mortem.
+
+Rule catalog (docs/static-analysis.md):
+
+========  =======================  ========  ==================================
+id        name                     severity  what it catches
+========  =======================  ========  ==================================
+GC101     collective-axis-unknown  error     collective over an axis name the
+                                             mesh does not define
+GC102     cond-divergent-          error     `lax.cond` branches with different
+          collectives                        collective schedules — ranks that
+                                             take different branches deadlock
+GC103     while-collective         warning   collective inside `lax.while_loop`
+                                             whose trip count is data-dependent
+                                             (rank-divergent counts desync)
+GC104     ppermute-bad-perm        error     ppermute perm that is not a
+                                             partial bijection / out of range
+GC105     axis-groups-asymmetric   error     axis_index_groups that do not
+                                             partition the axis into equal
+                                             disjoint groups
+GC201     replicated-large-array   warning   large state fully replicated on a
+                                             model-parallel mesh
+GC202     missing-donation         warning   grad/optimizer buffers not donated
+                                             (2x peak HBM)
+GC203     reshard-chain            warning   chained sharding constraints that
+                                             bounce one value between layouts
+                                             on the hot path
+GC301     bf16-upcast-compute      warning   bf16 values upcast to f32 and fed
+                                             straight into dot/conv (silent 2x
+                                             FLOP cost on the MXU)
+GC302     weak-type-input          warning   weak-typed scalar inputs that
+                                             fragment the jit cache
+GC401     static-float-attr        warning   per-step float attr (lr/wd/...)
+                                             reaching an op as a STATIC jit
+                                             key -> recompile every step
+GC402     registry-dynamic-gap     warning   registered op schema declares a
+                                             per-step param outside its
+                                             dynamic_params mechanism
+GC403     unhashable-attr          error     op attrs that cannot be hashed
+                                             into a jit cache key
+========  =======================  ========  ==================================
+
+The per-step attr names behind GC401/GC402 are the scheduled-hyperparam
+set (``lr``, ``wd``, ``rescale_grad``, ``t`` and their multi-tensor
+plurals); constant schema floats (epsilon, momentum, beta1/2) are fine as
+static keys and are not flagged.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import jax
+
+from .report import Finding, Report
+
+try:                                    # jax >= 0.4.36
+    from jax.extend import core as _core
+except ImportError:                     # older: the classic namespace
+    from jax import core as _core
+
+__all__ = ["CollectiveEvent", "collect_collectives", "check_jaxpr",
+           "check_fn", "check_symbol", "check_registry",
+           "check_replication", "check_trainer", "check_executor",
+           "PER_STEP_ATTRS", "COLLECTIVE_PRIMS"]
+
+# every collective primitive we track (axis_index is deliberately absent:
+# it reads the axis env but moves no data and cannot desync)
+COLLECTIVE_PRIMS = frozenset({
+    "psum", "pmax", "pmin", "ppermute", "pshuffle", "all_gather",
+    "all_to_all", "reduce_scatter", "psum_scatter", "pgather",
+})
+
+# attrs that change every optimizer step; static jit keys on these mean
+# one fresh XLA compile per step (registry.dynamic_params is the fix).
+# The canonical set lives next to the mechanism it polices.
+from ..ops.registry import PER_STEP_PARAMS as PER_STEP_ATTRS  # noqa: E402
+
+_JAXPR_TYPES = (_core.Jaxpr, _core.ClosedJaxpr)
+
+
+def _as_jaxpr(j):
+    """Normalize Jaxpr/ClosedJaxpr to the open Jaxpr."""
+    return j.jaxpr if isinstance(j, _core.ClosedJaxpr) else j
+
+
+def _source(eqn) -> str:
+    """file:line of the python call that produced this eqn (best effort)."""
+    try:
+        from jax._src import source_info_util
+        frame = source_info_util.user_frame(eqn.source_info)
+        if frame is None:
+            return ""
+        return "%s:%d" % (frame.file_name, frame.start_line)
+    except Exception:
+        return ""
+
+
+def _sub_jaxprs(eqn):
+    """Yield (label, jaxpr) for every sub-jaxpr in an eqn's params —
+    generic, so scan/cond/while/pjit/shard_map/remat/custom_vjp and any
+    future higher-order primitive are all walked."""
+    for key, val in sorted(eqn.params.items()):
+        if isinstance(val, _JAXPR_TYPES):
+            yield key, _as_jaxpr(val)
+        elif isinstance(val, (tuple, list)):
+            for i, item in enumerate(val):
+                if isinstance(item, _JAXPR_TYPES):
+                    yield "%s[%d]" % (key, i), _as_jaxpr(item)
+
+
+def _axes_of(params) -> Tuple:
+    """Normalized axis names of a collective eqn (strings only; positional
+    axes are device-local and cannot mismatch)."""
+    axes = params.get("axes", params.get("axis_name", ()))
+    if not isinstance(axes, (tuple, list)):
+        axes = (axes,)
+    return tuple(a for a in axes if isinstance(a, str))
+
+
+class CollectiveEvent:
+    """One collective eqn, in program order, with its jaxpr path."""
+
+    __slots__ = ("prim", "axes", "path", "params", "source")
+
+    def __init__(self, prim, axes, path, params, source):
+        self.prim = prim
+        self.axes = axes
+        self.path = path
+        self.params = params
+        self.source = source
+
+    def schedule_key(self):
+        """The (kind, axes) pair two ranks must agree on to stay in step."""
+        return (self.prim, self.axes)
+
+    def __repr__(self):
+        return "<Collective %s axes=%s at %s>" % (self.prim, self.axes,
+                                                  self.path or "/")
+
+
+def collect_collectives(jaxpr_like, path: str = "") -> List[CollectiveEvent]:
+    """Ordered collective events of a (Closed)Jaxpr, descending into every
+    nested jaxpr (scan/cond/while bodies, shard_map, pjit, remat...).
+    Cond branches are labelled ``cond.branches[i]`` so callers can compare
+    per-branch schedules."""
+    events = []
+    jaxpr = _as_jaxpr(jaxpr_like)
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name in COLLECTIVE_PRIMS:
+            events.append(CollectiveEvent(
+                name, _axes_of(eqn.params), path, dict(eqn.params),
+                _source(eqn)))
+        for label, sub in _sub_jaxprs(eqn):
+            sub_path = "%s/%s.%s" % (path, name, label) if path \
+                else "%s.%s" % (name, label)
+            events.extend(collect_collectives(sub, sub_path))
+    return events
+
+
+# ---------------------------------------------------------------------------
+# jaxpr rule passes
+# ---------------------------------------------------------------------------
+
+def _walk_jaxprs(jaxpr_like, path: str = ""):
+    """Yield (path, jaxpr) for the jaxpr and every nested jaxpr."""
+    jaxpr = _as_jaxpr(jaxpr_like)
+    yield path, jaxpr
+    for eqn in jaxpr.eqns:
+        for label, sub in _sub_jaxprs(eqn):
+            sub_path = "%s/%s.%s" % (path, eqn.primitive.name, label) \
+                if path else "%s.%s" % (eqn.primitive.name, label)
+            yield from _walk_jaxprs(sub, sub_path)
+
+
+def _mesh_axis_sizes(mesh) -> Optional[Dict[str, int]]:
+    """Accept a Mesh, a {axis: size} mapping, or an iterable of names."""
+    if mesh is None:
+        return None
+    shape = getattr(mesh, "shape", None)
+    if shape is not None and hasattr(shape, "items"):
+        return dict(shape.items())
+    if hasattr(mesh, "items"):
+        return dict(mesh.items())
+    return {name: 0 for name in mesh}          # names only, sizes unknown
+
+
+def _rule_axis_names(events, axis_sizes, rep: Report):
+    for ev in events:
+        unknown = [a for a in ev.axes if a not in axis_sizes]
+        if unknown:
+            rep.add(
+                "GC101", "error",
+                "%s over axis %s which the mesh (axes %s) does not define"
+                % (ev.prim, unknown, sorted(axis_sizes)),
+                location=ev.source or ev.path,
+                fix_hint="use a mesh axis name, or add the axis to the "
+                         "mesh this program runs under",
+                extra={"path": ev.path, "axes": list(ev.axes)})
+
+
+def _rule_cond_divergence(jaxpr_like, rep: Report, path: str = ""):
+    jaxpr = _as_jaxpr(jaxpr_like)
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name == "cond":
+            branches = eqn.params.get("branches", ())
+            schedules = [tuple(ev.schedule_key()
+                               for ev in collect_collectives(b))
+                         for b in branches]
+            if len(set(schedules)) > 1:
+                desc = ["branch%d=%s" % (i, [f"{p}@{','.join(a) or '-'}"
+                                             for p, a in s])
+                        for i, s in enumerate(schedules)]
+                rep.add(
+                    "GC102", "error",
+                    "cond branches carry different collective schedules "
+                    "(%s): ranks whose predicate diverges deadlock inside "
+                    "the collective — the watchdog would only catch this "
+                    "as a live hang" % "; ".join(desc),
+                    location=_source(eqn) or (path or "/"),
+                    fix_hint="hoist the collective out of the cond, or "
+                             "make every branch issue the identical "
+                             "collective sequence",
+                    extra={"path": path,
+                           "schedules": [[list(k) for k in s]
+                                         for s in schedules]})
+        elif name == "while":
+            body = eqn.params.get("body_jaxpr")
+            cond_j = eqn.params.get("cond_jaxpr")
+            inner = []
+            for part in (body, cond_j):
+                if part is not None:
+                    inner.extend(collect_collectives(part))
+            if inner:
+                rep.add(
+                    "GC103", "warning",
+                    "collective %s inside a while_loop: the trip count is "
+                    "data-dependent, so ranks can disagree on iteration "
+                    "count and desynchronize the schedule"
+                    % sorted({ev.prim for ev in inner}),
+                    location=_source(eqn) or (path or "/"),
+                    fix_hint="prefer lax.scan with a static trip count, "
+                             "or make the loop condition provably uniform "
+                             "across ranks (e.g. psum the predicate)",
+                    extra={"path": path})
+        for label, sub in _sub_jaxprs(eqn):
+            sub_path = "%s/%s.%s" % (path, name, label) if path \
+                else "%s.%s" % (name, label)
+            _rule_cond_divergence(sub, rep, sub_path)
+
+
+def _rule_ppermute(events, axis_sizes, rep: Report):
+    for ev in events:
+        if ev.prim != "ppermute":
+            continue
+        perm = list(ev.params.get("perm") or ())
+        srcs = [s for s, _ in perm]
+        dsts = [d for _, d in perm]
+        problems = []
+        if len(set(srcs)) != len(srcs):
+            problems.append("duplicate sources")
+        if len(set(dsts)) != len(dsts):
+            problems.append("duplicate destinations (two ranks send to "
+                            "one; one transfer is silently dropped)")
+        if axis_sizes:
+            for axis in ev.axes:
+                size = axis_sizes.get(axis) or 0
+                if size and any(not (0 <= r < size) for r in srcs + dsts):
+                    problems.append("rank outside axis %r of size %d"
+                                    % (axis, size))
+        if problems:
+            rep.add(
+                "GC104", "error",
+                "ppermute perm %s is invalid: %s" % (perm,
+                                                     "; ".join(problems)),
+                location=ev.source or ev.path,
+                fix_hint="a perm must be a partial bijection over "
+                         "[0, axis_size)",
+                extra={"path": ev.path, "perm": perm})
+
+
+def _rule_axis_groups(events, axis_sizes, rep: Report):
+    for ev in events:
+        groups = ev.params.get("axis_index_groups")
+        if not groups:
+            continue
+        sizes = {len(g) for g in groups}
+        flat = [r for g in groups for r in g]
+        problems = []
+        if len(sizes) > 1:
+            problems.append("groups of unequal size %s" % sorted(sizes))
+        if len(set(flat)) != len(flat):
+            problems.append("a rank appears in two groups")
+        if axis_sizes:
+            for axis in ev.axes:
+                size = axis_sizes.get(axis) or 0
+                if size and len(flat) != size:
+                    problems.append(
+                        "groups cover %d ranks but axis %r has %d — the "
+                        "uncovered ranks never enter the collective"
+                        % (len(flat), axis, size))
+        if problems:
+            rep.add(
+                "GC105", "error",
+                "%s axis_index_groups %s do not partition the axis: %s"
+                % (ev.prim, groups, "; ".join(problems)),
+                location=ev.source or ev.path,
+                fix_hint="groups must be equal-sized, disjoint, and "
+                         "cover every rank of the axis",
+                extra={"path": ev.path})
+
+
+def _rule_bf16_upcast(jaxpr_like, rep: Report):
+    """bf16 -> f32 converts feeding dot/conv: the matmul silently runs at
+    f32 MXU throughput (half the bf16 rate) — almost always an accidental
+    upcast, since intentional f32 accumulation uses
+    preferred_element_type, not an input convert."""
+    import numpy as np
+    for path, jaxpr in _walk_jaxprs(jaxpr_like):
+        upcast_vars = {}
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            if name == "convert_element_type" \
+                    and eqn.params.get("new_dtype") == np.dtype("float32") \
+                    and str(eqn.invars[0].aval.dtype) == "bfloat16":
+                upcast_vars[id(eqn.outvars[0])] = eqn
+            elif name in ("dot_general", "conv_general_dilated"):
+                for v in eqn.invars:
+                    src = upcast_vars.get(id(v))
+                    if src is not None:
+                        rep.add(
+                            "GC301", "warning",
+                            "bf16 value upcast to f32 feeds %s directly: "
+                            "the contraction runs at f32 rate instead of "
+                            "bf16" % name,
+                            location=_source(eqn) or path,
+                            fix_hint="keep the operand bf16 and request "
+                                     "f32 accumulation via "
+                                     "preferred_element_type if needed",
+                            extra={"path": path})
+                        upcast_vars.pop(id(v), None)   # once per convert
+
+
+def _rule_weak_types(closed, target: str, rep: Report):
+    jaxpr = _as_jaxpr(closed)
+    for i, v in enumerate(jaxpr.invars):
+        aval = getattr(v, "aval", None)
+        if aval is not None and getattr(aval, "weak_type", False):
+            rep.add(
+                "GC302", "warning",
+                "input %d is a weak-typed %s scalar: a later call with a "
+                "strongly-typed value (e.g. restored from checkpoint) "
+                "misses the jit cache and recompiles the whole program"
+                % (i, aval.dtype),
+                location=target,
+                fix_hint="pin the dtype at the call site: "
+                         "jnp.asarray(x, jnp.float32)",
+                extra={"arg_index": i})
+
+
+def _rule_reshard_chain(jaxpr_like, rep: Report):
+    for path, jaxpr in _walk_jaxprs(jaxpr_like):
+        constrained = {}           # id(var) -> (sharding str, eqn)
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name != "sharding_constraint":
+                continue
+            spec = str(eqn.params.get("sharding"))
+            prev = constrained.get(id(eqn.invars[0]))
+            if prev is not None and prev[0] != spec:
+                rep.add(
+                    "GC203", "warning",
+                    "value is resharded %s -> %s back to back: each hop "
+                    "is a collective copy on the hot path" % (prev[0],
+                                                              spec),
+                    location=_source(eqn) or path,
+                    fix_hint="pick one sharding for the value, or move "
+                             "the reshard off the per-step path",
+                    extra={"path": path})
+            for out in eqn.outvars:
+                constrained[id(out)] = (spec, eqn)
+
+
+def check_jaxpr(jaxpr_like, mesh=None, target: str = "") -> Report:
+    """Run every jaxpr-level rule pass over a (Closed)Jaxpr.
+
+    ``mesh``: a jax Mesh, a ``{axis: size}`` dict, or an iterable of axis
+    names — enables the axis-existence and rank-range checks."""
+    rep = Report("graphcheck", target)
+    events = collect_collectives(jaxpr_like)
+    axis_sizes = _mesh_axis_sizes(mesh)
+    if axis_sizes is not None:
+        _rule_axis_names(events, axis_sizes, rep)
+    _rule_cond_divergence(jaxpr_like, rep)
+    _rule_ppermute(events, axis_sizes, rep)
+    _rule_axis_groups(events, axis_sizes, rep)
+    _rule_bf16_upcast(jaxpr_like, rep)
+    _rule_weak_types(jaxpr_like, target, rep)
+    _rule_reshard_chain(jaxpr_like, rep)
+    return rep
+
+
+def check_fn(fn, *example_args, mesh=None, target: str = "",
+             **example_kwargs) -> Report:
+    """Trace ``fn`` (jitted or raw) with example args/structs and run the
+    jaxpr rules.  Tracing only — nothing compiles, nothing executes."""
+    closed = jax.make_jaxpr(fn)(*example_args, **example_kwargs)
+    return check_jaxpr(closed, mesh=mesh,
+                       target=target or getattr(fn, "__name__", "fn"))
+
+
+# ---------------------------------------------------------------------------
+# symbol / registry passes (recompile hazards)
+# ---------------------------------------------------------------------------
+
+def check_symbol(symbol, target: str = "") -> Report:
+    """GC401/GC403 over a Symbol graph: per-step float attrs reaching ops
+    as static jit keys, and attrs that cannot hash into a cache key."""
+    from ..executor import GraphProgram
+    rep = Report("graphcheck", target or (symbol.name or "symbol"))
+    prog = GraphProgram(symbol)
+    for node in prog.nodes:
+        if node.is_var:
+            continue
+        try:
+            attrs = node.parsed_attrs()
+        except Exception:
+            continue
+        dyn = tuple(node.op.dynamic_params)
+        for name, val in attrs.items():
+            if name in PER_STEP_ATTRS and isinstance(val, float) \
+                    and name not in dyn:
+                rep.add(
+                    "GC401", "warning",
+                    "node %s (op %s) carries per-step attr %s=%r as a "
+                    "STATIC jit key: every new value compiles a fresh "
+                    "program" % (node.name, node.op.name, name, val),
+                    location=node.name,
+                    fix_hint="declare %r in the op's dynamic_params so "
+                             "it rides as a traced input" % name,
+                    extra={"op": node.op.name, "attr": name})
+        try:
+            hash(attrs.key())
+        except TypeError as e:
+            rep.add(
+                "GC403", "error",
+                "node %s (op %s) has attrs that cannot hash into a jit "
+                "cache key: %s" % (node.name, node.op.name, e),
+                location=node.name,
+                fix_hint="attr values must be scalars/strings/tuples "
+                         "(lists and dicts are converted; arbitrary "
+                         "objects are not)",
+                extra={"op": node.op.name})
+    return rep
+
+
+def check_registry(target: str = "ops.registry") -> Report:
+    """GC402 over the live operator registry: any op whose schema declares
+    a per-step param (lr/wd/rescale_grad/t/...) outside dynamic_params
+    will recompile on every optimizer step."""
+    from ..ops import registry as _registry
+    rep = Report("graphcheck", target)
+    seen = set()
+    for name in _registry.list_ops():
+        op = _registry.get_op(name)
+        if id(op) in seen:            # aliases share the Operator
+            continue
+        seen.add(id(op))
+        missing = [p for p in op.params
+                   if p in PER_STEP_ATTRS and p not in op.dynamic_params]
+        if missing:
+            rep.add(
+                "GC402", "warning",
+                "op %s declares per-step params %s outside its "
+                "dynamic_params %s" % (op.name, missing,
+                                       list(op.dynamic_params)),
+                location="ops/registry:%s" % op.name,
+                fix_hint="add them to dynamic_params in the @register "
+                         "call so schedules don't recompile the op",
+                extra={"op": op.name, "missing": missing})
+    return rep
+
+
+# ---------------------------------------------------------------------------
+# sharding/memory passes (need context a jaxpr no longer carries)
+# ---------------------------------------------------------------------------
+
+def _replicated_threshold_bytes() -> int:
+    try:
+        mb = float(os.environ.get("MXNET_TPU_PREFLIGHT_REPLICATED_MB", "8"))
+    except ValueError:
+        mb = 8.0
+    return int(mb * (1 << 20))
+
+
+def check_replication(entries: Iterable[Tuple], mesh,
+                      model_axes: Sequence[str] = (),
+                      target: str = "") -> Report:
+    """GC201: large arrays fully replicated while a model-parallel axis is
+    active.  ``entries`` is ``(name, shape, dtype_itemsize, sharding)``;
+    replication along pure-dp meshes is the normal design and not flagged.
+    """
+    rep = Report("graphcheck", target)
+    axis_sizes = _mesh_axis_sizes(mesh) or {}
+    active = [a for a in model_axes if axis_sizes.get(a, 1) > 1]
+    if not active:
+        return rep
+    threshold = _replicated_threshold_bytes()
+    for name, shape, itemsize, sharding in entries:
+        n = 1
+        for d in shape:
+            n *= int(d)
+        nbytes = n * int(itemsize)
+        if nbytes < threshold:
+            continue
+        spec = getattr(sharding, "spec", None)
+        fully_replicated = spec is None or all(s is None for s in spec)
+        if fully_replicated:
+            rep.add(
+                "GC201", "warning",
+                "%s (%.1f MB) is fully replicated although model-parallel "
+                "axes %s are active: every device holds a full copy"
+                % (name, nbytes / 1e6, active),
+                location=name,
+                fix_hint="shard it over a model axis (__shard__ attr / "
+                         "PartitionSpec), or accept the HBM cost "
+                         "explicitly (raise "
+                         "MXNET_TPU_PREFLIGHT_REPLICATED_MB)",
+                extra={"bytes": nbytes})
+    return rep
+
+
+def check_donation(donated: bool, what: str, target: str = "") -> Report:
+    """GC202: the training step's state buffers (params/momenta/guard)
+    must be donated or the update holds old+new copies live — 2x peak."""
+    rep = Report("graphcheck", target)
+    if not donated:
+        rep.add(
+            "GC202", "warning",
+            "%s run without buffer donation: the update keeps the old and "
+            "new state live simultaneously (2x peak HBM)" % what,
+            location=target,
+            fix_hint="pass donate_argnums covering params and optimizer "
+                     "state to jax.jit")
+    return rep
+
+
+# ---------------------------------------------------------------------------
+# whole-program entry points
+# ---------------------------------------------------------------------------
+
+def check_trainer(trainer, params, mom, aux, inputs, keys=None,
+                  guard=None) -> Tuple[Report, object]:
+    """Full pre-flight over a ShardedTrainer's step program.
+
+    Traces the exact raw step function the trainer jits (same remat
+    policy, same guard automaton) and runs every pass.  Returns
+    ``(report, closed_jaxpr)`` so callers can persist the jaxpr artifact.
+    """
+    keys = keys if keys is not None else trainer._keys()
+    guard = guard if guard is not None else trainer._guard_arrays()
+    step_fn = trainer._make_step_fn()
+    closed = jax.make_jaxpr(step_fn)(params, mom, aux, inputs, keys, guard)
+    target = "ShardedTrainer(%s)" % (trainer.symbol.name or "symbol")
+    rep = check_jaxpr(closed, mesh=trainer.spec.mesh, target=target)
+    rep.extend(check_symbol(trainer.symbol, target=target))
+    rep.extend(check_registry())
+    shardings = trainer._param_shardings()
+    entries = [(n, trainer._param_shapes.get(n, ()), 4, s)
+               for n, s in zip(trainer.param_names, shardings)]
+    model_axes = [a for a in (trainer.tp_axis,) if a]
+    rep.extend(check_replication(entries, trainer.spec.mesh, model_axes,
+                                 target=target))
+    rep.extend(check_donation(getattr(trainer, "_step_donated", True),
+                              "ShardedTrainer jitted step", target=target))
+    rep.target = target
+    return rep, closed
+
+
+def check_executor(executor, train: bool = True) -> Tuple[Report, object]:
+    """Pre-flight over a bound Executor's fused forward+backward program
+    (the Module path).  Traces with the executor's own buffers as shape
+    structs; returns ``(report, closed_jaxpr)``."""
+    prog = executor._prog
+    args = tuple(a._handle for a in executor.arg_arrays)
+    aux = tuple(a._handle for a in executor.aux_arrays)
+    keys = executor._keys()
+    mask = tuple(executor.grad_req.get(n, "null") != "null"
+                 for n in prog.arg_names)
+    target = "Executor(%s)" % (executor._symbol.name or "symbol")
+    fwd = prog._jit_forward(bool(train))
+    if any(mask):
+        outs, _ = jax.eval_shape(fwd, args, aux, keys)
+        cots = tuple(jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs)
+        fb = prog._jit_fwd_bwd(bool(train), mask)
+        closed = jax.make_jaxpr(fb)(args, aux, keys, cots)
+    else:
+        closed = jax.make_jaxpr(fwd)(args, aux, keys)
+    rep = check_jaxpr(closed, target=target)
+    rep.extend(check_symbol(executor._symbol, target=target))
+    rep.extend(check_registry())
+    rep.target = target
+    return rep, closed

@@ -1,0 +1,136 @@
+"""Opt-in pre-flight: run graphcheck on the traced program before step 0.
+
+Wireup (``MXNET_TPU_PREFLIGHT=1``):
+
+* ``ShardedTrainer.step`` — the first step traces the exact step program
+  and runs every graphcheck pass BEFORE dispatching to devices.
+* ``Module.bind`` — the bound executor's fused forward(+backward)
+  program is checked the same way.
+
+On ERROR-severity findings the run aborts with
+:class:`~mxnet_tpu.analysis.report.PreflightError` (unless
+``MXNET_TPU_PREFLIGHT_ACTION=warn``), and ALWAYS writes the report —
+JSON next to the checkpoints, exactly where the PR-2 watchdog puts its
+post-mortems, so the forensics for "refused to launch" and "hung at
+step N" live in one place.
+
+Env knobs:
+
+=====================================  ====================================
+``MXNET_TPU_PREFLIGHT``                master switch (``1`` on; default off)
+``MXNET_TPU_PREFLIGHT_ACTION``         ``abort`` (default): raise
+                                       PreflightError on ERROR findings;
+                                       ``warn``: log and continue
+``MXNET_TPU_PREFLIGHT_DIR``            report directory (default: the
+                                       watchdog/checkpoint dir, else cwd)
+``MXNET_TPU_PREFLIGHT_HLO``            ``1``: also compile and dump the
+                                       optimized HLO next to the report
+                                       (feeds tools/hlo_diff.py
+                                       ``--from-graphcheck``; costs one
+                                       extra compile)
+``MXNET_TPU_PREFLIGHT_REPLICATED_MB``  GC201 size threshold (default 8)
+=====================================  ====================================
+"""
+from __future__ import annotations
+
+import logging
+import os
+
+from .report import PreflightError, Report
+
+__all__ = ["enabled", "report_dir", "run_trainer_preflight",
+           "run_module_preflight", "write_report"]
+
+_PREFIX = "preflight"
+_SEQ = [0]          # per-process report counter: several trainers/modules
+                    # in one process must not overwrite each other
+
+
+def enabled() -> bool:
+    return os.environ.get("MXNET_TPU_PREFLIGHT", "0") not in (
+        "0", "", "false", "off")
+
+
+def _action() -> str:
+    act = os.environ.get("MXNET_TPU_PREFLIGHT_ACTION", "abort")
+    return act if act in ("abort", "warn") else "abort"
+
+
+def report_dir() -> str:
+    explicit = os.environ.get("MXNET_TPU_PREFLIGHT_DIR")
+    if explicit:
+        return explicit
+    from ..resilience import watchdog as _wd
+    return (os.environ.get("MXNET_TPU_WATCHDOG_DIR")
+            or _wd.default_report_dir()
+            or os.getcwd())
+
+
+def write_report(report: Report, name: str, jaxpr=None,
+                 hlo_text: str = None) -> str:
+    """Persist the report (+ jaxpr text, + optional HLO) under the report
+    dir; returns the JSON path.  Artifact paths are recorded IN the
+    report so ``hlo_diff --from-graphcheck`` can find them."""
+    d = report_dir()
+    os.makedirs(d, exist_ok=True)
+    _SEQ[0] += 1
+    base = os.path.join(d, "%s-%s-%d-%d" % (_PREFIX, name, os.getpid(),
+                                            _SEQ[0]))
+    if jaxpr is not None:
+        jaxpr_path = base + ".jaxpr.txt"
+        with open(jaxpr_path, "w") as f:
+            f.write(str(jaxpr))
+        report.artifacts["jaxpr"] = jaxpr_path
+    if hlo_text is not None:
+        hlo_path = base + ".hlo.txt"
+        with open(hlo_path, "w") as f:
+            f.write(hlo_text)
+        report.artifacts["hlo"] = hlo_path
+    return report.save(base + ".json")
+
+
+def _finish(report: Report, name: str, jaxpr=None, hlo_text=None):
+    path = write_report(report, name, jaxpr=jaxpr, hlo_text=hlo_text)
+    errors = report.errors()
+    if errors:
+        msg = ("pre-flight found %d ERROR finding(s) in %s "
+               "(report: %s):\n%s"
+               % (len(errors), report.target, path,
+                  "\n".join("  [%s] %s" % (f.rule, f.message)
+                            for f in errors)))
+        if _action() == "abort":
+            raise PreflightError(msg, report)
+        logging.error("%s\nMXNET_TPU_PREFLIGHT_ACTION=warn: continuing "
+                      "anyway", msg)
+    else:
+        logging.info("pre-flight clean for %s (%d warnings; report: %s)",
+                     report.target, len(report.warnings()), path)
+    return path
+
+
+def run_trainer_preflight(trainer, params, mom, aux, inputs):
+    """Check a ShardedTrainer's step program; called by the trainer on its
+    first step when enabled.  Raises PreflightError on ERROR findings."""
+    from . import graphcheck
+    rep, closed = graphcheck.check_trainer(trainer, params, mom, aux,
+                                           inputs)
+    hlo_text = None
+    if os.environ.get("MXNET_TPU_PREFLIGHT_HLO", "0") not in ("0", ""):
+        try:
+            keys = trainer._keys()
+            guard = trainer._guard_arrays()
+            hlo_text = trainer._step.lower(
+                params, mom, aux, inputs, keys, guard).compile().as_text()
+        except Exception:
+            logging.exception("pre-flight: HLO dump failed (continuing)")
+    return _finish(rep, "trainer", jaxpr=closed, hlo_text=hlo_text)
+
+
+def run_module_preflight(module):
+    """Check a bound Module's head executor program; called from
+    Module.bind when enabled."""
+    from . import graphcheck
+    executor = module._exec_group.execs[0]
+    rep, closed = graphcheck.check_executor(executor,
+                                            train=module.for_training)
+    return _finish(rep, "module", jaxpr=closed)

@@ -1,0 +1,213 @@
+"""Shared finding/report model for the static-analysis engines.
+
+Both engines (graphcheck: jaxpr-level SPMD/perf lint; srclint: AST-level
+host-footgun lint) emit :class:`Finding` records into a :class:`Report`.
+A finding is one rule violation: rule id, severity, human message, a
+location string (file:line for srclint, a jaxpr path like
+``shard_map/scan.body`` for graphcheck), and a fix hint.  Reports render
+as JSON (machine: CI gates, ``tools/hlo_diff.py --from-graphcheck``) or
+pretty text (human: the ``tools/postmortem.py`` style), and carry enough
+provenance (engine, target, artifact paths) to act on after the run.
+
+Severity model — three levels, ordered:
+
+* ``error``   — the program is statically wrong in a way that will hang,
+  crash, or silently corrupt training (e.g. a rank-divergent collective
+  schedule).  Pre-flight aborts on these.
+* ``warning`` — a real hazard that may be intentional (replicated large
+  buffer, missing donation, recompile-per-step attr).
+* ``info``    — noteworthy but usually benign.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Iterable, List, Optional
+
+__all__ = ["Finding", "Report", "PreflightError", "SEVERITIES",
+           "severity_rank"]
+
+SEVERITIES = ("info", "warning", "error")
+
+
+def severity_rank(sev: str) -> int:
+    """Numeric order of a severity name (unknown names rank highest so a
+    typo'd severity is never silently ignored by a gate)."""
+    try:
+        return SEVERITIES.index(sev)
+    except ValueError:
+        return len(SEVERITIES)
+
+
+class PreflightError(RuntimeError):
+    """Raised when a pre-flight check finds ERROR-severity problems; the
+    offending :class:`Report` rides along as ``.report``."""
+
+    def __init__(self, message, report: "Report" = None):
+        super().__init__(message)
+        self.report = report
+
+
+class Finding:
+    """One rule violation."""
+
+    __slots__ = ("rule", "severity", "message", "location", "fix_hint",
+                 "extra")
+
+    def __init__(self, rule: str, severity: str, message: str,
+                 location: str = "", fix_hint: str = "",
+                 extra: Optional[Dict] = None):
+        if severity not in SEVERITIES:
+            raise ValueError("severity must be one of %s, got %r"
+                             % (SEVERITIES, severity))
+        self.rule = rule
+        self.severity = severity
+        self.message = message
+        self.location = location
+        self.fix_hint = fix_hint
+        self.extra = dict(extra or {})
+
+    def to_dict(self) -> Dict:
+        d = {"rule": self.rule, "severity": self.severity,
+             "message": self.message, "location": self.location}
+        if self.fix_hint:
+            d["fix_hint"] = self.fix_hint
+        if self.extra:
+            d["extra"] = self.extra
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "Finding":
+        return cls(d["rule"], d["severity"], d["message"],
+                   d.get("location", ""), d.get("fix_hint", ""),
+                   d.get("extra"))
+
+    def __repr__(self):
+        return "<Finding %s %s @ %s: %s>" % (
+            self.rule, self.severity.upper(), self.location or "?",
+            self.message)
+
+
+class Report:
+    """A bag of findings from one engine run over one target."""
+
+    def __init__(self, engine: str, target: str = "",
+                 findings: Optional[Iterable[Finding]] = None,
+                 artifacts: Optional[Dict[str, str]] = None):
+        self.engine = engine
+        self.target = target
+        self.findings: List[Finding] = list(findings or [])
+        # paths to things a downstream tool can chew on: the dumped
+        # jaxpr/HLO text for hlo_diff, the fixture file for srclint, ...
+        self.artifacts: Dict[str, str] = dict(artifacts or {})
+        self.time = time.time()
+
+    # -- building ---------------------------------------------------------
+    def add(self, rule, severity, message, location="", fix_hint="",
+            extra=None):
+        self.findings.append(Finding(rule, severity, message, location,
+                                     fix_hint, extra))
+
+    def extend(self, other: "Report"):
+        self.findings.extend(other.findings)
+        self.artifacts.update(other.artifacts)
+
+    # -- querying ---------------------------------------------------------
+    def by_severity(self, severity: str) -> List[Finding]:
+        return [f for f in self.findings if f.severity == severity]
+
+    def errors(self) -> List[Finding]:
+        return self.by_severity("error")
+
+    def warnings(self) -> List[Finding]:
+        return self.by_severity("warning")
+
+    def at_or_above(self, severity: str) -> List[Finding]:
+        floor = severity_rank(severity)
+        return [f for f in self.findings
+                if severity_rank(f.severity) >= floor]
+
+    def counts(self) -> Dict[str, int]:
+        out = {s: 0 for s in SEVERITIES}
+        for f in self.findings:
+            out[f.severity] = out.get(f.severity, 0) + 1
+        return out
+
+    def __len__(self):
+        return len(self.findings)
+
+    def __iter__(self):
+        return iter(self.findings)
+
+    # -- rendering --------------------------------------------------------
+    def to_dict(self) -> Dict:
+        return {
+            "kind": "analysis_report",
+            "engine": self.engine,
+            "target": self.target,
+            "time": self.time,
+            "counts": self.counts(),
+            "findings": [f.to_dict() for f in self.sorted()],
+            "artifacts": self.artifacts,
+        }
+
+    def to_json(self, indent: int = 2) -> str:
+        return json.dumps(self.to_dict(), indent=indent, default=repr)
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "Report":
+        rep = cls(d.get("engine", "?"), d.get("target", ""),
+                  [Finding.from_dict(f) for f in d.get("findings", [])],
+                  d.get("artifacts"))
+        rep.time = d.get("time", rep.time)
+        return rep
+
+    @classmethod
+    def load(cls, path: str) -> "Report":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+    def save(self, path: str) -> str:
+        """Atomic JSON write (same temp+replace discipline as the
+        checkpoint container — a preempted pre-flight must not leave a
+        truncated report for the next tool to choke on)."""
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(self.to_json())
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return path
+
+    def sorted(self) -> List[Finding]:
+        """Findings, most severe first, then by location for stability."""
+        return sorted(self.findings,
+                      key=lambda f: (-severity_rank(f.severity), f.rule,
+                                     f.location))
+
+    def pretty(self, max_findings: int = 0) -> str:
+        """Human rendering (tools/postmortem.py style)."""
+        lines = []
+        rule = "=" * 72
+        lines.append(rule)
+        lines.append("STATIC ANALYSIS [%s] %s" % (self.engine, self.target))
+        lines.append(rule)
+        c = self.counts()
+        lines.append("findings: %d error / %d warning / %d info"
+                     % (c["error"], c["warning"], c["info"]))
+        shown = self.sorted()
+        if max_findings and len(shown) > max_findings:
+            lines.append("(showing %d of %d)" % (max_findings, len(shown)))
+            shown = shown[:max_findings]
+        for f in shown:
+            lines.append("-" * 72)
+            lines.append("%-7s %s  %s" % (f.severity.upper(), f.rule,
+                                          f.location))
+            lines.append("    %s" % f.message)
+            if f.fix_hint:
+                lines.append("    fix: %s" % f.fix_hint)
+        for name, path in sorted(self.artifacts.items()):
+            lines.append("artifact %s: %s" % (name, path))
+        lines.append("")
+        return "\n".join(lines)

@@ -1,0 +1,465 @@
+"""Source linter: AST-level host-footgun scan (pre-flight Engine 2).
+
+Where graphcheck inspects the *traced* program, this engine inspects the
+*source* for mistakes that either never reach a trace (they crash or
+silently freeze a value at trace time) or that tracing cannot see
+(missing watchdog arming).  It is deliberately heuristic and
+conservative: a rule only fires on patterns that are near-certainly
+wrong, because the repo self-lint (tests/test_analysis.py) requires zero
+false positives on the shipped tree.
+
+**Traced-context detection.**  A function is considered traced when it
+(a) is decorated with ``jax.jit`` / ``functools.partial(jax.jit, ...)``
+/ ``jax.checkpoint`` / the op-registry ``@register``; (b) is passed by
+name into a tracing combinator (``jit``, ``shard_map``, ``grad``,
+``value_and_grad``, ``vjp``, ``scan``, ``cond``, ``while_loop``,
+``vmap``, ``remat``, ``eval_shape``, ``make_jaxpr``, ``pallas_call``,
+...); (c) contains collective primitives (``lax.psum`` et al. only make
+sense under trace); or (d) is defined inside, or called by name from,
+another traced function in the same module (propagated to fixpoint —
+host helpers that *run at trace time* inherit the constraint, because
+whatever they compute is frozen into the program).
+
+Rule catalog (docs/static-analysis.md):
+
+======  =========================  ========  ===============================
+id      name                       severity  what it catches
+======  =========================  ========  ===============================
+SL101   host-numpy-on-tracer       error     ``np.f(x)`` where ``x`` is a
+                                             traced-function parameter —
+                                             crashes at trace or silently
+                                             constant-folds
+SL102   time-in-jit                error     ``time.time()`` etc. inside a
+                                             traced function: frozen at
+                                             trace, never ticks again
+SL103   env-read-in-jit            warning   env reads inside a traced
+                                             function: frozen at first
+                                             trace, per-step changes lost
+SL104   python-rng-in-jit          error     ``random.*`` / ``np.random.*``
+                                             inside a traced function: the
+                                             same "random" numbers replay
+                                             every step
+SL105   tracer-leak-to-self        warning   ``self.x = ...`` inside a
+                                             traced function: leaks a
+                                             tracer out of the trace
+SL106   unarmed-collective-entry   warning   library function that builds a
+                                             shard_map program but never
+                                             arms the hang watchdog around
+                                             its execution
+======  =========================  ========  ===============================
+
+**Suppression syntax** (``docs/static-analysis.md``):
+
+* line:      ``x = np.sqrt(p)  # tpulint: disable=SL101``
+* function:  the same comment on the ``def`` line covers the body
+* file:      a ``# tpulint: disable-file=SL105,SL106`` line anywhere
+* ``disable=all`` disables every rule at that scope
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .report import Report
+
+__all__ = ["lint_source", "lint_file", "lint_paths", "RULES"]
+
+RULES = {
+    "SL101": ("host-numpy-on-tracer", "error"),
+    "SL102": ("time-in-jit", "error"),
+    "SL103": ("env-read-in-jit", "warning"),
+    "SL104": ("python-rng-in-jit", "error"),
+    "SL105": ("tracer-leak-to-self", "warning"),
+    "SL106": ("unarmed-collective-entry", "warning"),
+}
+
+# combinators whose function-valued arguments get traced (matched on the
+# last dotted segment: jax.jit, functools.partial(jax.jit, ...), lax.scan)
+_TRACING_CALLS = frozenset({
+    "jit", "shard_map", "grad", "value_and_grad", "vjp", "jvp",
+    "linearize", "checkpoint", "remat", "vmap", "pmap", "xmap",
+    "eval_shape", "make_jaxpr", "scan", "cond", "while_loop", "fori_loop",
+    "switch", "associative_scan", "custom_vjp", "custom_jvp", "named_call",
+    "pallas_call", "apply_backward_mirror",
+})
+
+# decorators that mark a def as traced
+_TRACING_DECORATORS = frozenset({"jit", "checkpoint", "remat", "register",
+                                 "custom_vjp", "custom_jvp"})
+
+_COLLECTIVE_CALLS = frozenset({
+    "psum", "pmean", "pmax", "pmin", "ppermute", "pshuffle", "all_gather",
+    "all_to_all", "psum_scatter", "axis_index", "pvary",
+})
+
+_TIME_CALLS = frozenset({"time.time", "time.perf_counter", "time.monotonic",
+                         "time.sleep", "time.process_time",
+                         "time.perf_counter_ns", "time.time_ns"})
+
+# np attributes that are constants/dtypes, not host computation
+_NP_BENIGN = frozenset({
+    "dtype", "float16", "float32", "float64", "int8", "int16", "int32",
+    "int64", "uint8", "uint16", "uint32", "uint64", "bool_", "complex64",
+    "complex128", "ndarray", "generic", "integer", "floating", "number",
+    "newaxis", "pi", "inf", "e", "nan", "shape",
+})
+
+_SUPPRESS_RE = re.compile(r"#\s*tpulint:\s*disable=([\w,\s]+)")
+_SUPPRESS_FILE_RE = re.compile(r"#\s*tpulint:\s*disable-file=([\w,\s]+)")
+
+
+def _dotted(node) -> str:
+    """'jax.lax.psum' for an Attribute/Name chain, '' when not static."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _last_segment(dotted: str) -> str:
+    return dotted.rsplit(".", 1)[-1] if dotted else ""
+
+
+class _FnInfo:
+    """``traced`` levels: 0 = host-only; 1 = runs AT TRACE TIME (reached
+    by call from a traced body — its results are frozen into the program,
+    but its parameters are usually static config, not tracers); 2 =
+    DIRECTLY traced (jitted / passed to a combinator / collective body —
+    its parameters ARE tracers)."""
+
+    __slots__ = ("node", "name", "parent", "traced", "param_names",
+                 "calls_watch", "builds_shard_map", "lineno")
+
+    TRACED_HOST = 1
+    TRACED_DIRECT = 2
+
+    def __init__(self, node, name, parent):
+        self.node = node
+        self.name = name
+        self.parent = parent           # enclosing _FnInfo or None
+        self.traced = 0
+        self.param_names: Set[str] = set()
+        self.calls_watch = False
+        self.builds_shard_map = False
+        self.lineno = node.lineno
+
+
+def _index_functions(tree) -> List[_FnInfo]:
+    """Every def/lambda with its enclosing function, in document order."""
+    infos: List[_FnInfo] = []
+
+    def walk(node, parent):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info = _FnInfo(child, child.name, parent)
+                _fill_params(info, child.args)
+                infos.append(info)
+                walk(child, info)
+            elif isinstance(child, ast.Lambda):
+                info = _FnInfo(child, "<lambda>", parent)
+                _fill_params(info, child.args)
+                infos.append(info)
+                walk(child, info)
+            else:
+                walk(child, parent)
+
+    walk(tree, None)
+    return infos
+
+
+def _fill_params(info: _FnInfo, args: ast.arguments):
+    for a in (list(args.posonlyargs) + list(args.args)
+              + list(args.kwonlyargs)):
+        info.param_names.add(a.arg)
+    if args.vararg:
+        info.param_names.add(args.vararg.arg)
+    if args.kwarg:
+        info.param_names.add(args.kwarg.arg)
+
+
+def _own_body_nodes(fn_node):
+    """AST nodes of a function body EXCLUDING nested function bodies (so a
+    violation is attributed to the innermost function)."""
+    out = []
+
+    def walk(node):
+        for child in ast.iter_child_nodes(node):
+            out.append(child)
+            if not isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                walk(child)
+
+    walk(fn_node)
+    return out
+
+
+def _has_tracing_decorator(node) -> bool:
+    for dec in getattr(node, "decorator_list", []):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        if _last_segment(_dotted(target)) in _TRACING_DECORATORS:
+            return True
+        # functools.partial(jax.jit, ...) / partial(jit, static_argnums=..)
+        if isinstance(dec, ast.Call) \
+                and _last_segment(_dotted(dec.func)) == "partial":
+            for a in dec.args:
+                if _last_segment(_dotted(a)) in _TRACING_DECORATORS:
+                    return True
+    return False
+
+
+def _mark_traced(infos: List[_FnInfo], tree) -> None:
+    by_name: Dict[str, List[_FnInfo]] = {}
+    for info in infos:
+        by_name.setdefault(info.name, []).append(info)
+
+    # seed DIRECT: decorators, collective bodies, names passed to tracing
+    # combinators, inline lambdas handed to combinators
+    traced_names: Set[str] = set()
+    for info in infos:
+        if _has_tracing_decorator(info.node):
+            info.traced = _FnInfo.TRACED_DIRECT
+        for node in _own_body_nodes(info.node):
+            if isinstance(node, ast.Call):
+                callee = _dotted(node.func)
+                if _last_segment(callee) in _COLLECTIVE_CALLS \
+                        and ("lax" in callee or "jax" in callee
+                             or callee in _COLLECTIVE_CALLS):
+                    info.traced = _FnInfo.TRACED_DIRECT
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _last_segment(_dotted(node.func)) not in _TRACING_CALLS:
+            continue
+        for a in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(a, ast.Name):
+                traced_names.add(a.id)
+            elif isinstance(a, ast.Lambda):
+                for info in infos:
+                    if info.node is a:
+                        info.traced = _FnInfo.TRACED_DIRECT
+    for name in traced_names:
+        for info in by_name.get(name, []):
+            info.traced = _FnInfo.TRACED_DIRECT
+
+    # propagate: nested defs of DIRECT fns see tracers too; same-module
+    # functions CALLED from any traced body run at trace time (HOST level
+    # — their results are frozen, but their params are usually static)
+    changed = True
+    while changed:
+        changed = False
+        for info in infos:
+            if info.parent is not None \
+                    and info.parent.traced == _FnInfo.TRACED_DIRECT \
+                    and info.traced < _FnInfo.TRACED_DIRECT:
+                info.traced = _FnInfo.TRACED_DIRECT
+                changed = True
+        for info in infos:
+            if not info.traced:
+                continue
+            for node in _own_body_nodes(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = _dotted(node.func)
+                if "." in callee or not callee:
+                    continue               # cross-module / dynamic: skip
+                for target in by_name.get(callee, []):
+                    if not target.traced:
+                        target.traced = _FnInfo.TRACED_HOST
+                        changed = True
+
+
+class _Suppressions:
+    def __init__(self, source: str):
+        self.lines = source.splitlines()
+        self.file_wide: Set[str] = set()
+        for line in self.lines:
+            m = _SUPPRESS_FILE_RE.search(line)
+            if m:
+                self.file_wide |= {t.strip() for t in m.group(1).split(",")}
+
+    def _line_set(self, lineno: int) -> Set[str]:
+        if 1 <= lineno <= len(self.lines):
+            m = _SUPPRESS_RE.search(self.lines[lineno - 1])
+            if m:
+                return {t.strip() for t in m.group(1).split(",")}
+        return set()
+
+    def active(self, rule: str, lineno: int,
+               fn: Optional[_FnInfo]) -> bool:
+        for scope in (self.file_wide, self._line_set(lineno),
+                      self._line_set(fn.lineno) if fn else set()):
+            if "all" in scope or rule in scope:
+                return True
+        return False
+
+
+def _enclosing_params(fn: _FnInfo) -> Set[str]:
+    """Parameter names of a DIRECTLY-traced fn and every directly-traced
+    enclosing fn — values flowing in from any of them are (potentially)
+    tracers.  Host-level (trace-time helper) params are excluded: they
+    usually carry static config, not tracers."""
+    names: Set[str] = set()
+    cur = fn
+    while cur is not None and cur.traced == _FnInfo.TRACED_DIRECT:
+        names |= cur.param_names
+        cur = cur.parent
+    return names
+
+
+def _call_arg_names(call: ast.Call) -> Set[str]:
+    out: Set[str] = set()
+    for a in list(call.args) + [kw.value for kw in call.keywords]:
+        if isinstance(a, ast.Name):
+            out.add(a.id)
+        elif isinstance(a, ast.Starred) and isinstance(a.value, ast.Name):
+            out.add(a.value.id)
+    return out
+
+
+def lint_source(source: str, filename: str = "<string>",
+                in_library: bool = False) -> Report:
+    """Lint one python source text.  ``in_library``: apply the
+    library-only rules (SL106) — True for files under ``mxnet_tpu/``."""
+    rep = Report("srclint", filename)
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError as e:
+        rep.add("SL000", "error", "file does not parse: %s" % e,
+                location="%s:%s" % (filename, e.lineno or 0))
+        return rep
+    sup = _Suppressions(source)
+    infos = _index_functions(tree)
+    _mark_traced(infos, tree)
+
+    def add(rule, lineno, fn, message, fix_hint=""):
+        if sup.active(rule, lineno, fn):
+            return
+        rep.add(rule, RULES[rule][1], message,
+                location="%s:%d" % (filename, lineno), fix_hint=fix_hint,
+                extra={"function": fn.name if fn else ""})
+
+    for fn in infos:
+        body = _own_body_nodes(fn.node)
+        for node in body:
+            if isinstance(node, ast.Call):
+                callee = _dotted(node.func)
+                if _last_segment(callee) == "watch":
+                    fn.calls_watch = True
+                if _last_segment(callee) == "shard_map":
+                    fn.builds_shard_map = True
+        if not fn.traced:
+            continue
+        tracer_names = _enclosing_params(fn)
+        for node in body:
+            if isinstance(node, ast.Call):
+                callee = _dotted(node.func)
+                root = callee.split(".", 1)[0]
+                last = _last_segment(callee)
+                if root in ("np", "numpy") and last not in _NP_BENIGN \
+                        and not callee.startswith(("np.random.",
+                                                   "numpy.random.")) \
+                        and (_call_arg_names(node) & tracer_names):
+                    add("SL101", node.lineno, fn,
+                        "host numpy call %s() on traced value(s) %s inside "
+                        "traced function %r: crashes at trace time or "
+                        "silently freezes the value into the program"
+                        % (callee, sorted(_call_arg_names(node)
+                                          & tracer_names), fn.name),
+                        "use jnp.%s (stays in the traced program)" % last)
+                if callee in _TIME_CALLS:
+                    add("SL102", node.lineno, fn,
+                        "%s() inside traced function %r is evaluated ONCE "
+                        "at trace time and frozen into the program"
+                        % (callee, fn.name),
+                        "move timing to the host loop around the jitted "
+                        "call")
+                if callee == "os.getenv" or callee == "os.environ.get":
+                    add("SL103", node.lineno, fn,
+                        "environment read inside traced function %r is "
+                        "frozen at first trace; later changes are "
+                        "silently ignored" % fn.name,
+                        "read the env var at module import or pass the "
+                        "value in as an argument")
+                if (callee.startswith("random.")
+                        or callee.startswith(("np.random.",
+                                              "numpy.random."))):
+                    add("SL104", node.lineno, fn,
+                        "host RNG call %s() inside traced function %r "
+                        "produces the SAME \"random\" numbers on every "
+                        "call of the compiled program" % (callee, fn.name),
+                        "thread a jax.random key in (needs_rng ops get "
+                        "one injected)")
+            elif isinstance(node, ast.Subscript):
+                if _dotted(node.value) == "os.environ":
+                    add("SL103", node.lineno, fn,
+                        "os.environ[...] inside traced function %r is "
+                        "frozen at first trace" % fn.name,
+                        "read the env var at module import or pass the "
+                        "value in as an argument")
+            elif isinstance(node, (ast.Assign, ast.AugAssign)) \
+                    and fn.traced == _FnInfo.TRACED_DIRECT:
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        add("SL105", node.lineno, fn,
+                            "assignment to self.%s inside traced function "
+                            "%r stores a tracer on the object: it escapes "
+                            "the trace and is dead (or poison) by the "
+                            "next call" % (t.attr, fn.name),
+                            "return the value from the traced function "
+                            "and store it on the host side")
+
+    if in_library:
+        for fn in infos:
+            if fn.traced or not fn.builds_shard_map or fn.calls_watch:
+                continue
+            if sup.active("SL106", fn.lineno, fn):
+                continue
+            rep.add("SL106", RULES["SL106"][1],
+                    "%r builds a shard_map program but never arms the "
+                    "hang watchdog around its execution: a dead peer "
+                    "blocks here with zero diagnostics" % fn.name,
+                    location="%s:%d" % (filename, fn.lineno),
+                    fix_hint="wrap the execution in resilience.watchdog."
+                             "watch(tag, kind='collective') like "
+                             "parallel/ring.py does",
+                    extra={"function": fn.name})
+    return rep
+
+
+def lint_file(path: str, in_library: Optional[bool] = None) -> Report:
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    if in_library is None:
+        in_library = "mxnet_tpu" in os.path.normpath(path).split(os.sep)
+    return lint_source(source, filename=path, in_library=in_library)
+
+
+def _iter_py_files(paths: Iterable[str]):
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git")]
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    yield os.path.join(dirpath, name)
+
+
+def lint_paths(paths: Iterable[str]) -> Report:
+    """Lint every ``.py`` file under ``paths`` into one combined report."""
+    rep = Report("srclint", ", ".join(paths))
+    for path in _iter_py_files(paths):
+        rep.extend(lint_file(path))
+    return rep

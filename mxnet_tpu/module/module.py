@@ -250,6 +250,14 @@ class Module(BaseModule):
             grad_req=grad_req, state_names=self._state_names,
             group2ctxs=self._group2ctxs)
         self.binded = True
+        # Opt-in pre-flight (MXNET_TPU_PREFLIGHT=1): statically check the
+        # fused forward(+backward) program this binding will run — trace
+        # only, before any batch touches a device.  Shared-module rebinds
+        # reuse an already-checked program, so only the owner checks.
+        if shared_module is None:
+            from ..analysis import preflight as _preflight
+            if _preflight.enabled():
+                _preflight.run_module_preflight(self)
 
         if shared_module is not None and shared_module.params_initialized:
             self._arg_params, self._aux_params = (shared_module._arg_params,

@@ -31,7 +31,14 @@ import jax
 from ..base import MXNetError, Param, _Null
 
 __all__ = ["Operator", "register", "get_op", "list_ops", "alias",
-           "AttrDict", "apply_op", "jitted_apply"]
+           "AttrDict", "apply_op", "jitted_apply", "PER_STEP_PARAMS"]
+
+# Param names whose values change every optimizer step (scheduled lr/wd,
+# Adam's bias-corrected timestep, multi-tensor plurals).  Any op schema
+# declaring one of these MUST route it through ``dynamic_params`` or the
+# op recompiles per step — enforced statically by
+# analysis/graphcheck.check_registry (rule GC402) and the pre-flight.
+PER_STEP_PARAMS = frozenset({"lr", "lrs", "wd", "wds", "rescale_grad", "t"})
 
 
 class AttrDict(dict):

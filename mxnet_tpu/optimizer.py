@@ -641,7 +641,15 @@ def _fused_sgd_program(momentum_on, clip):
     import jax
     import jax.numpy as jnp
 
-    @jax.jit
+    # ms is donated (graphcheck GC202): update_batch rebinds every
+    # momentum handle to the returned array immediately and the Updater
+    # owns those buffers exclusively, so without donation the update
+    # holds old+new momentum for the whole model live — for SGD-momentum
+    # that is a full extra model copy in HBM.  ws/gs are NOT donatable:
+    # set_params commits host params via device_put, which on the same
+    # device ALIASES the buffer with the Module's _arg_params copy, and
+    # grad buffers outlive the call (grad_req='add' accumulates).
+    @functools.partial(jax.jit, donate_argnums=(2,))
     def run(ws, gs, ms, lrs, wds, rescale, momentum):
         new_ws, new_ms = [], []
         for w, g, m, lr, wd in zip(ws, gs, ms, lrs, wds):

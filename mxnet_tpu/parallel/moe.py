@@ -130,5 +130,9 @@ def moe_ffn(x, wg, w1, w2, mesh: Mesh, axis: str = "ep",
     from .audit import record_collective
     with _wd.watch("parallel.moe_ffn", kind="collective"):
         out = sharded(x, wg, w1, w2)
-    record_collective("all-to-all", "parallel.moe_ffn")
+    # two all_to_all hops (dispatch + combine) AND the aux-loss pmean —
+    # the trail must name every kind in the traced schedule (audit-trail
+    # gap caught by analysis/graphcheck collective extraction)
+    record_collective("all-to-all", "parallel.moe_ffn dispatch/combine")
+    record_collective("all-reduce", "parallel.moe_ffn aux-loss pmean")
     return out

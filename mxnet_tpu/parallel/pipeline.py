@@ -95,7 +95,13 @@ def pipeline_apply(stage_fn: Callable, num_stages: int, mesh: Mesh,
             params_stacked, NamedSharding(mesh, P(axis)))
         x_rep = jax.device_put(x_micro, NamedSharding(mesh, P()))
         out = jax.jit(mapped)(params_sharded, x_rep)
+    # the schedule is S+M-1 ppermute ticks PLUS the final psum that
+    # broadcasts the last stage's outputs ring-wide — record both kinds
+    # or a hang post-mortem would misattribute a stall in the psum
+    # (audit-trail gap caught by analysis/graphcheck collective
+    # extraction; see tests/test_analysis.py)
     record_collective("collective-permute", "parallel.pipeline_apply")
+    record_collective("all-reduce", "parallel.pipeline_apply output psum")
     return out
 
 

@@ -103,6 +103,11 @@ class ShardedTrainer:
         self._skipped_steps = 0
         self._step_count = 0
         self._last_ok = True
+        # -- pre-flight (analysis/preflight.py): with MXNET_TPU_PREFLIGHT=1
+        # the first step statically checks the traced program before any
+        # device executes it; runs once per trainer.
+        self._step_donated = True
+        self._preflight_done = False
 
     # -- tensor-parallel sharding rules -----------------------------------
     def param_sharding(self, name: str, shape) -> NamedSharding:
@@ -308,6 +313,7 @@ class ShardedTrainer:
             (rep, rep),                             # guard (scale, streak)
         )
         out_shardings = (pshard, mshard, ashard, rep, rep, (rep, rep))
+        self._step_donated = bool(donate)   # preflight GC202 checks this
         with self.spec.mesh:
             return jax.jit(step_fn, in_shardings=in_shardings,
                            out_shardings=out_shardings,
@@ -353,6 +359,7 @@ class ShardedTrainer:
         inputs = {n: jax.ShapeDtypeStruct(tuple(batch_shapes[n]),
                                           dts.get(n, jnp.float32))
                   for n in self.input_names}
+        self._maybe_preflight(params, mom, aux, inputs)
         keys = self._keys()
         guard = self._guard_arrays()
         with self.spec.mesh:
@@ -387,6 +394,7 @@ class ShardedTrainer:
         if self._step is None or remat != self._built_remat:
             self._built_remat = remat
             self._step = self._build_step()
+        self._maybe_preflight(params, mom, aux, batch)
         self._step_count += 1
         _chaos.maybe_preempt(self._step_count)
         if _chaos.fire("nan_grad", self._step_count) is not None:
@@ -437,6 +445,25 @@ class ShardedTrainer:
                              "loss_scale": self.loss_scale,
                              "bad_streak": self._bad_streak,
                              "skipped_steps": self._skipped_steps})
+
+    # -- pre-flight --------------------------------------------------------
+    def _maybe_preflight(self, params, mom, aux, batch):
+        """Static analysis of the step program before step 0 (opt-in via
+        MXNET_TPU_PREFLIGHT=1; analysis/preflight.py).  Trace-only — no
+        compile, no device execution — and once per trainer.  Raises
+        PreflightError on ERROR-severity findings (action=abort)."""
+        if self._preflight_done:
+            return
+        self._preflight_done = True
+        from ..analysis import preflight as _preflight
+        if not _preflight.enabled():
+            return
+        inputs = {n: (v if hasattr(v, "shape") and hasattr(v, "dtype")
+                      else np.asarray(v))
+                  for n, v in batch.items()}
+        inputs = {n: jax.ShapeDtypeStruct(tuple(v.shape), v.dtype)
+                  for n, v in inputs.items()}
+        _preflight.run_trainer_preflight(self, params, mom, aux, inputs)
 
     # -- resilience state --------------------------------------------------
     def _guard_arrays(self):

@@ -65,7 +65,7 @@ from typing import Dict, Optional
 
 __all__ = ["Watchdog", "HeartbeatLane", "watch", "heartbeat", "lane",
            "enabled", "configure", "reset", "set_default_report_dir",
-           "write_postmortem", "DEFAULT_EXIT_CODE"]
+           "default_report_dir", "write_postmortem", "DEFAULT_EXIT_CODE"]
 
 DEFAULT_STEP_TIMEOUT = 300.0
 DEFAULT_EXIT_CODE = 43
@@ -529,6 +529,14 @@ def set_default_report_dir(path: str):
     directory (explicit MXNET_TPU_WATCHDOG_DIR still wins)."""
     global _DEFAULT_REPORT_DIR
     _DEFAULT_REPORT_DIR = os.fspath(path)
+
+
+def default_report_dir() -> Optional[str]:
+    """The directory forensics default to (checkpoint dir once a
+    CheckpointManager registered, else None).  The pre-flight analyzer
+    (analysis/preflight.py) writes its reports here too, so static and
+    runtime diagnostics for one run share a directory."""
+    return _DEFAULT_REPORT_DIR
 
 
 @contextmanager

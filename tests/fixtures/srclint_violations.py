@@ -1,0 +1,66 @@
+"""srclint fixture: every rule violated at least once, with the expected
+rule id noted on each line.  NEVER imported — parsed by
+tests/test_analysis.py only, and excluded from the repo self-lint (which
+covers mxnet_tpu/, example/ and tools/)."""
+import os
+import random
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+@jax.jit
+def bad_host_numpy(x):
+    return np.sqrt(x)                                     # SL101
+
+
+@jax.jit
+def bad_clock(x):
+    t0 = time.time()                                      # SL102
+    return x + t0
+
+
+@jax.jit
+def bad_env(x):
+    if os.environ.get("DEBUG"):                           # SL103
+        x = x * 2.0
+    scale = float(os.environ["SCALE"])                    # SL103
+    return x * scale
+
+
+@jax.jit
+def bad_rng(x):
+    noise = random.random()                               # SL104
+    jitter = np.random.randn()                            # SL104
+    return x + noise + jitter
+
+
+class Leaky:
+    @jax.jit
+    def bad_leak(self, x):
+        y = x * 2.0
+        self.cache = y                                    # SL105
+        return y
+
+
+def traced_by_combinator(x):
+    # marked traced because it is handed to lax.scan below
+    return x, np.log(x)                                   # SL101
+
+
+def drives_scan(xs):
+    return lax.scan(traced_by_combinator, xs[0], xs)
+
+
+def contains_collective(x):
+    # traced level inferred from the collective call
+    y = lax.psum(x, "dp")
+    return y + time.perf_counter()                        # SL102
+
+
+def suppressed_ok(x):
+    """Same violations, suppressed — must produce NO findings."""
+    return jax.jit(lambda v: v + time.time())(x)  # tpulint: disable=SL102

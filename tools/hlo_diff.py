@@ -4,11 +4,24 @@
 (tools/bench_ideal.py).  Prints per-program op histograms and their
 diff — the evidence base for PERF.md's framework-vs-ideal analysis.
 
-Usage: python tools/hlo_diff.py [batch]
-Writes /tmp/hlo_framework_bs{N}.txt (the ideal dump comes from
-BENCH_DUMP_HLO in bench_ideal.py).
+Usage:
+    python tools/hlo_diff.py [batch]
+        classic mode — dump the ResNet-50 step, diff against the ideal
+        (BENCH_DUMP_HLO in bench_ideal.py); writes
+        /tmp/hlo_framework_bs{N}.txt
+
+    python tools/hlo_diff.py --from-graphcheck REPORT.json \\
+                             [--against OTHER.json|HLO.txt]
+        pre-flight mode — take the HLO artifact recorded in a graphcheck
+        pre-flight report (run training once with MXNET_TPU_PREFLIGHT=1
+        MXNET_TPU_PREFLIGHT_HLO=1 to produce it) and diff it against a
+        second report's artifact or a raw HLO text file.  This is how a
+        flagged program is compared with its fixed variant WITHOUT
+        rerunning training; with no --against, prints the single
+        program's op histogram.
 """
 import collections
+import json
 import os
 import re
 import sys
@@ -54,20 +67,66 @@ def dump_framework(batch):
     return path
 
 
+def hlo_from_report(path):
+    """Resolve an HLO text path from a graphcheck/pre-flight report JSON
+    (its ``artifacts.hlo`` entry) or pass a raw HLO text path through."""
+    if not path.endswith(".json"):
+        return path
+    with open(path) as f:
+        rep = json.load(f)
+    hlo = (rep.get("artifacts") or {}).get("hlo")
+    if not hlo:
+        raise SystemExit(
+            "%s records no HLO artifact — rerun the pre-flight with "
+            "MXNET_TPU_PREFLIGHT_HLO=1 (see docs/static-analysis.md)"
+            % path)
+    if not os.path.isfile(hlo):
+        raise SystemExit("HLO artifact %s (from %s) is missing"
+                         % (hlo, path))
+    return hlo
+
+
+def print_diff(path_a, path_b, label_a, label_b):
+    ha, hb = histogram(path_a), histogram(path_b)
+    print("%-28s %10s %10s %8s" % ("op", label_a[:10], label_b[:10],
+                                   "delta"))
+    for op in sorted(set(ha) | set(hb), key=lambda o: -(ha[o] + hb[o])):
+        if ha[op] or hb[op]:
+            print("%-28s %10d %10d %+8d"
+                  % (op, ha[op], hb[op], ha[op] - hb[op]))
+    print("\ntotal lines: %s=%d %s=%d"
+          % (label_a, len(open(path_a).read().splitlines()),
+             label_b, len(open(path_b).read().splitlines())))
+
+
 def main():
-    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 32
+    argv = sys.argv[1:]
+    if "--from-graphcheck" in argv:
+        i = argv.index("--from-graphcheck")
+        report = argv[i + 1] if i + 1 < len(argv) else None
+        if not report:
+            raise SystemExit("--from-graphcheck needs a report path")
+        flagged = hlo_from_report(report)
+        against = None
+        if "--against" in argv:
+            j = argv.index("--against")
+            if j + 1 >= len(argv):
+                raise SystemExit("--against needs a report/HLO path")
+            against = hlo_from_report(argv[j + 1])
+        if against is None:
+            h = histogram(flagged)
+            print("%-28s %10s" % ("op", "count"))
+            for op, n in h.most_common():
+                print("%-28s %10d" % (op, n))
+            print("\ntotal lines: %d"
+                  % len(open(flagged).read().splitlines()))
+        else:
+            print_diff(flagged, against, "flagged", "fixed")
+        return
+    batch = int(argv[0]) if argv else 32
     fw = dump_framework(batch)
     ideal = "/tmp/hlo_ideal_bs%d.txt" % batch
-    hf, hi = histogram(fw), histogram(ideal)
-    print("%-28s %10s %10s %8s" % ("op", "framework", "ideal", "delta"))
-    for op in sorted(set(hf) | set(hi),
-                     key=lambda o: -(hf[o] + hi[o])):
-        if hf[op] or hi[op]:
-            print("%-28s %10d %10d %+8d"
-                  % (op, hf[op], hi[op], hf[op] - hi[op]))
-    nf = sum(open(fw).read().count("\n") for _ in [0])
-    print("\ntotal lines: framework=%d ideal=%d"
-          % (nf, len(open(ideal).read().splitlines())))
+    print_diff(fw, ideal, "framework", "ideal")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,158 @@
+#!/usr/bin/env python3
+"""Repo-wide footgun linter CLI (analysis engine 2, plus optional graph
+checks) — the pre-merge gate for TPU-hostile patterns.
+
+Usage:
+    python tools/tpulint.py [paths...] [options]
+
+    paths                 files/directories to lint (default: mxnet_tpu,
+                          example and tools, relative to the repo root)
+    --format pretty|json  output format (default pretty)
+    --severity LEVEL      exit non-zero only on findings at/above LEVEL
+                          (info|warning|error; default warning)
+    --out FILE            also write the JSON report to FILE
+    --graphcheck          additionally trace + check the built-in sharded
+                          entry points (ShardedTrainer toy step, ring,
+                          pipeline, moe) — needs jax and a few seconds
+    --max-findings N      cap pretty output (0 = all)
+
+Exit status: 0 = clean at the gate severity, 1 = findings, 2 = usage/IO
+error.  ``--format json`` emits ONE JSON document on stdout so CI can
+both gate on the exit code and archive the findings.
+"""
+import argparse
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+DEFAULT_PATHS = ("mxnet_tpu", "example", "tools")
+
+
+def _graphcheck_builtin(report):
+    """Trace the repo's sharded entry points and fold the findings in —
+    the 'lint the programs, not just the source' half of the CLI."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.analysis import graphcheck
+    from mxnet_tpu.parallel.mesh import MeshSpec, make_mesh
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+    from mxnet_tpu.parallel.ring import local_ring_attention_fn
+    from mxnet_tpu.parallel import moe as moe_mod
+
+    n = min(2, jax.device_count())
+    mesh = make_mesh((n,), ("dp",))
+    compat = {} if hasattr(jax.lax, "pvary") else {"check_rep": False}
+
+    # ShardedTrainer toy step
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.SoftmaxOutput(fc, name="softmax")
+    trainer = ShardedTrainer(net, MeshSpec(mesh))
+    shapes = {"data": (2 * n, 4), "softmax_label": (2 * n,)}
+    params, mom, aux = trainer.init_state(shapes)
+    inputs = {k: jax.ShapeDtypeStruct(v, jnp.float32)
+              for k, v in shapes.items()}
+    rep, _ = graphcheck.check_trainer(trainer, params, mom, aux, inputs)
+    report.extend(rep)
+
+    # ring attention block schedule
+    ring_mesh = make_mesh((n,), ("sp",))
+    fn = local_ring_attention_fn("sp", causal=True, scale=1.0,
+                                 num_devices=n)
+    mapped = shard_map(fn, mesh=ring_mesh,
+                       in_specs=(P(None, "sp"),) * 3,
+                       out_specs=P(None, "sp"), **compat)
+    blk = jax.ShapeDtypeStruct((1, 2 * n, 2, 4), jnp.float32)
+    report.extend(graphcheck.check_fn(mapped, blk, blk, blk,
+                                      mesh=ring_mesh,
+                                      target="parallel.ring_attention"))
+
+    # moe dispatch/combine schedule
+    ep_mesh = make_mesh((n,), ("ep",))
+    local = moe_mod._moe_local_fn("ep", capacity=2,
+                                  activation=jax.nn.relu)
+    mapped = shard_map(local, mesh=ep_mesh,
+                       in_specs=(P("ep"), P(), P("ep"), P("ep")),
+                       out_specs=(P("ep"), P()), **compat)
+    report.extend(graphcheck.check_fn(
+        mapped,
+        jax.ShapeDtypeStruct((4 * n, 8), jnp.float32),
+        jax.ShapeDtypeStruct((8, n * 2), jnp.float32),
+        jax.ShapeDtypeStruct((n * 2, 8, 16), jnp.float32),
+        jax.ShapeDtypeStruct((n * 2, 16, 8), jnp.float32),
+        mesh=ep_mesh, target="parallel.moe_ffn"))
+
+    # pipeline tick schedule
+    pp_mesh = make_mesh((n,), ("pp",))
+    from mxnet_tpu.parallel.pipeline import pipeline_apply
+
+    def check_pipeline():
+        stacked = jax.ShapeDtypeStruct((n, 4), jnp.float32)
+        x = jax.ShapeDtypeStruct((2, 1, 4), jnp.float32)
+
+        def run(p, xm):
+            return pipeline_apply(lambda pl, v: v * pl.sum(), n, pp_mesh,
+                                  "pp", p, xm)
+        report.extend(graphcheck.check_fn(
+            run, stacked, x, mesh=pp_mesh,
+            target="parallel.pipeline_apply"))
+    check_pipeline()
+    report.extend(graphcheck.check_registry())
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*", help="files/dirs to lint")
+    ap.add_argument("--format", choices=("pretty", "json"),
+                    default="pretty")
+    ap.add_argument("--severity", choices=("info", "warning", "error"),
+                    default="warning",
+                    help="exit-1 gate: findings at/above this level")
+    ap.add_argument("--out", help="also write JSON report here")
+    ap.add_argument("--graphcheck", action="store_true",
+                    help="also trace+check built-in sharded entry points")
+    ap.add_argument("--max-findings", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    paths = args.paths or [os.path.join(_REPO, p) for p in DEFAULT_PATHS]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print("tpulint: no such path(s): %s" % ", ".join(missing),
+              file=sys.stderr)
+        return 2
+
+    from mxnet_tpu.analysis import srclint
+    report = srclint.lint_paths(paths)
+    report.engine = "tpulint"
+    if args.graphcheck:
+        try:
+            _graphcheck_builtin(report)
+        except Exception as e:                      # noqa: BLE001
+            print("tpulint: --graphcheck failed: %r" % e, file=sys.stderr)
+            return 2
+
+    if args.out:
+        report.save(args.out)
+    if args.format == "json":
+        print(report.to_json())
+    else:
+        print(report.pretty(max_findings=args.max_findings))
+
+    gated = report.at_or_above(args.severity)
+    return 1 if gated else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
