@@ -2470,6 +2470,36 @@ int MXPredCreateFromServed(const char *served_path, PredictorHandle *out) {
   API_END();
 }
 
+int MXPredSetDeadline(PredictorHandle handle, double deadline_sec) {
+  API_BEGIN();
+  PyObject *r = Call("pred_set_deadline",
+                     Py_BuildValue("(Kd)", (unsigned long long)H(handle),
+                                   deadline_sec));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXPredGetHealth(PredictorHandle handle, int *health) {
+  API_BEGIN();
+  PyObject *r = Call("pred_get_health",
+                     Py_BuildValue("(K)", (unsigned long long)H(handle)));
+  CHECK_PY(r);
+  *health = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXPredSwapServed(PredictorHandle handle, const char *served_path) {
+  API_BEGIN();
+  PyObject *r = Call("pred_swap_served",
+                     Py_BuildValue("(Ks)", (unsigned long long)H(handle),
+                                   served_path));
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
 int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
                          mx_uint **shape_data, mx_uint *shape_ndim) {
   API_BEGIN();

@@ -47,6 +47,22 @@ MXNET_DLL int MXPredCreatePartialOut(
  * tracing.  Artifact must match the running device kind. */
 MXNET_DLL int MXPredCreateFromServed(const char *served_path,
                                      PredictorHandle *out);
+/*! Served predictors dispatch through the resilient serving runtime
+ * (mxnet_tpu/serving/): bounded admission queue, deadline-aware
+ * batching, circuit breaker, hot swap.  Serving failures return -1 with
+ * a typed "Overloaded:"/"DeadlineExceeded:"/"CircuitOpen:"/
+ * "ExecFailed:"/"SwapFailed:" prefix in MXGetLastError(). */
+/*! Per-request deadline (seconds) for subsequent MXPredForward calls on
+ * a served predictor; <= 0 restores the runtime default. */
+MXNET_DLL int MXPredSetDeadline(PredictorHandle handle, double deadline_sec);
+/*! Serving health: 0 = SERVING, 1 = DEGRADED, 2 = BROKEN (circuit open,
+ * requests are shed instantly until the cooldown probe succeeds). */
+MXNET_DLL int MXPredGetHealth(PredictorHandle handle, int *health);
+/*! Canary-validated hot model-swap: load served_path, warm-run it off
+ * the serving path, atomically install on success; on any validation
+ * failure the previous model keeps serving and this returns -1. */
+MXNET_DLL int MXPredSwapServed(PredictorHandle handle,
+                               const char *served_path);
 MXNET_DLL int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
                                    mx_uint **shape_data,
                                    mx_uint *shape_ndim);

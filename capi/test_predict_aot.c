@@ -10,6 +10,7 @@
  */
 #include <stdio.h>
 #include <stdlib.h>
+#include <string.h>
 #include <unistd.h>
 
 #include "mxnet_tpu_c_predict_api.h"
@@ -32,6 +33,15 @@ int main(int argc, char **argv) {
   PredictorHandle pred = NULL;
   CHECK(MXPredCreateFromServed(argv[1], &pred));
 
+  /* the served path runs through the resilient serving runtime: health
+   * must read SERVING before any traffic */
+  int health = -1;
+  CHECK(MXPredGetHealth(pred, &health));
+  if (health != 0) {
+    fprintf(stderr, "fresh predictor health %d != SERVING\n", health);
+    return 1;
+  }
+
   /* standard MXPred flow: size the output buffer BEFORE feeding input */
   mx_uint *shape = NULL, ndim = 0;
   CHECK(MXPredGetOutputShape(pred, 0, &shape, &ndim));
@@ -39,6 +49,17 @@ int main(int argc, char **argv) {
   float batch[4 * 3];
   for (int i = 0; i < 4 * 3; ++i) batch[i] = (float)(i % 5) * 0.25f - 0.5f;
   CHECK(MXPredSetInput(pred, "data", batch, 4 * 3));
+
+  /* an unmeetable deadline must fail typed through MXGetLastError, not
+   * crash the embedded interpreter */
+  CHECK(MXPredSetDeadline(pred, 1e-6));
+  if (MXPredForward(pred) == 0 ||
+      strstr(MXGetLastError(), "DeadlineExceeded") == NULL) {
+    fprintf(stderr, "wanted typed DeadlineExceeded, got rc=0 or: %s\n",
+            MXGetLastError());
+    return 1;
+  }
+  CHECK(MXPredSetDeadline(pred, 0));   /* back to the runtime default */
   CHECK(MXPredForward(pred));
   if (ndim != 2 || shape[0] != 4) {
     fprintf(stderr, "unexpected output rank/shape\n");
@@ -62,6 +83,16 @@ int main(int argc, char **argv) {
     printf("row %u -> class %d\n", r, best);
   }
   free(probs);
+
+  /* hot-swap to a missing artifact: typed refusal, old model keeps
+   * serving (forward still works) */
+  if (MXPredSwapServed(pred, "/nonexistent/model.mxt") == 0 ||
+      strstr(MXGetLastError(), "SwapFailed") == NULL) {
+    fprintf(stderr, "wanted typed SwapFailed, got rc=0 or: %s\n",
+            MXGetLastError());
+    return 1;
+  }
+  CHECK(MXPredForward(pred));
   CHECK(MXPredFree(pred));
   printf("PREDICT AOT OK\n");
   /* skip static-destructor teardown: the embedded interpreter's

@@ -1235,24 +1235,49 @@ class _Predictor:
 
 
 class _ServedPredictor:
-    """Predictor over a deploy.ServedProgram artifact: the compiled
-    executable deserializes directly (no symbol layer, no tracing), so
-    the C consumer path MXPredCreateFromServed -> SetInput -> Forward ->
-    GetOutput never builds a graph."""
+    """Predictor over a deploy.ServedProgram artifact, dispatched through
+    the resilient serving runtime (serving/runtime.py): the compiled
+    executable deserializes directly (no symbol layer, no tracing) and
+    every MXPredForward goes through admission control, deadline
+    accounting and the circuit breaker.  Serving errors (Overloaded,
+    DeadlineExceeded, CircuitOpen, ExecFailed, SwapFailed) surface as
+    Python exceptions whose str() keeps the ``TypeName:`` prefix — the C
+    shim (capi/c_api.cc FailFromPython) flattens them into the error-
+    return + MXGetLastError convention, so nothing unwinds through the
+    embedded-interpreter boundary."""
 
     def __init__(self, path):
-        from .deploy import ServedProgram
-        self._served = ServedProgram.load(path)
+        from .serving import ServingRuntime
+        self._runtime = ServingRuntime(path, name="capi-serving")
+        self._served = self._runtime._program
         self._feed = {}
         self._outputs = None
+        self._deadline = None      # relative seconds; None = runtime default
 
     def set_input(self, name, data):
         if name not in self._served.input_names:
             raise MXNetError("unknown predictor input %r" % name)
-        self._feed[name] = np.asarray(data)
+        # the C caller hands a flat float buffer (MXPredSetInput);
+        # reshape to the artifact's full batch shape, as ServedProgram
+        # .forward always did
+        self._feed[name] = np.asarray(
+            data, self._served.input_dtypes[name]).reshape(
+                self._served.input_shapes[name])
+
+    def set_deadline(self, seconds):
+        """<= 0 restores the runtime default (MXNET_TPU_SERVE_*)."""
+        self._deadline = float(seconds) if seconds > 0 else None
+
+    def health(self) -> int:
+        return self._runtime.health()
+
+    def swap(self, path):
+        self._runtime.swap(path)
+        self._served = self._runtime._program
 
     def forward(self):
-        self._outputs = self._served.forward(**self._feed)
+        self._outputs = self._runtime.predict(dict(self._feed),
+                                              deadline=self._deadline)
 
     def get_output(self, index):
         if self._outputs is None:
@@ -1267,6 +1292,9 @@ class _ServedPredictor:
         if self._outputs is None:
             raise MXNetError("call MXPredForward first")
         return tuple(self._outputs[index].shape)
+
+    def close(self):
+        self._runtime.close()
 
 
 def pred_create_served(path: str) -> int:
@@ -1301,6 +1329,30 @@ def pred_forward(h: int):
     _get(h).forward()
 
 
+def _served_only(h: int, what: str):
+    pred = _get(h)
+    if not isinstance(pred, _ServedPredictor):
+        raise MXNetError("%s requires a served predictor "
+                         "(MXPredCreateFromServed)" % what)
+    return pred
+
+
+def pred_set_deadline(h: int, seconds: float):
+    """MXPredSetDeadline: per-request deadline for subsequent forwards."""
+    _served_only(h, "MXPredSetDeadline").set_deadline(float(seconds))
+
+
+def pred_get_health(h: int) -> int:
+    """MXPredGetHealth: 0=SERVING, 1=DEGRADED, 2=BROKEN (serving/breaker)."""
+    return int(_served_only(h, "MXPredGetHealth").health())
+
+
+def pred_swap_served(h: int, path: str):
+    """MXPredSwapServed: canary-validated hot swap; rolls back (keeps the
+    serving model) and errors on a bad artifact."""
+    _served_only(h, "MXPredSwapServed").swap(path)
+
+
 def pred_get_output_shape(h: int, index: int):
     return list(_get(h).output_shape(index))
 
@@ -1315,6 +1367,9 @@ def pred_get_output(h: int, index: int, addr: int, size: int):
 
 
 def pred_free(h: int):
+    pred = _handles.get(int(h))
+    if isinstance(pred, _ServedPredictor):
+        pred.close()       # stop the serving worker thread with the handle
     free_handle(h)
 
 

@@ -23,9 +23,18 @@ this through MXPredCreateFromServed (capi.py pred_create_served), so a C
 consumer can run a trained model from the artifact alone.
 
 Caveat (inherent to XLA AOT): the artifact is compiled for a specific
-device kind + topology; load on matching hardware.
+device kind + topology.  ``export_compiled`` records ``platform``,
+``device_kind`` and ``device_count`` in the container header and
+``ServedProgram.load`` refuses a mismatch with a typed
+:class:`TopologyMismatch` — instead of an opaque XLA deserializer crash
+— unless ``MXNET_TPU_SERVED_IGNORE_TOPOLOGY=1`` (experts: e.g. loading
+a single-chip artifact on a larger host to inspect its header).
+Artifacts written before these fields existed load with a warning.
 """
 from __future__ import annotations
+
+import logging
+import os
 
 import numpy as np
 
@@ -33,6 +42,18 @@ from .base import MXNetError
 from .resilience.container import read_container, write_container
 
 _MAGIC = "mxnet_tpu-served-v2"
+
+
+class TopologyMismatch(MXNetError):
+    """A served artifact was compiled for different hardware than the
+    loading process sees (platform / device kind / device count)."""
+
+
+def _current_topology():
+    """(platform, device_kind, device_count) of the running backend."""
+    import jax
+    devices = jax.devices()
+    return (jax.default_backend(), devices[0].device_kind, len(devices))
 
 
 def _to_host(arr):
@@ -103,8 +124,12 @@ def export_compiled(prog, const_args, aux, input_names, input_shapes,
             "flat-tuple signature the served container encodes"
             % (in_tree, out_tree))
 
+    platform, device_kind, device_count = _current_topology()
     meta = {
         "magic": _MAGIC,
+        "platform": platform,
+        "device_kind": device_kind,
+        "device_count": device_count,
         "param_names": param_names,
         "input_names": list(input_names),
         "input_shapes": {n: list(input_shapes[n]) for n in input_names},
@@ -123,6 +148,36 @@ def export_compiled(prog, const_args, aux, input_names, input_shapes,
     return path
 
 
+def _check_topology(meta):
+    """Refuse to hand a mismatched executable to XLA's deserializer.
+
+    The deserializer's own failure mode is an opaque crash (or, worse, a
+    program that runs and silently misbehaves on a different device
+    kind); this check turns it into a typed, actionable error BEFORE the
+    payload is touched."""
+    if "platform" not in meta:      # pre-topology v2 artifact
+        logging.warning(
+            "served artifact predates topology metadata; cannot verify it "
+            "matches this host (re-export to record platform/device_kind/"
+            "device_count)")
+        return
+    recorded = (meta.get("platform"), meta.get("device_kind"),
+                meta.get("device_count"))
+    current = _current_topology()
+    if recorded == current:
+        return
+    detail = ("artifact was exported for platform=%r device_kind=%r "
+              "device_count=%r but this process sees platform=%r "
+              "device_kind=%r device_count=%r" % (recorded + current))
+    if os.environ.get("MXNET_TPU_SERVED_IGNORE_TOPOLOGY") == "1":
+        logging.warning("MXNET_TPU_SERVED_IGNORE_TOPOLOGY=1: loading "
+                        "anyway — %s", detail)
+        return
+    raise TopologyMismatch(
+        "%s; XLA AOT executables only run on matching hardware "
+        "(set MXNET_TPU_SERVED_IGNORE_TOPOLOGY=1 to override)" % detail)
+
+
 class ServedProgram:
     """A deserialized AOT executable + its weights; no tracing anywhere."""
 
@@ -132,6 +187,7 @@ class ServedProgram:
         if meta.get("magic") != _MAGIC:
             raise MXNetError("not a mxnet_tpu served-program file "
                              "(magic %r)" % meta.get("magic"))
+        _check_topology(meta)
         in_tree, out_tree = _arity_trees(
             len(meta["param_names"]), len(meta["input_names"]),
             int(meta["n_outputs"]))

@@ -28,8 +28,13 @@ that layer (cf. "TensorFlow: a system for large-scale ML", arXiv:1605.08695
   ``num_dead_node``/straggler telemetry without issuing collectives.
 * ``chaos``      — fault injection (env or context manager): simulated
   preemption, checkpoint corruption, NaN gradients, transient IO
-  errors, silent hangs.  The resilience tests use it to prove recovery
-  end-to-end.
+  errors, silent hangs, and serving-path faults (slow/failing
+  executors, poisoned model swaps).  The resilience tests use it to
+  prove recovery end-to-end.
+
+The inference-side counterpart — admission control, deadlines, circuit
+breaking and hot model-swap built ON these primitives — is
+``mxnet_tpu/serving`` (docs/deploy.md, "Resilient serving").
 """
 from .container import (CorruptContainer, peek_header, read_container,
                         write_container)

@@ -17,6 +17,16 @@ recovery machinery is *proven* by tests instead of trusted:
   ``MXNET_TPU_CHAOS_HANG_SECONDS``, 3600 s), simulating a silent stall:
   peers block in the next collective and only the watchdog
   (resilience/watchdog.py) can turn the hang into a diagnosed fail-fast.
+* ``slow_exec``    — the serving executor call sleeps (``seconds`` param
+  or ``MXNET_TPU_CHAOS_SLOW_EXEC_SECONDS``, default 0.5) INSIDE the
+  watchdog-armed dispatch region: the straggling-accelerator drill for
+  the serving runtime (deadline misses, queue growth, shedding).
+* ``exec_error``   — the serving executor call raises ``RuntimeError``,
+  exercising retry/backoff and the circuit breaker
+  (serving/breaker.py) on the inference path.
+* ``bad_swap``     — the hot model-swap canary run produces non-finite
+  outputs, so swap validation must reject the incoming model and keep
+  serving the previous one (serving/runtime.py swap/rollback drill).
 
 Faults are armed either with the :func:`inject` context manager (tests)
 or the ``MXNET_TPU_CHAOS`` env var (whole-run drills), a comma list of
@@ -32,8 +42,8 @@ import os
 from typing import List, Optional
 
 __all__ = ["SimulatedPreemption", "inject", "fire", "maybe_preempt",
-           "maybe_io_error", "maybe_hang", "corrupt_latest", "active",
-           "reset"]
+           "maybe_io_error", "maybe_hang", "maybe_slow_exec",
+           "maybe_exec_error", "corrupt_latest", "active", "reset"]
 
 
 class SimulatedPreemption(RuntimeError):
@@ -71,9 +81,11 @@ def _parse_env():
         if not tok:
             continue
         count = 1
-        if "x" in tok.rsplit("@", 1)[-1] or ("@" not in tok and "x" in tok):
-            tok, _, c = tok.rpartition("x")
-            count = int(c)
+        # only a trailing "xN" with digit N is a count — fault KINDS may
+        # themselves contain "x" (slow_exec, exec_error)
+        base, _, c = tok.rpartition("x")
+        if base and c.isdigit():
+            tok, count = base, int(c)
         kind, _, step = tok.partition("@")
         _FAULTS.append(_Fault(kind, at_step=step or None, count=count))
 
@@ -151,6 +163,31 @@ def maybe_hang(step: Optional[int] = None):
     print("chaos: rank hanging for %.1fs at step %s" % (seconds, step),
           flush=True)
     time.sleep(seconds)
+
+
+def maybe_slow_exec(step: Optional[int] = None):
+    """Sleep inside the serving executor call if a ``slow_exec`` fault
+    fires now — the straggling-accelerator drill.  The sleep happens
+    INSIDE the watchdog-armed dispatch region (serving/runtime.py), so
+    the drill proves deadline accounting + forensics on the real path."""
+    params = fire("slow_exec", step)
+    if params is None:
+        return
+    import time
+    seconds = float(params.get(
+        "seconds",
+        os.environ.get("MXNET_TPU_CHAOS_SLOW_EXEC_SECONDS", "0.5")))
+    time.sleep(seconds)
+
+
+def maybe_exec_error(step: Optional[int] = None):
+    """Raise RuntimeError from the serving executor call if an
+    ``exec_error`` fault fires now (inside the retried execute callable,
+    so retry/backoff absorbs transient firings and the circuit breaker
+    sees only post-retry failures)."""
+    if fire("exec_error", step) is not None:
+        raise RuntimeError(
+            "chaos: injected executor failure at batch %s" % step)
 
 
 def maybe_io_error(desc: str = ""):

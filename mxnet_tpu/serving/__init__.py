@@ -1,0 +1,50 @@
+"""Resilient serving runtime over AOT deploy artifacts (deploy.py).
+
+The training half of the resilience story (checkpoint/watchdog/chaos,
+``mxnet_tpu/resilience/``) hardened PRs 1-3; this package is the
+inference half — the runtime *around* the compiled program that
+production serving actually lives or dies on (cf. "TensorFlow: a system
+for large-scale ML", arXiv:1605.08695: the serving viability comes from
+the runtime, not the graph):
+
+* ``admission`` — bounded queue + backpressure: priority-aware load
+  shedding with a typed :class:`errors.Overloaded` instead of unbounded
+  queueing.
+* ``batcher``   — deadline-aware dynamic batching into the executable's
+  fixed ``fwd(params, inputs)`` batch shape; expired requests are
+  dropped before device dispatch.
+* ``breaker``   — circuit breaker driving ``SERVING → DEGRADED →
+  BROKEN`` health, shedding instantly while broken, probing after a
+  cooldown.
+* ``runtime``   — :class:`ServingRuntime`: the worker loop wiring those
+  to watchdog-armed dispatch, retry/backoff, hot model-swap with canary
+  validation + rollback, and live stats (tools/servebench.py).
+
+Quick start::
+
+    from mxnet_tpu.serving import ServingRuntime
+    with ServingRuntime("model.mxt") as rt:
+        out = rt.predict(data=example)            # sync, default deadline
+        req = rt.submit(data=example, priority=2, deadline=0.05)
+        out = req.result()                        # typed errors on shed
+
+The C ABI reaches the same runtime through ``MXPredCreateFromServed`` +
+``MXPredSetDeadline`` / ``MXPredGetHealth`` / ``MXPredSwapServed``
+(capi.py), with errors flattened to ``MXGetLastError`` text keeping the
+``TypeName:`` prefix.
+"""
+from .admission import AdmissionQueue
+from .batcher import collect_batch, normalize_inputs, pack, unpack
+from .breaker import BROKEN, DEGRADED, HEALTH_NAMES, SERVING, CircuitBreaker
+from .errors import (CircuitOpen, DeadlineExceeded, ExecFailed, Overloaded,
+                     ServingError, SwapFailed, TopologyMismatch)
+from .request import Request
+from .runtime import ServingRuntime
+
+__all__ = [
+    "ServingRuntime", "Request", "AdmissionQueue", "CircuitBreaker",
+    "SERVING", "DEGRADED", "BROKEN", "HEALTH_NAMES",
+    "ServingError", "Overloaded", "DeadlineExceeded", "CircuitOpen",
+    "ExecFailed", "SwapFailed", "TopologyMismatch",
+    "normalize_inputs", "collect_batch", "pack", "unpack",
+]

@@ -1,0 +1,438 @@
+"""ServingRuntime: resilient request serving over a ServedProgram.
+
+One worker thread owns the device: it pulls admitted requests from the
+bounded :class:`admission.AdmissionQueue`, packs them into the
+executable's fixed batch shape (:mod:`batcher`), and dispatches with
+
+* the dispatch armed on the :mod:`resilience.watchdog` deadline
+  machinery — a wedged executor produces an all-thread stack dump and a
+  JSON post-mortem (same format as training hangs) instead of a silent
+  stall;
+* :func:`resilience.retry.call_with_retry` absorbing transient executor
+  errors, bounded by the batch's deadline margin;
+* the :class:`breaker.CircuitBreaker` turning post-retry failures into
+  health transitions ``SERVING → DEGRADED → BROKEN`` and instant
+  :class:`errors.CircuitOpen` shedding while broken.
+
+Hot model-swap (:meth:`ServingRuntime.swap`) loads a new artifact
+through the CRC-validated container path, warm-runs it on a canary
+batch OFF the serving path, and only then flips the program pointer
+under the model lock — so a bad artifact (``bad_swap`` chaos, corrupt
+file, schema drift, non-finite canary outputs) is rejected with
+:class:`errors.SwapFailed` and costs zero live requests.  The previous
+program is retained for explicit :meth:`ServingRuntime.rollback`.
+
+Env knobs (all ``MXNET_TPU_SERVE_*``, documented in docs/deploy.md;
+constructor arguments win over the environment):
+
+=====================================  ==================================
+``MXNET_TPU_SERVE_QUEUE_DEPTH``        admission queue bound (64)
+``MXNET_TPU_SERVE_MAX_BATCH``          rows per dispatch, capped at the
+                                       artifact batch dim (artifact B)
+``MXNET_TPU_SERVE_LINGER``             max batch-fill wait, seconds (0.002)
+``MXNET_TPU_SERVE_DEFAULT_DEADLINE``   per-request deadline when the
+                                       caller gives none, seconds (30);
+                                       <= 0 disables
+``MXNET_TPU_SERVE_DEADLINE_MARGIN``    static slack subtracted from the
+                                       earliest deadline when closing a
+                                       batch, on top of the observed
+                                       exec-time EWMA (0.005)
+``MXNET_TPU_SERVE_BREAKER_THRESHOLD``  consecutive failures to open (3)
+``MXNET_TPU_SERVE_BREAKER_COOLDOWN``   open -> probe seconds (5)
+``MXNET_TPU_SERVE_RETRY_MAX``          executor attempts per batch (2)
+``MXNET_TPU_SERVE_RETRY_BACKOFF``      first retry sleep, seconds (0.01)
+``MXNET_TPU_SERVE_EXEC_TIMEOUT``       watchdog wedge deadline per
+                                       dispatch, seconds (60; 0 disables)
+                                       — deliberately independent of
+                                       request deadlines: a deadline
+                                       miss is routine overload, only a
+                                       STUCK executor makes forensics
+``MXNET_TPU_SERVE_WATCHDOG_ACTION``    ``wait`` (default: post-mortem,
+                                       keep serving — the breaker and
+                                       deadlines shield callers) or
+                                       ``abort`` (fail-fast restart)
+=====================================  ==================================
+"""
+from __future__ import annotations
+
+import collections
+import contextlib
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..resilience import chaos, watchdog as _watchdog
+from ..resilience.retry import call_with_retry
+from ..resilience.watchdog import Watchdog
+from . import batcher
+from .admission import AdmissionQueue
+from .breaker import HEALTH_NAMES, CircuitBreaker
+from .errors import (CircuitOpen, DeadlineExceeded, ExecFailed, ServingError,
+                     SwapFailed)
+from .request import Request
+
+__all__ = ["ServingRuntime"]
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ[name])
+    except (KeyError, ValueError):
+        return default
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ[name])
+    except (KeyError, ValueError):
+        return default
+
+
+class ServingRuntime:
+    """Resilient serving loop over one model (see module docstring).
+
+    ``program`` is a :class:`deploy.ServedProgram`, a path to a served
+    artifact, or any program-like object exposing ``input_names``,
+    ``input_shapes`` (leading dim = batch), ``input_dtypes`` and
+    ``forward(**inputs) -> [outputs]`` (tools/servebench.py uses a
+    synthetic one to load-test the runtime without a device).
+    """
+
+    def __init__(self, program, *, queue_depth=None, max_batch_rows=None,
+                 linger=None, default_deadline=None, deadline_margin=None,
+                 breaker_threshold=None, breaker_cooldown=None,
+                 retry_tries=None, retry_backoff=None, exec_timeout=None,
+                 watchdog_action=None, report_dir=None, name="serving"):
+        self._program = self._load_program(program)
+        self._previous = None
+        self._name = name
+        self._batch_dim = int(
+            self._program.input_shapes[self._program.input_names[0]][0])
+
+        depth = (queue_depth if queue_depth is not None
+                 else _env_int("MXNET_TPU_SERVE_QUEUE_DEPTH", 64))
+        rows = (max_batch_rows if max_batch_rows is not None
+                else _env_int("MXNET_TPU_SERVE_MAX_BATCH", self._batch_dim))
+        self._max_rows = max(1, min(int(rows), self._batch_dim))
+        self._linger = (linger if linger is not None
+                        else _env_float("MXNET_TPU_SERVE_LINGER", 0.002))
+        dl = (default_deadline if default_deadline is not None
+              else _env_float("MXNET_TPU_SERVE_DEFAULT_DEADLINE", 30.0))
+        self._default_deadline = dl if dl and dl > 0 else None
+        self._margin = (deadline_margin if deadline_margin is not None
+                        else _env_float("MXNET_TPU_SERVE_DEADLINE_MARGIN",
+                                        0.005))
+        self._retry_tries = (retry_tries if retry_tries is not None
+                             else _env_int("MXNET_TPU_SERVE_RETRY_MAX", 2))
+        self._retry_backoff = (
+            retry_backoff if retry_backoff is not None
+            else _env_float("MXNET_TPU_SERVE_RETRY_BACKOFF", 0.01))
+        # wedge detection is a separate budget from request deadlines: a
+        # deadline miss is routine overload (typed error, no forensics);
+        # only an executor stuck PAST this is worth a stack dump.  0
+        # disables arming.
+        self._exec_timeout = (
+            exec_timeout if exec_timeout is not None
+            else _env_float("MXNET_TPU_SERVE_EXEC_TIMEOUT", 60.0)) or None
+        self._wd_action = (watchdog_action or
+                           os.environ.get("MXNET_TPU_SERVE_WATCHDOG_ACTION",
+                                          "wait"))
+        self._report_dir = report_dir
+
+        self._queue = AdmissionQueue(depth)
+        self._breaker = CircuitBreaker(
+            threshold=(breaker_threshold if breaker_threshold is not None
+                       else _env_int("MXNET_TPU_SERVE_BREAKER_THRESHOLD", 3)),
+            cooldown=(breaker_cooldown if breaker_cooldown is not None
+                      else _env_float("MXNET_TPU_SERVE_BREAKER_COOLDOWN",
+                                      5.0)))
+
+        self._lock = threading.Lock()          # counters + model pointer
+        self._swap_lock = threading.Lock()     # serializes swap/rollback
+        self._counters = collections.Counter()
+        self._latencies = collections.deque(maxlen=2048)
+        self._exec_ewma = 0.0
+        self._seq = 0
+        self._batch_seq = 0
+        self._wd: Optional[Watchdog] = None
+        self._stop = False
+        self._worker = threading.Thread(target=self._run,
+                                        name="mxt-serving", daemon=True)
+        self._worker.start()
+
+    # ------------------------------------------------------------------
+    # model loading / swap / rollback
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _load_program(source):
+        if hasattr(source, "forward") and hasattr(source, "input_names"):
+            return source
+        from ..deploy import ServedProgram
+        return ServedProgram.load(os.fspath(source))
+
+    def _schema_mismatch(self, new) -> Optional[str]:
+        cur = self._program
+        if list(new.input_names) != list(cur.input_names):
+            return ("input names %s != %s"
+                    % (list(new.input_names), list(cur.input_names)))
+        for n in cur.input_names:
+            if tuple(new.input_shapes[n]) != tuple(cur.input_shapes[n]):
+                return ("input %r shape %s != %s"
+                        % (n, tuple(new.input_shapes[n]),
+                           tuple(cur.input_shapes[n])))
+            if np.dtype(new.input_dtypes[n]) != np.dtype(cur.input_dtypes[n]):
+                return ("input %r dtype %s != %s"
+                        % (n, new.input_dtypes[n], cur.input_dtypes[n]))
+        return None
+
+    def swap(self, source, canary_inputs: Optional[Dict] = None):
+        """Hot-swap to a new model: load (CRC + topology validated by the
+        container path), schema-check, warm-run a canary batch OFF the
+        serving path, then atomically flip the program pointer.  Any
+        validation failure raises :class:`SwapFailed` and the previous
+        model keeps serving — no live request ever sees the rejected
+        artifact.  Returns the installed program."""
+        with self._swap_lock:
+            try:
+                new = self._load_program(source)
+            except Exception as e:
+                with self._lock:
+                    self._counters["swap_failures"] += 1
+                raise SwapFailed("could not load %r: %s" % (source, e))
+            mismatch = self._schema_mismatch(new)
+            if mismatch:
+                with self._lock:
+                    self._counters["swap_failures"] += 1
+                raise SwapFailed("schema mismatch: %s" % mismatch)
+            canary = canary_inputs or {
+                n: np.zeros(tuple(new.input_shapes[n]), new.input_dtypes[n])
+                for n in new.input_names}
+            try:
+                outs = [np.asarray(o) for o in new.forward(**canary)]
+            except Exception as e:
+                with self._lock:
+                    self._counters["swap_failures"] += 1
+                raise SwapFailed("canary run raised: %r" % e)
+            if chaos.fire("bad_swap") is not None:
+                # simulate a poisoned artifact: the canary "computes" NaN
+                outs = [np.full_like(o, np.nan)
+                        if np.issubdtype(o.dtype, np.floating) else o
+                        for o in outs]
+            bad = [i for i, o in enumerate(outs)
+                   if np.issubdtype(o.dtype, np.floating)
+                   and not np.isfinite(o).all()]
+            if bad:
+                with self._lock:
+                    self._counters["swap_failures"] += 1
+                raise SwapFailed(
+                    "canary produced non-finite outputs at indices %s; "
+                    "previous model keeps serving" % bad)
+            with self._lock:
+                self._previous = self._program
+                self._program = new
+                self._counters["swaps"] += 1
+            return new
+
+    def rollback(self):
+        """Re-install the program that :meth:`swap` replaced."""
+        with self._swap_lock, self._lock:
+            if self._previous is None:
+                raise SwapFailed("no previous model to roll back to")
+            self._program, self._previous = self._previous, self._program
+            self._counters["rollbacks"] += 1
+            return self._program
+
+    # ------------------------------------------------------------------
+    # client surface
+    # ------------------------------------------------------------------
+    def submit(self, inputs: Optional[Dict] = None, *, priority: int = 0,
+               deadline: Optional[float] = None, **kw_inputs) -> Request:
+        """Admit one request (1..B rows per input); returns its
+        :class:`Request` future.  ``deadline`` is RELATIVE seconds from
+        now (None: the runtime default; <= 0: no deadline).  Raises
+        :class:`CircuitOpen` / :class:`Overloaded` when shedding."""
+        if self._stop:
+            raise ServingError("runtime is closed")
+        feed = dict(inputs or {})
+        feed.update(kw_inputs)
+        prog = self._program
+        arrays, rows = batcher.normalize_inputs(
+            feed, prog.input_names, prog.input_shapes, prog.input_dtypes,
+            self._max_rows)
+        with self._lock:
+            self._counters["submitted"] += 1
+            self._seq += 1
+            seq = self._seq
+        if not self._breaker.admit_ok():
+            with self._lock:
+                self._counters["shed_circuit"] += 1
+            raise CircuitOpen(
+                "circuit open after repeated executor failures; "
+                "shedding until the %.1fs cooldown probe succeeds"
+                % self._breaker.cooldown)
+        rel = self._default_deadline if deadline is None else deadline
+        abs_deadline = (time.monotonic() + rel
+                        if rel is not None and rel > 0 else None)
+        req = Request(arrays, rows, priority=priority,
+                      deadline=abs_deadline, seq=seq)
+        self._queue.offer(req)       # Overloaded propagates to the caller
+        with self._lock:
+            self._counters["admitted"] += 1
+        return req
+
+    def predict(self, inputs: Optional[Dict] = None, *, priority: int = 0,
+                deadline: Optional[float] = None,
+                **kw_inputs) -> List[np.ndarray]:
+        """Synchronous submit + wait; returns the request's output rows."""
+        req = self.submit(inputs, priority=priority, deadline=deadline,
+                          **kw_inputs)
+        # the request's own deadline machinery produces the typed error;
+        # the extra slack only guards against a dead worker
+        wait = None if req.deadline is None else req.remaining() + 5.0
+        return req.result(timeout=wait)
+
+    def health(self) -> int:
+        return self._breaker.health()
+
+    def health_name(self) -> str:
+        return HEALTH_NAMES[self._breaker.health()]
+
+    def stats(self) -> dict:
+        with self._lock:
+            counters = dict(self._counters)
+            lat = list(self._latencies)
+            ewma = self._exec_ewma
+        counters.setdefault("completed", 0)
+        out = {
+            "health": self.health_name(),
+            "queue_depth": len(self._queue),
+            "queue_bound": self._queue.depth,
+            "max_batch_rows": self._max_rows,
+            "shed_overload": self._queue.shed_overload,
+            "shed_expired": self._queue.shed_expired,
+            "exec_time_ewma_s": round(ewma, 6),
+            "breaker": self._breaker.describe(),
+            "counters": counters,
+        }
+        if lat:
+            lat.sort()
+
+            def pct(p):
+                return round(lat[min(len(lat) - 1,
+                                     int(p * (len(lat) - 1)))], 6)
+
+            out["latency_s"] = {"p50": pct(0.50), "p95": pct(0.95),
+                                "p99": pct(0.99), "max": lat[-1]}
+        return out
+
+    def close(self):
+        """Stop the worker; fail everything still queued (typed)."""
+        self._stop = True
+        for req in self._queue.drain():
+            req._fail(ServingError("runtime closed before dispatch"))
+        self._worker.join(timeout=5.0)
+        if self._wd is not None:
+            self._wd.stop()
+            self._wd = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # ------------------------------------------------------------------
+    # worker
+    # ------------------------------------------------------------------
+    def _close_margin(self) -> float:
+        """Slack to keep between batch close and the earliest deadline:
+        the static knob plus the observed execution-time EWMA."""
+        with self._lock:
+            return self._margin + self._exec_ewma
+
+    def _run(self):
+        while not self._stop:
+            req = self._queue.pop_live(timeout=0.05)
+            if req is None:
+                continue
+            if not self._breaker.dispatch_ok():
+                # open circuit: hold the line (bounded — the queue keeps
+                # expiring stale requests), probe after cooldown
+                self._queue.push_front(req)
+                time.sleep(0.02)
+                continue
+            batch = batcher.collect_batch(
+                self._queue, req, self._max_rows, self._linger,
+                self._close_margin)
+            self._dispatch(batch)
+
+    def _ensure_watchdog(self) -> Watchdog:
+        if self._wd is None:
+            self._wd = Watchdog(
+                step_timeout=self._exec_timeout or _watchdog.DEFAULT_STEP_TIMEOUT,
+                action=self._wd_action, report_dir=self._report_dir,
+                poll=0.05)
+        return self._wd
+
+    def _exec_once(self, prog, packed, seq):
+        chaos.maybe_exec_error(seq)
+        chaos.maybe_slow_exec(seq)
+        return [np.asarray(o) for o in prog.forward(**packed)]
+
+    def _dispatch(self, batch: List[Request]):
+        with self._lock:
+            self._batch_seq += 1
+            seq = self._batch_seq
+            prog = self._program
+        packed = batcher.pack(batch, prog.input_names, prog.input_shapes,
+                              prog.input_dtypes)
+        deadlines = [r.remaining() for r in batch if r.deadline is not None]
+        margin = min(deadlines) if deadlines else None
+        wd_timeout = self._exec_timeout
+        retry_budget = max(0.05, margin) if margin is not None else None
+        t0 = time.monotonic()
+        armed = (contextlib.nullcontext() if wd_timeout is None else
+                 self._ensure_watchdog().watch(
+                     "%s.execute" % self._name, kind="step", step=seq,
+                     timeout=wd_timeout))
+        try:
+            with armed:
+                outs = call_with_retry(
+                    self._exec_once, prog, packed, seq,
+                    exceptions=(RuntimeError, OSError),
+                    max_tries=self._retry_tries,
+                    backoff=self._retry_backoff, timeout=retry_budget,
+                    desc="%s.execute" % self._name)
+        except Exception as e:
+            self._breaker.record_failure()
+            with self._lock:
+                self._counters["exec_failures"] += 1
+            err = ExecFailed("executor failed after %d attempt(s): %r"
+                             % (self._retry_tries, e))
+            for r in batch:
+                if r.expired():
+                    r._fail(DeadlineExceeded(
+                        "deadline passed while the executor was failing"))
+                else:
+                    r._fail(err)
+            return
+        exec_time = time.monotonic() - t0
+        self._breaker.record_success()
+        per_request = batcher.unpack(outs, batch, self._batch_dim)
+        delivered = 0
+        for r, r_outs in zip(batch, per_request):
+            if r._deliver(r_outs):      # late delivery -> DeadlineExceeded
+                delivered += 1
+        with self._lock:
+            self._exec_ewma = (exec_time if self._exec_ewma == 0.0
+                               else 0.8 * self._exec_ewma + 0.2 * exec_time)
+            self._counters["batches"] += 1
+            self._counters["rows"] += sum(r.rows for r in batch)
+            self._counters["completed"] += delivered
+            for r in batch:
+                if r.latency is not None and r._error is None:
+                    self._latencies.append(r.latency)

@@ -1,0 +1,216 @@
+"""End-to-end chaos serving drill — run as a SUBPROCESS by
+tests/test_serving.py::test_chaos_serving_drill.
+
+The parent arms faults through the environment (the production drill
+path, not the in-code context manager):
+
+    MXNET_TPU_CHAOS="exec_errorx4,slow_execx6,bad_swap"
+    MXNET_TPU_CHAOS_SLOW_EXEC_SECONDS=<small>
+
+and this script drives a real exported ServedProgram through the
+serving runtime, asserting with live traffic that
+
+  1. repeated executor failures open the circuit breaker (health
+     BROKEN, instant typed CircuitOpen shedding) and a post-cooldown
+     probe closes it again;
+  2. a saturating load sheds with typed Overloaded and the queue never
+     grows past its bound;
+  3. no request is ever reported OK past its deadline;
+  4. an env-armed bad_swap hot-swap is rejected (typed SwapFailed) with
+     ZERO failed requests attributable to the swap, and the follow-up
+     clean swap actually changes the served model.
+
+It prints one "DRILL_VERDICT {json}" line, then wedges the executor
+under a watchdog armed with action=abort: the watchdog must dump a
+post-mortem and KILL this process with exit code 43, which the parent
+verifies (the kill-and-verify step).
+
+Usage: python tests/serving_drill.py <workdir>
+"""
+import json
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np           # noqa: E402
+
+import mxnet_tpu as mx       # noqa: E402
+from mxnet_tpu.resilience import chaos                    # noqa: E402
+from mxnet_tpu.serving import (CircuitOpen, Overloaded,   # noqa: E402
+                               ServingRuntime, SwapFailed)
+
+DEADLINE = 0.25
+
+
+def export_artifact(path, seed):
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, num_hidden=8, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=5, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    ex = net.simple_bind(mx.cpu(), data=(4, 3))
+    rs = np.random.RandomState(seed)
+    for a in ex.arg_arrays:
+        a[:] = mx.nd.array(rs.normal(0, 0.5, a.shape))
+    ex.export_compiled(path, input_names=("data",))
+    return path
+
+
+def main():
+    workdir = sys.argv[1]
+    verdict = {}
+    art_a = export_artifact(os.path.join(workdir, "model_a.mxt"), seed=0)
+    art_b = export_artifact(os.path.join(workdir, "model_b.mxt"), seed=1)
+
+    full = np.linspace(-1, 1, 12, dtype=np.float32).reshape(4, 3)
+    # depth 16: small enough that a 60-request flood sheds (Overloaded),
+    # large enough that admitted requests sit behind slow_exec batches
+    # long enough to expire (DeadlineExceeded before dispatch)
+    rt = ServingRuntime(
+        art_a, queue_depth=16, linger=0.005, default_deadline=DEADLINE,
+        retry_tries=2, retry_backoff=0.005, breaker_threshold=2,
+        breaker_cooldown=0.4, report_dir=workdir)
+
+    # warm-up on the program directly (not through the runtime): pays the
+    # lazy device work without consuming any armed chaos firings, so
+    # phase 1 starts from the exact env-armed fault counts
+    rt._program.forward(data=full)
+
+    # -- phase 1: circuit breaker opens on consecutive executor failures
+    exec_failures = 0
+    for _ in range(2):           # 2 batches x 2 retry attempts = 4 firings
+        try:
+            rt.predict(data=full, deadline=2.0)
+        except Exception:
+            exec_failures += 1
+    verdict["exec_failures"] = exec_failures
+    verdict["health_after_failures"] = rt.health_name()
+    try:
+        rt.submit(data=full, deadline=2.0)
+        verdict["circuit_shed_typed"] = False
+    except CircuitOpen:
+        verdict["circuit_shed_typed"] = True
+    time.sleep(rt._breaker.cooldown + 0.1)
+    try:
+        rt.predict(data=full, deadline=2.0)     # probe (slow_exec but ok)
+        verdict["probe_ok"] = True
+    except Exception as e:
+        verdict["probe_ok"] = False
+        verdict["probe_error"] = repr(e)
+    verdict["health_after_probe"] = rt.health_name()
+
+    # -- phase 2: saturating load -> bounded queue, typed shedding, no
+    #    late OK (slow_exec still has firings left; after those the tiny
+    #    model is fast, so the flood sees both regimes)
+    outcomes = {"ok": 0, "Overloaded": 0, "DeadlineExceeded": 0,
+                "other": 0}
+    late_ok = 0
+    depth_max = [0]
+    stop = [False]
+
+    def sampler():
+        while not stop[0]:
+            depth_max[0] = max(depth_max[0], len(rt._queue))
+            time.sleep(0.002)
+
+    samp = threading.Thread(target=sampler, daemon=True)
+    samp.start()
+    lock = threading.Lock()
+    late_counter = [0]
+
+    def flood():
+        # open loop: submit everything up front (saturation), collect
+        # afterwards — shed happens at submit, deadlines at collect
+        row = np.ones((3,), np.float32)
+        admitted = []
+        for _ in range(15):
+            try:
+                admitted.append(rt.submit(data=row, deadline=DEADLINE))
+            except Exception as e:
+                with lock:
+                    outcomes[type(e).__name__] = \
+                        outcomes.get(type(e).__name__, 0) + 1
+        for req in admitted:
+            try:
+                req.result(timeout=DEADLINE + 5)
+                with lock:
+                    outcomes["ok"] += 1
+                    if req.latency > DEADLINE:
+                        late_counter[0] += 1
+            except Exception as e:
+                with lock:
+                    outcomes[type(e).__name__] = \
+                        outcomes.get(type(e).__name__, 0) + 1
+
+    threads = [threading.Thread(target=flood) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop[0] = True
+    samp.join(timeout=1)
+    late_ok = late_counter[0]
+    verdict["flood_outcomes"] = outcomes
+    verdict["late_ok"] = late_ok
+    verdict["queue_depth_max"] = depth_max[0]
+    verdict["queue_bound"] = rt._queue.depth
+
+    # -- phase 3: bad_swap rejected with zero request impact, then a
+    #    clean swap changes the model
+    before = rt.predict(data=full, deadline=2.0)[0]
+    bg_failures = [0]
+    bg_stop = [False]
+
+    def background():
+        while not bg_stop[0]:
+            try:
+                rt.predict(data=full, deadline=2.0)
+            except Exception:
+                bg_failures[0] += 1
+
+    bg = threading.Thread(target=background, daemon=True)
+    bg.start()
+    try:
+        rt.swap(art_b)               # env-armed bad_swap poisons canary
+        verdict["bad_swap_typed"] = False
+    except SwapFailed:
+        verdict["bad_swap_typed"] = True
+    after_bad = rt.predict(data=full, deadline=2.0)[0]
+    try:
+        rt.swap(art_b)               # fault consumed: clean swap
+        swap_ok = True
+    except Exception:
+        swap_ok = False
+    after_good = rt.predict(data=full, deadline=2.0)[0]
+    bg_stop[0] = True
+    bg.join(timeout=5)
+    verdict["swap_ok"] = swap_ok
+    verdict["bg_failures_during_swaps"] = bg_failures[0]
+    verdict["unchanged_after_bad_swap"] = bool(
+        np.allclose(before, after_bad, atol=1e-6))
+    verdict["changed_after_good_swap"] = bool(
+        not np.allclose(before, after_good, atol=1e-4))
+    stats = rt.stats()
+    verdict["breaker_opened_total"] = stats["breaker"]["opened_total"]
+    verdict["breaker_recovered_total"] = stats["breaker"]["recovered_total"]
+    rt.close()
+
+    print("DRILL_VERDICT " + json.dumps(verdict), flush=True)
+
+    # -- phase 4 (kill-and-verify): wedge the executor under an
+    #    abort-mode watchdog; it must write forensics and _exit(43)
+    rt2 = ServingRuntime(art_a, default_deadline=5.0, retry_tries=1,
+                         exec_timeout=0.15, watchdog_action="abort",
+                         report_dir=workdir, name="drill-wedge")
+    with chaos.inject("slow_exec", seconds=60):
+        rt2.submit(data=full, deadline=5.0)
+        time.sleep(30)               # the watchdog kills us first
+    sys.exit(7)                      # unreachable if the watchdog works
+
+
+if __name__ == "__main__":
+    main()

@@ -343,6 +343,58 @@ def test_c_predict_aot_served(capi_lib, tmp_path):
     assert "PREDICT AOT OK" in r.stdout
 
 
+def test_c_served_serving_error_propagation(capi_lib, tmp_path):
+    """Serving errors (deadline, swap failure, corrupt artifact) must
+    cross the embedded-interpreter boundary as error-return -1 + typed
+    text in MXGetLastError — never as an unwinding Python exception.
+    Uses the in-process (hosted interpreter) tier so export and load
+    share one jax backend/topology."""
+    lib = capi_lib
+    import mxnet_tpu as mx
+
+    # corrupt artifact: typed refusal, not a crash
+    evil = str(tmp_path / "evil.mxt").encode()
+    import pickle
+    with open(evil, "wb") as f:
+        pickle.dump({"innocent": "model"}, f)
+    h = ctypes.c_void_p()
+    assert lib.MXPredCreateFromServed(evil, ctypes.byref(h)) == -1
+    assert b"pickle" in lib.MXGetLastError()
+
+    artifact = str(tmp_path / "model.mxt")
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, num_hidden=5, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    ex = net.simple_bind(mx.cpu(), data=(4, 3))
+    rs = np.random.RandomState(0)
+    for a in ex.arg_arrays:
+        a[:] = mx.nd.array(rs.normal(0, 0.3, a.shape))
+    ex.export_compiled(artifact, input_names=("data",))
+
+    _check(lib, lib.MXPredCreateFromServed(artifact.encode(),
+                                           ctypes.byref(h)))
+    health = ctypes.c_int(-1)
+    _check(lib, lib.MXPredGetHealth(h, ctypes.byref(health)))
+    assert health.value == 0            # SERVING
+
+    batch = np.zeros(12, np.float32)
+    _check(lib, lib.MXPredSetInput(h, b"data",
+                                   batch.ctypes.data_as(ctypes.c_void_p),
+                                   12))
+    lib.MXPredSetDeadline.argtypes = [ctypes.c_void_p, ctypes.c_double]
+    _check(lib, lib.MXPredSetDeadline(h, ctypes.c_double(1e-6)))
+    assert lib.MXPredForward(h) == -1
+    assert b"DeadlineExceeded" in lib.MXGetLastError()
+
+    _check(lib, lib.MXPredSetDeadline(h, ctypes.c_double(0.0)))
+    _check(lib, lib.MXPredForward(h))
+
+    assert lib.MXPredSwapServed(h, b"/nonexistent/model.mxt") == -1
+    assert b"SwapFailed" in lib.MXGetLastError()
+    _check(lib, lib.MXPredForward(h))   # previous model keeps serving
+    _check(lib, lib.MXPredFree(h))
+
+
 def test_c_autograd_and_cachedop(capi_lib):
     """MXAutograd* + MXCreateCachedOp/MXInvokeCachedOp over ctypes."""
     lib = capi_lib

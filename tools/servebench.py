@@ -1,0 +1,261 @@
+#!/usr/bin/env python3
+"""Load generator for the resilient serving runtime (mxnet_tpu/serving).
+
+Drives a ServingRuntime — over a real AOT artifact or a synthetic
+executor — in closed-loop (N workers, one in-flight request each) or
+open-loop (fixed arrival rate, so overload and shedding are visible)
+mode, and prints what a serving operator watches: latency percentiles,
+shed rate by cause, queue depth, batch fill, and final health.
+
+Usage:
+    python tools/servebench.py [--artifact model.mxt] [options]
+
+    --artifact PATH    serve a real exported artifact (default: a
+                       synthetic executor — no device, no tracing — so
+                       the runtime itself is what gets measured)
+    --exec-latency S   synthetic executor time per batch (default 0.002)
+    --batch N --features N   synthetic model shape (default 8 x 16)
+    --mode closed|open       load shape (default closed)
+    --concurrency N    closed-loop workers (default 8)
+    --rate R           open-loop arrivals/sec (default 500)
+    --duration S       wall-clock run time (default 2.0)
+    --deadline S       per-request deadline (default 0.25)
+    --priorities CSV   cycled per request, e.g. "0,0,0,2" (default "0")
+    --queue-depth N / --max-batch N / --linger S   runtime knobs
+    --json             emit ONE JSON document on stdout (for CI smoke)
+
+The measurement loop is stdlib-only (threading/time/statistics); chaos
+faults armed via MXNET_TPU_CHAOS (slow_exec/exec_error) apply to the
+dispatch path as in production, making this the serving drill driver.
+"""
+import argparse
+import json
+import os
+import statistics
+import sys
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+class SyntheticProgram:
+    """Program-like stand-in: fixed batch shape, configurable latency,
+    identity-ish math — measures the runtime, not a device."""
+
+    def __init__(self, batch, features, latency):
+        import numpy as np
+        self.input_names = ["data"]
+        self.input_shapes = {"data": (batch, features)}
+        self.input_dtypes = {"data": np.dtype(np.float32)}
+        self.output_shapes = [(batch, features)]
+        self.latency = latency
+        self._np = np
+
+    def forward(self, data):
+        if self.latency:
+            time.sleep(self.latency)
+        return [self._np.tanh(data)]
+
+
+def _percentiles(latencies):
+    if not latencies:
+        return {}
+    xs = sorted(latencies)
+
+    def pct(p):
+        return xs[min(len(xs) - 1, int(p * (len(xs) - 1)))]
+
+    return {"p50_ms": round(pct(0.50) * 1e3, 3),
+            "p95_ms": round(pct(0.95) * 1e3, 3),
+            "p99_ms": round(pct(0.99) * 1e3, 3),
+            "max_ms": round(xs[-1] * 1e3, 3),
+            "mean_ms": round(statistics.fmean(xs) * 1e3, 3)}
+
+
+class Collector:
+    """Thread-safe outcome tally: ok latencies + typed-error counts."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.latencies = []
+        self.errors = {}
+        self.total = 0
+
+    def record_ok(self, latency):
+        with self._lock:
+            self.total += 1
+            self.latencies.append(latency)
+
+    def record_error(self, exc):
+        kind = type(exc).__name__
+        with self._lock:
+            self.total += 1
+            self.errors[kind] = self.errors.get(kind, 0) + 1
+
+
+def _example(prog):
+    """One example row (batch-dim stripped) for every model input."""
+    import numpy as np
+    return {n: np.zeros(tuple(prog.input_shapes[n][1:]),
+                        prog.input_dtypes[n]) for n in prog.input_names}
+
+
+def run_closed(rt, prog, args, collector, stop_at, priorities):
+    """Closed loop: each worker keeps exactly one request in flight."""
+    example = _example(prog)
+    counter = [0]
+    lock = threading.Lock()
+
+    def worker():
+        while time.monotonic() < stop_at:
+            with lock:
+                counter[0] += 1
+                prio = priorities[counter[0] % len(priorities)]
+            t0 = time.monotonic()
+            try:
+                req = rt.submit(dict(example), priority=prio,
+                                deadline=args.deadline)
+                req.result(timeout=args.deadline + 5.0)
+                collector.record_ok(time.monotonic() - t0)
+            except Exception as e:
+                collector.record_error(e)
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(args.concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=args.duration + 30.0)
+
+
+def run_open(rt, prog, args, collector, stop_at, priorities):
+    """Open loop: arrivals at a fixed rate regardless of completions —
+    the load shape that actually exposes shedding behavior."""
+    example = _example(prog)
+    interval = 1.0 / args.rate
+    pending = []
+    n = 0
+    next_at = time.monotonic()
+    while time.monotonic() < stop_at:
+        now = time.monotonic()
+        if now < next_at:
+            time.sleep(min(interval, next_at - now))
+            continue
+        next_at += interval
+        n += 1
+        t0 = time.monotonic()
+        try:
+            req = rt.submit(dict(example),
+                            priority=priorities[n % len(priorities)],
+                            deadline=args.deadline)
+            pending.append((t0, req))
+        except Exception as e:
+            collector.record_error(e)
+    for t0, req in pending:
+        try:
+            req.result(timeout=args.deadline + 5.0)
+            collector.record_ok(req.latency if req.latency is not None
+                                else time.monotonic() - t0)
+        except Exception as e:
+            collector.record_error(e)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--artifact")
+    ap.add_argument("--exec-latency", type=float, default=0.002)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--features", type=int, default=16)
+    ap.add_argument("--mode", choices=("closed", "open"), default="closed")
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--rate", type=float, default=500.0)
+    ap.add_argument("--duration", type=float, default=2.0)
+    ap.add_argument("--deadline", type=float, default=0.25)
+    ap.add_argument("--priorities", default="0")
+    ap.add_argument("--queue-depth", type=int, default=64)
+    ap.add_argument("--max-batch", type=int, default=None)
+    ap.add_argument("--linger", type=float, default=0.002)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    from mxnet_tpu.serving import ServingRuntime
+
+    if args.artifact:
+        prog = args.artifact
+    else:
+        prog = SyntheticProgram(args.batch, args.features, args.exec_latency)
+    priorities = [int(p) for p in args.priorities.split(",")]
+    rt = ServingRuntime(prog, queue_depth=args.queue_depth,
+                        max_batch_rows=args.max_batch, linger=args.linger,
+                        default_deadline=args.deadline, name="servebench")
+    prog = rt._program        # resolve artifact path -> loaded program
+
+    collector = Collector()
+    depth_samples = []
+    stop_at = time.monotonic() + args.duration
+    sampling = [True]
+
+    def sampler():
+        while sampling[0]:
+            depth_samples.append(len(rt._queue))
+            time.sleep(0.01)
+
+    s = threading.Thread(target=sampler, daemon=True)
+    s.start()
+    t_start = time.monotonic()
+    try:
+        if args.mode == "closed":
+            run_closed(rt, prog, args, collector, stop_at, priorities)
+        else:
+            run_open(rt, prog, args, collector, stop_at, priorities)
+    finally:
+        sampling[0] = False
+        s.join(timeout=1.0)
+        stats = rt.stats()
+        rt.close()
+    elapsed = time.monotonic() - t_start
+
+    shed = sum(v for k, v in collector.errors.items()
+               if k in ("Overloaded", "CircuitOpen"))
+    report = {
+        "mode": args.mode,
+        "duration_s": round(elapsed, 3),
+        "requests": collector.total,
+        "ok": len(collector.latencies),
+        "throughput_rps": round(len(collector.latencies) /
+                                max(elapsed, 1e-9), 1),
+        "errors": collector.errors,
+        "shed_rate": round(shed / max(collector.total, 1), 4),
+        "latency": _percentiles(collector.latencies),
+        "queue_depth_max": max(depth_samples) if depth_samples else 0,
+        "queue_depth_mean": round(statistics.fmean(depth_samples), 2)
+        if depth_samples else 0.0,
+        "runtime_stats": stats,
+    }
+    if args.json:
+        json.dump(report, sys.stdout, indent=2, sort_keys=True)
+        print()
+        return 0
+    print("servebench: %(mode)s loop, %(duration_s).2fs" % report)
+    print("  requests        %(requests)d (ok %(ok)d, %(throughput_rps).1f"
+          " ok/s)" % report)
+    print("  shed rate       %.1f%%  errors %s"
+          % (100 * report["shed_rate"], report["errors"] or "none"))
+    if report["latency"]:
+        print("  latency ms      p50 %(p50_ms)s  p95 %(p95_ms)s  "
+              "p99 %(p99_ms)s  max %(max_ms)s" % report["latency"])
+    print("  queue depth     max %d  mean %.2f  (bound %d)"
+          % (report["queue_depth_max"], report["queue_depth_mean"],
+             args.queue_depth))
+    print("  batches         %d (%.2f rows avg)  health %s"
+          % (stats["counters"].get("batches", 0),
+             stats["counters"].get("rows", 0) /
+             max(stats["counters"].get("batches", 1), 1),
+             stats["health"]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
