@@ -46,6 +46,14 @@ SL106   unarmed-collective-entry   warning   library function that builds a
                                              shard_map program but never
                                              arms the hang watchdog around
                                              its execution
+SL107   manual-timing-use-spans    info      host-side library function
+                                             hand-rolling start/stop
+                                             timing (``t0 = time.X(); ...
+                                             time.X() - t0``) instead of a
+                                             telemetry span — the
+                                             measurement is invisible to
+                                             the merged trace and the
+                                             metrics registry
 ======  =========================  ========  ===============================
 
 **Suppression syntax** (``docs/static-analysis.md``):
@@ -73,7 +81,17 @@ RULES = {
     "SL104": ("python-rng-in-jit", "error"),
     "SL105": ("tracer-leak-to-self", "warning"),
     "SL106": ("unarmed-collective-entry", "warning"),
+    "SL107": ("manual-timing-use-spans", "info"),
 }
+
+# bare wall/monotonic clock reads whose subtraction pattern marks a
+# hand-rolled timing measurement (SL107)
+_CLOCK_BARE = frozenset({"time.time", "time.monotonic",
+                         "time.perf_counter"})
+
+# the instrumentation layer itself legitimately reads clocks
+_SL107_EXEMPT_PARTS = ("telemetry",)
+_SL107_EXEMPT_FILES = ("profiler.py",)
 
 # combinators whose function-valued arguments get traced (matched on the
 # last dotted segment: jax.jit, functools.partial(jax.jit, ...), lax.scan)
@@ -418,6 +436,23 @@ def lint_source(source: str, filename: str = "<string>",
                             "return the value from the traced function "
                             "and store it on the host side")
 
+    if in_library and not _sl107_exempt(filename):
+        for fn in infos:
+            if fn.traced:
+                continue     # traced timing is SL102's (error) territory
+            lineno = _manual_timing_site(fn)
+            if lineno is None or sup.active("SL107", lineno, fn):
+                continue
+            rep.add("SL107", RULES["SL107"][1],
+                    "host function %r hand-rolls a start/stop timing "
+                    "measurement; it never reaches the merged trace or "
+                    "the metrics registry" % fn.name,
+                    location="%s:%d" % (filename, lineno),
+                    fix_hint="wrap the region in telemetry.span(name, "
+                             "metric=...) — one measurement feeds the "
+                             "trace, histograms, and post-mortems",
+                    extra={"function": fn.name})
+
     if in_library:
         for fn in infos:
             if fn.traced or not fn.builds_shard_map or fn.calls_watch:
@@ -434,6 +469,43 @@ def lint_source(source: str, filename: str = "<string>",
                              "parallel/ring.py does",
                     extra={"function": fn.name})
     return rep
+
+
+def _sl107_exempt(filename: str) -> bool:
+    parts = os.path.normpath(filename).split(os.sep)
+    return (any(p in _SL107_EXEMPT_PARTS for p in parts)
+            or (parts and parts[-1] in _SL107_EXEMPT_FILES))
+
+
+def _manual_timing_site(fn: _FnInfo) -> Optional[int]:
+    """Line of the first elapsed-time subtraction in ``fn``'s own body:
+    ``end_or_clockcall - var`` where ``var`` was assigned from a BARE
+    clock read in the same function.  Deadline arithmetic
+    (``time.monotonic() + budget``) never matches, because the stored
+    name is not a bare clock read."""
+    body = _own_body_nodes(fn.node)
+    timevars: Set[str] = set()
+    for node in body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call) \
+                and _dotted(node.value.func) in _CLOCK_BARE:
+            timevars.add(node.targets[0].id)
+    if not timevars:
+        return None
+
+    def time_sourced(expr):
+        if isinstance(expr, ast.Call) and _dotted(expr.func) in _CLOCK_BARE:
+            return True
+        return isinstance(expr, ast.Name) and expr.id in timevars
+
+    for node in body:
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub) \
+                and isinstance(node.right, ast.Name) \
+                and node.right.id in timevars \
+                and time_sourced(node.left):
+            return node.lineno
+    return None
 
 
 def lint_file(path: str, in_library: Optional[bool] = None) -> Report:

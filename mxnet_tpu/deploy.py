@@ -206,18 +206,25 @@ class ServedProgram:
 
     @classmethod
     def load(cls, path):
-        arrays, meta, blobs = read_container(path)
-        return cls(arrays, meta, blobs)
+        from . import telemetry
+        with telemetry.span("deploy/load", cat="deploy", path=str(path)):
+            arrays, meta, blobs = read_container(path)
+            prog = cls(arrays, meta, blobs)
+        telemetry.count("deploy.loads")
+        return prog
 
     def forward(self, **inputs):
         """Run the compiled program; returns a list of host numpy outputs."""
         import jax
-        vals = []
-        for n in self.input_names:
-            if n not in inputs:
-                raise MXNetError("missing input %r" % n)
-            host = np.asarray(inputs[n], self.input_dtypes[n]) \
-                .reshape(self.input_shapes[n])
-            vals.append(jax.device_put(host))
-        outs = self._compiled(self._params, tuple(vals))
-        return [np.asarray(o) for o in outs]
+        from . import telemetry
+        with telemetry.span("deploy/forward", cat="deploy",
+                            metric="deploy.forward_seconds"):
+            vals = []
+            for n in self.input_names:
+                if n not in inputs:
+                    raise MXNetError("missing input %r" % n)
+                host = np.asarray(inputs[n], self.input_dtypes[n]) \
+                    .reshape(self.input_shapes[n])
+                vals.append(jax.device_put(host))
+            outs = self._compiled(self._params, tuple(vals))
+            return [np.asarray(o) for o in outs]

@@ -205,6 +205,12 @@ class ImageRecordIter(DataIter):
         return img.asnumpy(), label
 
     def next(self):
+        from .. import telemetry
+        with telemetry.span("data/next", cat="io",
+                            metric="data.next_seconds"):
+            return self._next_batch()
+
+    def _next_batch(self):
         if self._native is not None:
             data, label, pad = self._native.next()   # raises StopIteration
             out_label = label[:, 0] if self.label_width == 1 else label

@@ -377,7 +377,10 @@ class KVStoreTPUDist(KVStore):
                 else:
                     from .parallel import allreduce_array
                     merged._handle = allreduce_array(merged._handle)
-            record_collective("all-reduce", "KVStoreTPUDist._reduce(%s)" % k)
+            record_collective("all-reduce", "KVStoreTPUDist._reduce(%s)" % k,
+                              bytes=int(getattr(
+                                  getattr(merged, "_handle", merged),
+                                  "nbytes", 0)))
         return merged
 
 

@@ -379,6 +379,7 @@ class Module(BaseModule):
     # -- the train step ----------------------------------------------------
 
     def forward(self, data_batch, is_train=None):
+        from .. import telemetry
         self._require(bound=True, params=True)
         bound = tuple(d.shape for d in self._data_shapes)
         if isinstance(data_batch, list):
@@ -387,7 +388,8 @@ class Module(BaseModule):
             incoming = tuple(a.shape for a in data_batch.data)
         if bound != incoming:
             self._rebind_for(data_batch, incoming)
-        self._exec_group.forward(data_batch, is_train)
+        with telemetry.span("module/forward", cat="module"):
+            self._exec_group.forward(data_batch, is_train)
 
     def _rebind_for(self, data_batch, incoming):
         """Shape change mid-stream (e.g. last partial batch): reshape the
@@ -406,36 +408,42 @@ class Module(BaseModule):
 
     def forward_backward(self, data_batch):
         """Fused fwd+bwd — one XLA computation per device."""
+        from .. import telemetry
         self._require(bound=True, params=True)
-        self._exec_group.forward_backward(data_batch)
+        with telemetry.span("module/forward_backward", cat="module"):
+            self._exec_group.forward_backward(data_batch)
 
     def backward(self, out_grads=None):
+        from .. import telemetry
         self._require(bound=True, params=True)
-        self._exec_group.backward(out_grads=out_grads)
+        with telemetry.span("module/backward", cat="module"):
+            self._exec_group.backward(out_grads=out_grads)
 
     def update(self):
         """Apply one optimizer step to every parameter (reference
         module.py:629).  With a grad_guard installed, a step whose
         gradients are non-finite applies NOTHING — params, optimizer
         state and kvstore all keep their previous values."""
+        from .. import telemetry
         self._require(bound=True, params=True, optimizer=True)
-        if self._grad_guard is not None:
-            grads = [g for glist in self._exec_group_grad_arrays()
-                     for g in glist if g is not None]
-            if not self._grad_guard.step(grads):
-                return
-        self._params_dirty = True
-        if self._update_on_kvstore:
-            _update_params_on_kvstore(self._exec_group_param_arrays(),
-                                      self._exec_group_grad_arrays(),
-                                      self._kvstore,
-                                      self._exec_group.param_names)
-        else:
-            _update_params(self._exec_group_param_arrays(),
-                           self._exec_group_grad_arrays(),
-                           updater=self._updater, kvstore=self._kvstore,
-                           num_device=len(self._context),
-                           param_names=self._exec_group.param_names)
+        with telemetry.span("module/update", cat="module"):
+            if self._grad_guard is not None:
+                grads = [g for glist in self._exec_group_grad_arrays()
+                         for g in glist if g is not None]
+                if not self._grad_guard.step(grads):
+                    return
+            self._params_dirty = True
+            if self._update_on_kvstore:
+                _update_params_on_kvstore(self._exec_group_param_arrays(),
+                                          self._exec_group_grad_arrays(),
+                                          self._kvstore,
+                                          self._exec_group.param_names)
+            else:
+                _update_params(self._exec_group_param_arrays(),
+                               self._exec_group_grad_arrays(),
+                               updater=self._updater, kvstore=self._kvstore,
+                               num_device=len(self._context),
+                               param_names=self._exec_group.param_names)
 
     def get_outputs(self, merge_multi_context=True):
         self._require(bound=True, params=True)

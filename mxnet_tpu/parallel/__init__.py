@@ -104,7 +104,8 @@ def allreduce_array(x):
     with _wd.watch("parallel.allreduce_array", kind="collective"):
         gathered = multihost_utils.process_allgather(x)
         out = jnp.sum(gathered, axis=0)
-    record_collective("all-reduce", "parallel.allreduce_array")
+    record_collective("all-reduce", "parallel.allreduce_array",
+                      bytes=int(getattr(x, "nbytes", 0)))
     return out
 
 

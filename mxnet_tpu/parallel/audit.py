@@ -39,12 +39,36 @@ _RUNTIME_LOG: "deque" = deque(maxlen=128)
 _RUNTIME_LOCK = threading.Lock()
 
 
-def record_collective(kind: str, tag: str = "", step=None):
+def record_collective(kind: str, tag: str = "", step=None, bytes=None):
     """Note a completed collective (``kind`` = psum/barrier/ppermute/
-    all_to_all/..., ``tag`` = call-site label)."""
+    all_to_all/..., ``tag`` = call-site label, ``bytes`` = operand
+    payload when the entry point knows it).
+
+    Besides the bounded forensic trail, each record fans out into the
+    telemetry layer when armed: a ``parallel.collectives`` counter
+    (labeled by kind) + ``parallel.collective_bytes``, and a zero-width
+    marker in the merged Chrome trace so collective completions line up
+    against the span timeline."""
+    now = time.time()
     with _RUNTIME_LOCK:
-        _RUNTIME_LOG.append({"time": time.time(), "kind": kind,
-                             "tag": tag, "step": step})
+        _RUNTIME_LOG.append({"time": now, "kind": kind,
+                             "tag": tag, "step": step, "bytes": bytes})
+    from .. import telemetry
+    if telemetry.is_armed():
+        telemetry.count("parallel.collectives", kind=kind)
+        if bytes:
+            telemetry.count("parallel.collective_bytes", float(bytes),
+                            kind=kind)
+    from .. import profiler
+    if profiler.is_running():
+        args = {"kind": kind, "tag": tag}
+        if step is not None:
+            args["step"] = step
+        if bytes is not None:
+            args["bytes"] = int(bytes)
+        profiler.record_event("collective/%s" % kind,
+                              time.perf_counter() * 1e6, 0.0,
+                              cat="collective", args=args)
 
 
 def last_collective():

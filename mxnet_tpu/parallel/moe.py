@@ -126,13 +126,21 @@ def moe_ffn(x, wg, w1, w2, mesh: Mesh, axis: str = "ep",
         fn, mesh=mesh,
         in_specs=(P(axis), P(), P(axis), P(axis)),
         out_specs=(P(axis), P()), **compat)
+    from .. import telemetry as _tel
     from ..resilience import watchdog as _wd
     from .audit import record_collective
-    with _wd.watch("parallel.moe_ffn", kind="collective"):
+    # each all_to_all moves the packed (E, C, d) dispatch blocks, f32
+    a2a_bytes = 2 * E * capacity * x.shape[-1] * 4
+    with _tel.span("collective/moe_ffn", cat="collective",
+                   metric="parallel.collective_seconds",
+                   kind="all-to-all,all-reduce", bytes=a2a_bytes), \
+            _wd.watch("parallel.moe_ffn", kind="collective"):
         out = sharded(x, wg, w1, w2)
     # two all_to_all hops (dispatch + combine) AND the aux-loss pmean —
     # the trail must name every kind in the traced schedule (audit-trail
     # gap caught by analysis/graphcheck collective extraction)
-    record_collective("all-to-all", "parallel.moe_ffn dispatch/combine")
-    record_collective("all-reduce", "parallel.moe_ffn aux-loss pmean")
+    record_collective("all-to-all", "parallel.moe_ffn dispatch/combine",
+                      bytes=a2a_bytes)
+    record_collective("all-reduce", "parallel.moe_ffn aux-loss pmean",
+                      bytes=4)
     return out

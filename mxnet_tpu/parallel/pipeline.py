@@ -88,9 +88,18 @@ def pipeline_apply(stage_fn: Callable, num_stages: int, mesh: Mesh,
     compat = {} if hasattr(jax.lax, "pvary") else {"check_rep": False}
     mapped = shard_map(per_device, mesh=mesh, in_specs=in_specs,
                        out_specs=out_specs, **compat)
+    from .. import telemetry as _tel
     from ..resilience import watchdog as _wd
     from .audit import record_collective
-    with _wd.watch("parallel.pipeline_apply", kind="collective"):
+    # boundary activations hop the ring once per tick: (S+M-1) micro-
+    # batch-sized ppermutes; the final psum moves the (M, mb) outputs
+    act_bytes = int(getattr(x_micro, "nbytes", 0))
+    hop_bytes = (act_bytes // max(M, 1)) * (S + M - 1)
+    with _tel.span("collective/pipeline_apply", cat="collective",
+                   metric="parallel.collective_seconds",
+                   kind="collective-permute,all-reduce",
+                   bytes=hop_bytes + act_bytes), \
+            _wd.watch("parallel.pipeline_apply", kind="collective"):
         params_sharded = jax.device_put(
             params_stacked, NamedSharding(mesh, P(axis)))
         x_rep = jax.device_put(x_micro, NamedSharding(mesh, P()))
@@ -100,8 +109,10 @@ def pipeline_apply(stage_fn: Callable, num_stages: int, mesh: Mesh,
     # or a hang post-mortem would misattribute a stall in the psum
     # (audit-trail gap caught by analysis/graphcheck collective
     # extraction; see tests/test_analysis.py)
-    record_collective("collective-permute", "parallel.pipeline_apply")
-    record_collective("all-reduce", "parallel.pipeline_apply output psum")
+    record_collective("collective-permute", "parallel.pipeline_apply",
+                      bytes=hop_bytes)
+    record_collective("all-reduce", "parallel.pipeline_apply output psum",
+                      bytes=act_bytes)
     return out
 
 

@@ -117,14 +117,21 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
     mapped = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, **compat)
     sharding = NamedSharding(mesh, spec)
+    from .. import telemetry as _tel
     from ..resilience import watchdog as _wd
     from .audit import record_collective
-    with _wd.watch("parallel.ring_attention", kind="collective"):
+    # k/v blocks each make n-1 ppermute hops around the ring
+    kv_bytes = int(getattr(k, "nbytes", 0) + getattr(v, "nbytes", 0))
+    with _tel.span("collective/ring_attention", cat="collective",
+                   metric="parallel.collective_seconds",
+                   kind="collective-permute", bytes=kv_bytes), \
+            _wd.watch("parallel.ring_attention", kind="collective"):
         q = jax.device_put(q, sharding)
         k = jax.device_put(k, sharding)
         v = jax.device_put(v, sharding)
         out = jax.jit(mapped)(q, k, v)
-    record_collective("collective-permute", "parallel.ring_attention")
+    record_collective("collective-permute", "parallel.ring_attention",
+                      bytes=kv_bytes)
     return out
 
 

@@ -386,6 +386,7 @@ class ShardedTrainer:
         raises :class:`~mxnet_tpu.resilience.guards.NonFiniteError` with
         diagnostics.  Chaos faults (`preempt`, `nan_grad`) are honored
         here so fault drills exercise this exact code path."""
+        from .. import telemetry as _tel
         from ..executor import backward_mirror_policy
         from ..resilience import chaos as _chaos
         from ..resilience import watchdog as _watchdog
@@ -406,21 +407,55 @@ class ShardedTrainer:
         # the deadline covers everything a stall can hide in: the chaos
         # hang drill, host->device transfer, and the jitted step with its
         # fused gradient psum (a dead peer blocks right here)
-        with _watchdog.watch("ShardedTrainer.step", kind="step",
-                             step=self._step_count):
+        with _tel.span("train/step", cat="train",
+                       metric="train.step_seconds",
+                       step=self._step_count) as _sp, \
+                _watchdog.watch("ShardedTrainer.step", kind="step",
+                                step=self._step_count):
             _chaos.maybe_hang(self._step_count)
-            inputs = {n: jax.device_put(v, self.spec.batch_sharding())
-                      for n, v in batch.items()}
-            keys = self._keys()
-            params, mom, aux, loss, ok, guard = self._step(
-                params, mom, aux, inputs, keys, self._guard_arrays())
-            self._guard_state = guard
-            if self.guard_nonfinite:
-                self._note_step_result(bool(ok), loss)
+            with _tel.span("train/host_enqueue", cat="train",
+                           step=self._step_count):
+                inputs = {n: jax.device_put(v, self.spec.batch_sharding())
+                          for n, v in batch.items()}
+                keys = self._keys()
+                params, mom, aux, loss, ok, guard = self._step(
+                    params, mom, aux, inputs, keys, self._guard_arrays())
+                self._guard_state = guard
+            # host-enqueue vs device-block split: the dispatch above is
+            # async; this wait is where device time (and a straggling
+            # peer's psum) actually lands.  The explicit sync happens
+            # only when spans record — the disarmed hot path keeps the
+            # pipelined async dispatch untouched.
+            with _tel.span("train/device_wait", cat="train",
+                           step=self._step_count) as _dw:
+                if _dw.active:
+                    jax.block_until_ready((loss, ok))
+                if self.guard_nonfinite:
+                    self._note_step_result(bool(ok), loss)
+        _tel.count("train.steps")
         record_collective("psum", "ShardedTrainer.step dp grad all-reduce",
-                          step=self._step_count)
+                          step=self._step_count, bytes=self._grad_bytes())
         _watchdog.heartbeat(self._step_count)
+        _tel.window_tick()
         return params, mom, aux, loss
+
+    def _grad_bytes(self):
+        """Analytic dp all-reduce payload (f32 grads), cached — feeds the
+        collective telemetry record; None before shapes resolve."""
+        cached = getattr(self, "_grad_bytes_cache", None)
+        if cached is not None:
+            return cached
+        shapes = self._param_shapes
+        if not shapes:
+            return None
+        total = 0
+        for shape in shapes.values():
+            n = 1
+            for d in shape:
+                n *= int(d)
+            total += 4 * n
+        self._grad_bytes_cache = total
+        return total
 
     def _note_step_result(self, ok, loss):
         """Host half of the guard: budget tracking + graceful abort."""
@@ -430,6 +465,8 @@ class ShardedTrainer:
             return
         self._bad_streak += 1
         self._skipped_steps += 1
+        from .. import telemetry as _tel
+        _tel.count("train.skipped_steps")
         if self._bad_streak > self.nonfinite_budget:
             from ..resilience.guards import NonFiniteError
             raise NonFiniteError(

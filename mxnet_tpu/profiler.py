@@ -6,11 +6,24 @@ trace dump profiler.cc:147) + python/mxnet/profiler.py.
 TPU-natively the heavy lifting is jax.profiler (XPlane → TensorBoard /
 Perfetto).  This module keeps the reference's API (profiler_set_config /
 profiler_set_state / dump_profile) and ALSO emits a Chrome-trace JSON of
-python-level op dispatches so the "open chrome://tracing" UX survives.
+python-level events so the "open chrome://tracing" UX survives.
+
+The event store is **per-thread**: ``record_event`` appends to a buffer
+owned by the calling thread (registered once, under a lock, on that
+thread's first event), so the hot dispatch path takes NO lock per event
+— the reference engine's per-device ``OprExecStat`` vectors, not one
+contended global.  ``dump_profile`` snapshots every registered buffer
+without draining it, so events recorded while a dump is in flight land
+in the next dump instead of being lost.
+
+The dump is the MERGED timeline: op events (ndarray/executor dispatch)
+plus every telemetry span (``mxnet_tpu.telemetry.spans``) — trainer
+steps, module fwd/bwd, data iterator, collectives, checkpoints, and the
+serving admission→batch→dispatch→deliver pipeline — one file, open it
+in Perfetto.
 """
 from __future__ import annotations
 
-import atexit
 import json
 import os
 import threading
@@ -20,7 +33,21 @@ from typing import List, Optional
 import jax
 
 _state = {"mode": "symbolic", "filename": "profile.json", "running": False,
-          "events": [], "jax_dir": None, "lock": threading.Lock()}
+          "jax_dir": None}
+
+_REG_LOCK = threading.Lock()
+_BUFFERS: List[list] = []           # every thread's event list, strong refs
+_TLS = threading.local()
+
+
+def _buf() -> list:
+    b = getattr(_TLS, "buf", None)
+    if b is None:
+        b = []
+        _TLS.buf = b
+        with _REG_LOCK:
+            _BUFFERS.append(b)
+    return b
 
 
 def profiler_set_config(mode="symbolic", filename="profile.json"):
@@ -32,8 +59,10 @@ def profiler_set_config(mode="symbolic", filename="profile.json"):
 def profiler_set_state(state="stop"):
     """reference: MXSetProfilerState; 'run' | 'stop'."""
     if state == "run" and not _state["running"]:
+        with _REG_LOCK:
+            for b in _BUFFERS:
+                del b[:]
         _state["running"] = True
-        _state["events"] = []
         jax_dir = os.path.splitext(_state["filename"])[0] + "_xplane"
         try:
             jax.profiler.start_trace(jax_dir)
@@ -58,24 +87,38 @@ def is_running() -> bool:
     return _state["running"]
 
 
-def record_event(name: str, start_us: float, dur_us: float, cat="operator"):
-    """Append one op event (called by instrumented dispatch paths)."""
+def record_event(name: str, start_us: float, dur_us: float, cat="operator",
+                 args=None, tid: Optional[int] = None, pid: int = 0):
+    """Append one trace event — lock-free for the calling thread (its
+    buffer is registered once).  ``args`` become the Chrome-trace event
+    args (visible on click in Perfetto); ``tid`` overrides the thread
+    lane (virtual lanes for retrospective spans)."""
     if not _state["running"]:
         return
-    with _state["lock"]:
-        _state["events"].append(
-            {"name": name, "cat": cat, "ph": "X", "ts": start_us,
-             "dur": dur_us, "pid": 0,
-             "tid": threading.get_ident() % 1000})
+    ev = {"name": name, "cat": cat, "ph": "X", "ts": start_us,
+          "dur": dur_us, "pid": pid,
+          "tid": threading.get_ident() % 1000 if tid is None else tid}
+    if args:
+        ev["args"] = dict(args)
+    _buf().append(ev)
 
 
 def dump_profile():
-    """reference: MXDumpProfile — write Chrome trace JSON."""
-    with _state["lock"]:
-        trace = {"traceEvents": list(_state["events"]),
-                 "displayTimeUnit": "ms"}
-        with open(_state["filename"], "w") as f:
-            json.dump(trace, f)
+    """reference: MXDumpProfile — write the merged Chrome trace JSON.
+
+    Reads every thread's buffer WITHOUT draining it (no event recorded
+    during the dump is lost; it simply appears in the next dump), sorts
+    by timestamp so Perfetto nests slices correctly."""
+    with _REG_LOCK:
+        bufs = list(_BUFFERS)
+    events = []
+    for b in bufs:
+        events.extend(list(b))
+    events.sort(key=lambda e: (e.get("pid", 0), e.get("tid", 0),
+                               e.get("ts", 0.0), -e.get("dur", 0.0)))
+    trace = {"traceEvents": events, "displayTimeUnit": "ms"}
+    with open(_state["filename"], "w") as f:
+        json.dump(trace, f)
     return _state["filename"]
 
 

@@ -137,6 +137,10 @@ def fire(kind: str, step: Optional[int] = None) -> Optional[dict]:
         if f.at_step is not None and step != f.at_step:
             continue
         f.remaining -= 1
+        # every injected fault is a labeled telemetry counter, so drill
+        # tests assert "N injected, N absorbed" instead of grepping logs
+        from .. import telemetry
+        telemetry.count("chaos.faults_injected", kind=kind)
         return dict(f.params)
     return None
 

@@ -73,10 +73,15 @@ class CheckpointManager:
 
     # -- write -----------------------------------------------------------
     def save(self, step: int, arrays, meta=None, blobs=None) -> str:
+        from .. import telemetry
         meta = dict(meta or {})
         meta["step"] = int(step)
-        path = write_container(self.path_for(step), arrays, meta, blobs)
-        self._retain()
+        with telemetry.span("checkpoint/save", cat="checkpoint",
+                            metric="checkpoint.save_seconds",
+                            step=int(step)):
+            path = write_container(self.path_for(step), arrays, meta, blobs)
+            self._retain()
+        telemetry.count("checkpoint.saves")
         return path
 
     def _retain(self):
@@ -93,18 +98,24 @@ class CheckpointManager:
         the newest snapshot that VALIDATES, quarantining any corrupt
         files found on the way down.  Returns None when nothing valid
         exists."""
-        if step is not None:
-            arrays, meta, blobs = read_container(self.path_for(step))
-            return Checkpoint(int(step), self.path_for(step), arrays, meta,
-                              blobs)
-        for s in reversed(self.steps()):
-            path = self.path_for(s)
-            try:
-                arrays, meta, blobs = read_container(path)
-                return Checkpoint(s, path, arrays, meta, blobs)
-            except (CorruptContainer, OSError) as e:
-                self._quarantine(path, e)
-        return None
+        from .. import telemetry
+        with telemetry.span("checkpoint/restore", cat="checkpoint",
+                            metric="checkpoint.restore_seconds"):
+            if step is not None:
+                arrays, meta, blobs = read_container(self.path_for(step))
+                telemetry.count("checkpoint.restores")
+                return Checkpoint(int(step), self.path_for(step), arrays,
+                                  meta, blobs)
+            for s in reversed(self.steps()):
+                path = self.path_for(s)
+                try:
+                    arrays, meta, blobs = read_container(path)
+                    telemetry.count("checkpoint.restores")
+                    return Checkpoint(s, path, arrays, meta, blobs)
+                except (CorruptContainer, OSError) as e:
+                    telemetry.count("checkpoint.quarantined")
+                    self._quarantine(path, e)
+            return None
 
     def latest(self) -> Optional[Checkpoint]:
         """Newest valid snapshot (corrupt ones quarantined), or None."""

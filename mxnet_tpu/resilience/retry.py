@@ -48,7 +48,11 @@ def call_with_retry(fn: Callable, *args,
     desc = desc or getattr(fn, "__name__", "call")
     for attempt in range(1, max_tries + 1):
         try:
-            return fn(*args, **kwargs)
+            result = fn(*args, **kwargs)
+            if attempt > 1:
+                from .. import telemetry
+                telemetry.count("retry.absorbed", desc=desc)
+            return result
         except exceptions as e:
             now = time.monotonic()
             if attempt >= max_tries or now >= deadline:

@@ -93,6 +93,7 @@ class HeartbeatLane:
     """
 
     PREFIX = "mxt_hb"
+    MD_PREFIX = "mxt_md"     # per-rank telemetry digest (one key, JSON)
 
     def __init__(self, client=None):
         self._explicit_client = client
@@ -143,9 +144,21 @@ class HeartbeatLane:
         try:
             self._kv_set(client, "%s/%d" % (self.PREFIX, self._rank()),
                          "%d:%.6f" % (int(step), now))
-            return True
         except Exception:
             return False
+        # piggyback the compact telemetry digest on the same lane (same
+        # throttle, one overwritten key per rank) so rank 0 can build a
+        # fleet view with NO extra collectives or polling threads
+        try:
+            from .. import telemetry
+            if telemetry.is_armed():
+                self._kv_set(client,
+                             "%s/%d" % (self.MD_PREFIX, self._rank()),
+                             json.dumps(telemetry.rank_digest(step=step),
+                                        default=repr))
+        except Exception:
+            pass     # the digest is best-effort; the beat already landed
+        return True
 
     def peers(self) -> Dict[int, Dict[str, float]]:
         """``{rank: {"step": int, "time": float}}`` for every rank that
@@ -163,6 +176,25 @@ class HeartbeatLane:
                 rank = int(str(key).rsplit("/", 1)[-1])
                 step_s, _, t_s = str(value).partition(":")
                 out[rank] = {"step": int(step_s), "time": float(t_s)}
+            except (ValueError, TypeError):
+                continue
+        return out
+
+    def digests(self) -> Dict[int, dict]:
+        """``{rank: telemetry digest}`` for every rank that published one
+        (telemetry armed + heartbeat beaten).  Empty when inactive."""
+        client = self._client()
+        if client is None:
+            return {}
+        try:
+            entries = client.key_value_dir_get(self.MD_PREFIX + "/")
+        except Exception:
+            return {}
+        out = {}
+        for key, value in entries:
+            try:
+                rank = int(str(key).rsplit("/", 1)[-1])
+                out[rank] = json.loads(str(value))
             except (ValueError, TypeError):
                 continue
         return out
@@ -199,7 +231,7 @@ class HeartbeatLane:
         now = time.time()
         fastest = max(beats, key=lambda r: beats[r]["step"])
         slowest = min(beats, key=lambda r: beats[r]["step"])
-        return {
+        report = {
             "ranks": {str(r): {"step": beats[r]["step"],
                                "age_sec": round(now - beats[r]["time"], 3)}
                       for r in sorted(beats)},
@@ -210,6 +242,25 @@ class HeartbeatLane:
             "stale_ranks": [r for r in sorted(beats)
                             if now - beats[r]["time"] > stale_sec],
         }
+        # step-TIME skew from the piggybacked telemetry digests: a rank
+        # that beats on schedule but computes slowly never lags in steps
+        # until it blocks everyone — p50 skew catches it while it is
+        # merely slow, not yet stuck
+        p50s = {}
+        for rank, d in self.digests().items():
+            sm = (d or {}).get("step_ms") or {}
+            if sm.get("p50"):
+                p50s[rank] = float(sm["p50"])
+        if p50s:
+            slow = max(p50s, key=p50s.get)
+            fast = min(p50s, key=p50s.get)
+            report["step_time"] = {
+                "p50_ms": {str(r): p50s[r] for r in sorted(p50s)},
+                "slowest_rank": slow,
+                "fastest_rank": fast,
+                "skew": round(p50s[slow] / max(p50s[fast], 1e-9), 3),
+            }
+        return report
 
 
 # ---------------------------------------------------------------------------
@@ -245,6 +296,25 @@ def _device_snapshot():
     try:
         from ..parallel.mesh import describe_devices
         return describe_devices()
+    except Exception as e:
+        return {"error": repr(e)}
+
+
+def _telemetry_window():
+    """Last-N-seconds metrics activity for the report — what the process
+    was DOING, next to the stacks that say where it STOOD.  Guarded: the
+    monitor thread must never raise."""
+    try:
+        from .. import telemetry
+        return telemetry.metrics_window()
+    except Exception as e:
+        return {"error": repr(e)}
+
+
+def _open_spans():
+    try:
+        from .. import telemetry
+        return telemetry.open_spans()
     except Exception as e:
         return {"error": repr(e)}
 
@@ -295,6 +365,8 @@ def write_postmortem(report_dir: str, tag: str, step=None, deadline=None,
             "straggler": lane_.straggler_report(),
             "devices": _device_snapshot(),
             "env": _env_snapshot(),
+            "metrics_window": _telemetry_window(),
+            "open_spans": _open_spans(),
         }
         if extra:
             report.update(extra)
@@ -557,5 +629,11 @@ def watch(tag, kind="step", step=None, timeout=None):
 
 def heartbeat(step: int, force: bool = False):
     """Publish this rank's progress on the heartbeat lane (throttled;
-    no-op outside jax.distributed runs)."""
+    no-op outside jax.distributed runs).  Also ticks the telemetry
+    metrics window so post-mortems carry recent-activity deltas."""
+    try:
+        from .. import telemetry
+        telemetry.window_tick()
+    except Exception:
+        pass
     return lane().beat(step, force=force)

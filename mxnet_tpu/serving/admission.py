@@ -45,6 +45,7 @@ class AdmissionQueue:
 
     def offer(self, req: Request):
         """Admit ``req`` or shed — never blocks, never grows past depth."""
+        from .. import telemetry
         victim = None
         with self._lock:
             if len(self._items) >= self.depth:
@@ -52,12 +53,14 @@ class AdmissionQueue:
                              key=lambda r: (r.priority, r.enqueued_at))
                 if req.priority <= victim.priority:
                     self.shed_overload += 1
+                    telemetry.count("serve.shed", cause="overload")
                     raise Overloaded(
                         "queue full (depth %d) and request priority %d "
                         "does not beat the cheapest queued priority %d"
                         % (self.depth, req.priority, victim.priority))
                 self._items.remove(victim)
                 self.shed_overload += 1
+                telemetry.count("serve.shed", cause="evicted")
             self._items.append(req)
             self._nonempty.notify()
         if victim is not None:
@@ -69,14 +72,17 @@ class AdmissionQueue:
         """Oldest non-expired request, or None after ``timeout``.
         Expired requests are failed with :class:`DeadlineExceeded` here —
         before device dispatch — and never returned."""
+        from .. import telemetry
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._lock:
             while True:
                 while self._items:
                     req = self._items.pop(0)
                     if not req.expired():
+                        req.t_popped = time.monotonic()
                         return req
                     self.shed_expired += 1
+                    telemetry.count("serve.shed", cause="expired")
                     req._fail(DeadlineExceeded(
                         "deadline passed while queued; dropped before "
                         "dispatch"))

@@ -24,7 +24,8 @@ class Request:
     """One admitted inference request (see module docstring)."""
 
     __slots__ = ("inputs", "rows", "priority", "deadline", "enqueued_at",
-                 "seq", "_event", "_outputs", "_error", "_done_at")
+                 "seq", "t_popped", "t_dispatched", "t_exec_done",
+                 "_event", "_outputs", "_error", "_done_at")
 
     def __init__(self, inputs: Dict[str, np.ndarray], rows: int,
                  priority: int = 0, deadline: Optional[float] = None,
@@ -35,6 +36,11 @@ class Request:
         self.deadline = deadline      # absolute time.monotonic(), or None
         self.enqueued_at = time.monotonic()
         self.seq = seq
+        # telemetry phase timestamps (monotonic), set by the pipeline:
+        # queue pop -> batch close/dispatch -> executor done -> delivery
+        self.t_popped: Optional[float] = None
+        self.t_dispatched: Optional[float] = None
+        self.t_exec_done: Optional[float] = None
         self._event = threading.Event()
         self._outputs: Optional[List[np.ndarray]] = None
         self._error: Optional[BaseException] = None
@@ -62,6 +68,10 @@ class Request:
         if self._done_at is None:
             return None
         return self._done_at - self.enqueued_at
+
+    @property
+    def done_at(self) -> Optional[float]:
+        return self._done_at
 
     # -- completion (runtime side) ----------------------------------------
     def _deliver(self, outputs: List[np.ndarray]) -> bool:

@@ -64,6 +64,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from .. import telemetry
 from ..resilience import chaos, watchdog as _watchdog
 from ..resilience.retry import call_with_retry
 from ..resilience.watchdog import Watchdog
@@ -153,7 +154,18 @@ class ServingRuntime:
         self._lock = threading.Lock()          # counters + model pointer
         self._swap_lock = threading.Lock()     # serializes swap/rollback
         self._counters = collections.Counter()
-        self._latencies = collections.deque(maxlen=2048)
+        # latency/queue-wait/exec distributions live in telemetry
+        # histograms (the ONE percentile implementation, shared with
+        # tools/servebench.py).  Per-runtime unregistered instances keep
+        # concurrent runtimes from mixing samples; ``always=True`` keeps
+        # stats() working with telemetry disarmed (same cost as the
+        # deque it replaces).
+        self._lat_hist = telemetry.Histogram(
+            "serve.latency_seconds", registered=False, always=True)
+        self._qwait_hist = telemetry.Histogram(
+            "serve.queue_wait_seconds", registered=False, always=True)
+        self._exec_hist = telemetry.Histogram(
+            "serve.exec_seconds", registered=False, always=True)
         self._exec_ewma = 0.0
         self._seq = 0
         self._batch_seq = 0
@@ -269,6 +281,7 @@ class ServingRuntime:
         if not self._breaker.admit_ok():
             with self._lock:
                 self._counters["shed_circuit"] += 1
+            telemetry.count("serve.shed", cause="circuit")
             raise CircuitOpen(
                 "circuit open after repeated executor failures; "
                 "shedding until the %.1fs cooldown probe succeeds"
@@ -303,7 +316,6 @@ class ServingRuntime:
     def stats(self) -> dict:
         with self._lock:
             counters = dict(self._counters)
-            lat = list(self._latencies)
             ewma = self._exec_ewma
         counters.setdefault("completed", 0)
         out = {
@@ -317,15 +329,20 @@ class ServingRuntime:
             "breaker": self._breaker.describe(),
             "counters": counters,
         }
-        if lat:
-            lat.sort()
-
-            def pct(p):
-                return round(lat[min(len(lat) - 1,
-                                     int(p * (len(lat) - 1)))], 6)
-
-            out["latency_s"] = {"p50": pct(0.50), "p95": pct(0.95),
-                                "p99": pct(0.99), "max": lat[-1]}
+        # percentiles come from the telemetry histogram — single source
+        # of truth shared with servebench (schema unchanged)
+        lat = self._lat_hist.summary()
+        if lat["count"]:
+            ps = self._lat_hist.percentiles((0.50, 0.95, 0.99))
+            out["latency_s"] = {"p50": round(ps[0.50], 6),
+                                "p95": round(ps[0.95], 6),
+                                "p99": round(ps[0.99], 6),
+                                "max": lat["max"]}
+        qw = self._qwait_hist.summary()
+        if qw["count"]:
+            out["queue_wait_s"] = {"p50": round(qw.get("p50") or 0.0, 6),
+                                   "p95": round(qw.get("p95") or 0.0, 6),
+                                   "max": qw["max"]}
         return out
 
     def close(self):
@@ -390,17 +407,21 @@ class ServingRuntime:
             prog = self._program
         packed = batcher.pack(batch, prog.input_names, prog.input_shapes,
                               prog.input_dtypes)
+        now = time.monotonic()
+        for r in batch:
+            r.t_dispatched = now
         deadlines = [r.remaining() for r in batch if r.deadline is not None]
         margin = min(deadlines) if deadlines else None
         wd_timeout = self._exec_timeout
         retry_budget = max(0.05, margin) if margin is not None else None
-        t0 = time.monotonic()
         armed = (contextlib.nullcontext() if wd_timeout is None else
                  self._ensure_watchdog().watch(
                      "%s.execute" % self._name, kind="step", step=seq,
                      timeout=wd_timeout))
         try:
-            with armed:
+            with armed, telemetry.span(
+                    "serve/exec", cat="serve", timed=True, batch=seq,
+                    rows=sum(r.rows for r in batch)) as sp:
                 outs = call_with_retry(
                     self._exec_once, prog, packed, seq,
                     exceptions=(RuntimeError, OSError),
@@ -411,6 +432,7 @@ class ServingRuntime:
             self._breaker.record_failure()
             with self._lock:
                 self._counters["exec_failures"] += 1
+            telemetry.count("serve.exec_failures")
             err = ExecFailed("executor failed after %d attempt(s): %r"
                              % (self._retry_tries, e))
             for r in batch:
@@ -419,12 +441,15 @@ class ServingRuntime:
                         "deadline passed while the executor was failing"))
                 else:
                     r._fail(err)
+            self._trace_requests(batch)
             return
-        exec_time = time.monotonic() - t0
+        exec_time = sp.duration
+        done = time.monotonic()
         self._breaker.record_success()
         per_request = batcher.unpack(outs, batch, self._batch_dim)
         delivered = 0
         for r, r_outs in zip(batch, per_request):
+            r.t_exec_done = done
             if r._deliver(r_outs):      # late delivery -> DeadlineExceeded
                 delivered += 1
         with self._lock:
@@ -433,6 +458,48 @@ class ServingRuntime:
             self._counters["batches"] += 1
             self._counters["rows"] += sum(r.rows for r in batch)
             self._counters["completed"] += delivered
-            for r in batch:
-                if r.latency is not None and r._error is None:
-                    self._latencies.append(r.latency)
+        self._exec_hist.observe(exec_time)
+        for r in batch:
+            if r.t_popped is not None:
+                self._qwait_hist.observe(r.t_popped - r.enqueued_at)
+            if r.latency is not None and r._error is None:
+                self._lat_hist.observe(r.latency)
+        telemetry.count("serve.requests", float(delivered), outcome="ok")
+        if delivered < len(batch):
+            telemetry.count("serve.requests",
+                            float(len(batch) - delivered), outcome="late")
+        self._trace_requests(batch)
+        telemetry.window_tick()
+
+    def _trace_requests(self, batch: List[Request]):
+        """Retrospective per-request spans into the merged trace: each
+        request gets a virtual lane showing its admission → queue-wait →
+        batch-fill → exec → deliver pipeline, reconstructed from the
+        timestamps the hot path already records."""
+        if not telemetry.spans_active():
+            return
+        from ..telemetry import record_span
+        for r in batch:
+            end = r.done_at or time.monotonic()
+            # one lane per in-flight slot, in a dedicated virtual
+            # process group (pid=1) so real thread ids never collide
+            tid = r.seq % 128
+            attrs = {"seq": r.seq, "rows": r.rows, "priority": r.priority}
+            record_span("serve/request", r.enqueued_at,
+                        end - r.enqueued_at, cat="serve", tid=tid, pid=1,
+                        **attrs)
+            popped = min(r.t_popped or end, end)
+            record_span("serve/queue_wait", r.enqueued_at,
+                        popped - r.enqueued_at, cat="serve", tid=tid,
+                        pid=1)
+            disp = min(r.t_dispatched or popped, end)
+            if disp > popped:
+                record_span("serve/batch_fill", popped, disp - popped,
+                            cat="serve", tid=tid, pid=1)
+            ex_done = min(r.t_exec_done or end, end)
+            if ex_done > disp:
+                record_span("serve/exec", disp, ex_done - disp,
+                            cat="serve", tid=tid, pid=1)
+            if end > ex_done:
+                record_span("serve/deliver", ex_done, end - ex_done,
+                            cat="serve", tid=tid, pid=1)

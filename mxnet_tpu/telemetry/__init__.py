@@ -1,0 +1,46 @@
+"""Unified telemetry layer: metrics registry, structured spans, and
+cross-rank aggregation — the one subsystem the whole stack reports into.
+
+Three pieces (full catalog + knobs in docs/observability.md):
+
+* :mod:`.registry` — process-global named counters/gauges/histograms
+  with a zero-cost disarmed path, JSONL + Prometheus export, and a
+  rolling metrics window for post-mortems.
+* :mod:`.spans` — ``span("train/step", step=n)`` nested, thread-aware
+  timing that merges with the profiler's op events into ONE
+  Chrome/Perfetto trace via ``profiler.dump_profile()``.
+* :mod:`.digest` — compact per-rank digests piggybacked on the PR-2
+  heartbeat lane; rank 0 renders a fleet view and finds stragglers by
+  step-time skew.
+
+Quick start::
+
+    from mxnet_tpu import telemetry
+    telemetry.arm()                      # or MXNET_TPU_TELEMETRY=1
+    with telemetry.span("train/step", step=n,
+                        metric="train.step_seconds"):
+        ...
+    print(telemetry.prometheus_text())
+"""
+from .registry import (DEFAULT_BUCKETS, Counter, Gauge, Histogram, arm,
+                       count, counter, counter_total, delta, disarm,
+                       export_jsonl, gauge, histogram, is_armed,
+                       metrics_window, observe, prometheus_text,
+                       reset_metrics, set_gauge, snapshot, window_tick)
+from .spans import open_spans, record_span, span, spans_active
+from .digest import fleet_view, rank_digest, render_fleet
+
+__all__ = [
+    "DEFAULT_BUCKETS", "Counter", "Gauge", "Histogram", "arm", "count",
+    "counter", "counter_total", "delta", "disarm", "export_jsonl", "gauge",
+    "histogram", "is_armed", "metrics_window", "observe", "prometheus_text",
+    "reset_metrics", "set_gauge", "snapshot", "window_tick",
+    "open_spans", "record_span", "span", "spans_active",
+    "fleet_view", "rank_digest", "render_fleet",
+]
+
+
+def reset():
+    """Full test reset: metrics, window, arm state (spans' open tables
+    are self-healing — they empty as spans exit)."""
+    reset_metrics()

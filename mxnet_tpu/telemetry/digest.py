@@ -1,0 +1,119 @@
+"""Cross-rank aggregation: compact per-rank digests + the rank-0 fleet view.
+
+Multi-host observability without new collectives: each rank folds its
+registry into a ~200-byte JSON digest (step-time p50/p95, throughput,
+shed/retry/fault counters) and piggybacks it on the PR-2 heartbeat lane
+(one overwritten coordination-KV key per rank, ``mxt_md/<rank>``).  Any
+rank — rank 0 by convention — can then render a fleet table and find the
+straggler by *step time*, not just by heartbeat lag: a rank that beats on
+time but computes slowly is invisible to lag and obvious in p50 skew
+(the step-time attribution signal the TPU learned-performance-model work
+builds everything on).
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from . import registry as _registry
+
+__all__ = ["rank_digest", "fleet_view", "render_fleet"]
+
+# counters folded into the digest (name -> short digest key)
+_DIGEST_COUNTERS = (
+    ("train.steps", "steps_done"),
+    ("train.skipped_steps", "skipped"),
+    ("serve.shed", "shed"),
+    ("retry.absorbed", "retries"),
+    ("chaos.faults_injected", "faults"),
+)
+
+
+def rank_digest(step: Optional[int] = None) -> dict:
+    """This rank's compact metrics digest (see module docstring).
+    Cheap: one histogram summary + a handful of counter sums."""
+    hist = _registry.histogram("train.step_seconds")
+    s = hist.summary()
+    d = {"t": time.time(), "step": step}
+    if s["count"]:
+        d["step_ms"] = {
+            "p50": round(1e3 * (s.get("p50") or 0.0), 3),
+            "p95": round(1e3 * (s.get("p95") or 0.0), 3),
+            "mean": round(1e3 * (s["mean"] or 0.0), 3),
+            "n": s["count"],
+        }
+    tput = _throughput()
+    if tput is not None:
+        d["throughput_sps"] = round(tput, 3)
+    counters = {}
+    for name, key in _DIGEST_COUNTERS:
+        total = _registry.counter_total(name)
+        if total:
+            counters[key] = total
+    if counters:
+        d["counters"] = counters
+    return d
+
+
+def _throughput() -> Optional[float]:
+    """Steps/sec from the rolling window: train.steps delta over the
+    oldest in-window snapshot.  None with <2 samples."""
+    win = list(_registry._WINDOW)
+    if not win:
+        return None
+    t0, snap0 = win[0]
+    now = time.time()
+    if now - t0 < 0.5:
+        return None
+
+    def steps_of(snap):
+        desc = snap["metrics"].get("train.steps")
+        if not desc:
+            return 0.0
+        return sum(s["value"] for s in desc["series"])
+
+    cur = _registry.counter_total("train.steps")
+    return max(0.0, cur - steps_of(snap0)) / (now - t0)
+
+
+def fleet_view() -> dict:
+    """Merge every rank's heartbeat + digest into one table (read-only KV
+    scan; callable from any rank, rendered on rank 0)."""
+    from ..resilience import watchdog
+    lane = watchdog.lane()
+    beats = lane.peers()
+    digests = lane.digests()
+    now = time.time()
+    ranks = {}
+    for rank in sorted(set(beats) | set(digests)):
+        row = {}
+        b = beats.get(rank)
+        if b:
+            row["step"] = b["step"]
+            row["age_sec"] = round(now - b["time"], 3)
+        d = digests.get(rank)
+        if d:
+            row["digest"] = d
+        ranks[str(rank)] = row
+    return {"time": now, "ranks": ranks,
+            "straggler": lane.straggler_report()}
+
+
+def render_fleet(view: Optional[dict] = None) -> str:
+    """Human-readable fleet table (stdlib-only; tools/metricsdump.py
+    reuses the same layout)."""
+    view = view or fleet_view()
+    lines = ["rank  step   age_s   p50_ms   p95_ms   tput/s  counters"]
+    for rank, row in sorted(view["ranks"].items(), key=lambda kv: int(kv[0])):
+        d = row.get("digest") or {}
+        sm = d.get("step_ms") or {}
+        lines.append(
+            "%-5s %-6s %-7s %-8s %-8s %-7s %s"
+            % (rank, row.get("step", "-"), row.get("age_sec", "-"),
+               sm.get("p50", "-"), sm.get("p95", "-"),
+               d.get("throughput_sps", "-"), d.get("counters", "") or ""))
+    strag = (view.get("straggler") or {}).get("step_time")
+    if strag:
+        lines.append("step-time straggler: rank %s (p50 skew x%.2f)"
+                     % (strag.get("slowest_rank"), strag.get("skew", 0.0)))
+    return "\n".join(lines)

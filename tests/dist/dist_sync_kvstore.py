@@ -96,6 +96,38 @@ def main():
     assert float(total[0]) == nworker * (nworker + 1) / 2, total
     kv.barrier()
 
+    # telemetry fleet view round-trip (ISSUE 5): each rank runs a few
+    # telemetry-spanned "steps" — rank 1 deliberately slowed — beats the
+    # heartbeat lane (which piggybacks the metrics digest), and every
+    # rank must then see every peer's digest; rank 0's straggler report
+    # must finger the slow rank by STEP-TIME skew, not heartbeat lag
+    # (rank 1 beats on time; it is merely slow).
+    import time
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.resilience import watchdog
+    telemetry.arm()
+    slow_rank = 1
+    step_sleep = 0.15 if rank == slow_rank else 0.01
+    for s in range(1, 4):
+        with telemetry.span("train/step", cat="train",
+                            metric="train.step_seconds", step=s):
+            time.sleep(step_sleep)
+        watchdog.heartbeat(s, force=True)
+    kv.barrier()   # all digests published before anyone reads
+    digests = watchdog.lane().digests()
+    assert set(digests) == set(range(nworker)), digests
+    for r, d in digests.items():
+        assert d["step_ms"]["n"] >= 3, (r, d)
+    view = telemetry.fleet_view()
+    assert set(view["ranks"]) == {str(r) for r in range(nworker)}
+    strag = view["straggler"]["step_time"]
+    assert strag["slowest_rank"] == slow_rank, strag
+    assert strag["skew"] > 2.0, strag
+    if rank == 0:
+        print(telemetry.render_fleet(view), flush=True)
+    telemetry.disarm()
+    kv.barrier()
+
     print("dist_sync_kvstore rank %d/%d OK" % (rank, nworker), flush=True)
 
 

@@ -63,6 +63,10 @@ def export_artifact(path, seed):
 def main():
     workdir = sys.argv[1]
     verdict = {}
+    # telemetry armed so every env-armed chaos firing is a labeled
+    # counter the wrapper can assert on (injected == expected)
+    from mxnet_tpu import telemetry
+    telemetry.arm()
     art_a = export_artifact(os.path.join(workdir, "model_a.mxt"), seed=0)
     art_b = export_artifact(os.path.join(workdir, "model_b.mxt"), seed=1)
 
@@ -198,6 +202,13 @@ def main():
     verdict["breaker_opened_total"] = stats["breaker"]["opened_total"]
     verdict["breaker_recovered_total"] = stats["breaker"]["recovered_total"]
     rt.close()
+
+    fault_counter = telemetry.counter("chaos.faults_injected")
+    verdict["faults_injected"] = {
+        "exec_error": fault_counter.value(kind="exec_error"),
+        "slow_exec": fault_counter.value(kind="slow_exec"),
+        "bad_swap": fault_counter.value(kind="bad_swap"),
+    }
 
     print("DRILL_VERDICT " + json.dumps(verdict), flush=True)
 

@@ -428,6 +428,51 @@ def test_srclint_library_rule_sl106():
     assert len(rep2) == 0
 
 
+def test_srclint_sl107_manual_timing_in_library():
+    """SL107 (info): a host-side library function hand-rolling start/stop
+    timing should use a telemetry span; deadline arithmetic and
+    span-based timing stay quiet."""
+    src = (
+        "import time\n"
+        "from mxnet_tpu import telemetry\n"
+        "def hand_rolled(work):\n"
+        "    t0 = time.perf_counter()\n"
+        "    work()\n"
+        "    return time.perf_counter() - t0\n"
+        "def deadline_math(budget):\n"
+        "    deadline = time.monotonic() + budget\n"
+        "    while time.monotonic() < deadline:\n"
+        "        pass\n"
+        "    return deadline - budget\n"
+        "def span_based(work):\n"
+        "    with telemetry.span('x', timed=True) as sp:\n"
+        "        work()\n"
+        "    return sp.duration\n"
+    )
+    rep = srclint.lint_source(src, "mxnet_tpu/inline_lib.py",
+                              in_library=True)
+    assert [f.rule for f in rep] == ["SL107"]
+    assert rep.findings[0].extra["function"] == "hand_rolled"
+    assert rep.findings[0].severity == "info"
+    # host-only: app/tools code outside the library is not flagged
+    assert len(srclint.lint_source(src, "tools/inline_app.py",
+                                   in_library=False)) == 0
+    # the instrumentation layer itself is exempt
+    assert len(srclint.lint_source(
+        src, "mxnet_tpu/telemetry/inline.py", in_library=True)) == 0
+    # a TRACED function with the same pattern is SL102's territory
+    traced = (
+        "import time, jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    t0 = time.perf_counter()\n"
+        "    return x * (time.perf_counter() - t0)\n"
+    )
+    rep2 = srclint.lint_source(traced, "mxnet_tpu/inline2.py",
+                               in_library=True)
+    assert set(f.rule for f in rep2) == {"SL102"}
+
+
 def test_srclint_suppression_scopes():
     src = (
         "import time, jax\n"

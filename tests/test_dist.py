@@ -72,7 +72,8 @@ def test_dist_hang_watchdog_4proc(tmp_path):
 
     out = _run_dist("dist_hang_watchdog.py",
                     launch_args=("--max-restarts", "1"),
-                    extra_env={"HANG_CKPT_DIR": str(tmp_path)})
+                    extra_env={"HANG_CKPT_DIR": str(tmp_path),
+                               "MXNET_TPU_TELEMETRY": "1"})
     assert "chaos: rank hanging" in out
     assert "restart 1/1" in out
 
@@ -90,4 +91,19 @@ def test_dist_hang_watchdog_4proc(tmp_path):
             stalled.append(rep)
             assert rep["tag"] == "Module.fit step"
             assert "maybe_hang" in open(rep["stack_dump"]).read()
+            # ISSUE 5: the post-mortem shows what the process was DOING —
+            # a recent metrics window (telemetry armed via env) and the
+            # spans still open at expiry (the hung train/step)
+            window = rep["metrics_window"]
+            assert window["armed"] is True, window
+            assert window["snapshots"] >= 1, window
+            assert "train.step_seconds" in window["last"]["metrics"]
+            chaos_counts = window["last"]["metrics"].get(
+                "chaos.faults_injected", {}).get("series", [])
+            assert any(s["labels"].get("kind") == "hang"
+                       for s in chaos_counts), chaos_counts
+            open_names = [s["name"]
+                          for spans in rep["open_spans"].values()
+                          for s in spans]
+            assert "train/step" in open_names, rep["open_spans"]
     assert stalled, "the hung rank's report must name the stuck frame"

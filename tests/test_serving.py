@@ -409,6 +409,11 @@ def test_chaos_serving_drill_kill_and_verify(tmp_path):
     assert v["swap_ok"] is True
     assert v["changed_after_good_swap"] is True
     assert v["bg_failures_during_swaps"] == 0
+    # chaos telemetry: every env-armed fault firing was counted — the
+    # drill asserts "N injected, N absorbed" instead of grepping logs
+    # (MXNET_TPU_CHAOS=exec_errorx4,slow_execx6,bad_swap above)
+    assert v["faults_injected"] == {"exec_error": 4, "slow_exec": 6,
+                                    "bad_swap": 1}
     # kill-and-verify forensics: post-mortem from the wedged phase
     reports = [f for f in os.listdir(str(tmp_path))
                if f.startswith("watchdog-postmortem")

@@ -1,0 +1,493 @@
+"""Unified telemetry layer (mxnet_tpu/telemetry): registry semantics,
+span nesting, the merged Chrome trace, heartbeat digests, post-mortem
+metrics windows, chaos/retry counters, and the disarmed zero-cost path.
+
+The multi-process fleet-view drill (every rank's digest visible to rank
+0, slow rank fingered by step-time skew) rides the existing 4-proc dist
+test (tests/dist/dist_sync_kvstore.py); these are the single-process
+seams plus the ISSUE-5 end-to-end merged-trace acceptance test.
+"""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler, telemetry
+from mxnet_tpu.resilience import chaos, watchdog
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    telemetry.reset()
+    telemetry.disarm()
+    chaos.reset()
+    watchdog.reset()
+    yield
+    profiler.set_state("stop")
+    telemetry.reset()
+    chaos.reset()
+    watchdog.reset()
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+def test_counter_gauge_histogram_with_labels():
+    telemetry.arm()
+    telemetry.count("t.requests", outcome="ok")
+    telemetry.count("t.requests", 2, outcome="ok")
+    telemetry.count("t.requests", outcome="err")
+    telemetry.set_gauge("t.depth", 7)
+    for v in (0.001, 0.004, 0.02, 0.02, 1.5):
+        telemetry.observe("t.lat", v)
+
+    c = telemetry.counter("t.requests")
+    assert c.value(outcome="ok") == 3
+    assert c.value(outcome="err") == 1
+    assert c.total() == 4
+    assert telemetry.gauge("t.depth").value() == 7
+
+    h = telemetry.histogram("t.lat")
+    s = h.summary()
+    assert s["count"] == 5
+    assert s["min"] == 0.001 and s["max"] == 1.5
+    assert abs(s["sum"] - 1.545) < 1e-9
+    # exact percentiles from the reservoir, servebench's old formula
+    xs = sorted((0.001, 0.004, 0.02, 0.02, 1.5))
+    assert h.percentiles((0.5,))[0.5] == xs[int(0.5 * 4)]
+
+
+def test_snapshot_delta_roundtrip():
+    telemetry.arm()
+    telemetry.count("t.steps")
+    telemetry.observe("t.lat", 0.01)
+    before = telemetry.snapshot()
+    telemetry.count("t.steps", 4)
+    telemetry.observe("t.lat", 0.02)
+    d = telemetry.delta(telemetry.snapshot(), before)
+    steps = d["metrics"]["t.steps"]["series"][0]
+    assert steps["value"] == 4
+    lat = d["metrics"]["t.lat"]["series"][0]
+    assert lat["count"] == 1
+    # snapshots are JSON-serializable end to end (the JSONL feed)
+    json.loads(json.dumps(before))
+
+
+def test_prometheus_text_format():
+    telemetry.arm()
+    telemetry.count("train.steps", 3)
+    telemetry.observe("serve.lat", 0.003)
+    text = telemetry.prometheus_text()
+    assert "# TYPE train_steps counter" in text
+    assert "train_steps 3" in text
+    assert "# TYPE serve_lat histogram" in text
+    assert 'serve_lat_bucket{le="+Inf"} 1' in text
+    assert "serve_lat_count 1" in text
+
+
+def test_export_jsonl_and_metricsdump_render(tmp_path):
+    telemetry.arm()
+    path = str(tmp_path / "m.jsonl")
+    telemetry.count("t.steps")
+    telemetry.export_jsonl(path)
+    telemetry.count("t.steps", 5)
+    telemetry.export_jsonl(path)
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "metricsdump", os.path.join(os.path.dirname(__file__), "..",
+                                    "tools", "metricsdump.py"))
+    md = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(md)
+    with open(path) as f:
+        snaps = md._parse_lines(f.readlines())
+    assert len(snaps) == 2
+    text = md.render(snaps[1], snaps[0])
+    assert "t.steps" in text and "/s)" in text    # rate rendered
+
+
+def test_disarmed_is_zero_cost_and_records_nothing():
+    assert not telemetry.is_armed()
+    telemetry.count("t.nope")
+    telemetry.observe("t.nope_h", 1.0)
+    with telemetry.span("t/span", metric="t.nope_h"):
+        pass
+    telemetry.arm()
+    assert telemetry.counter_total("t.nope") == 0
+    assert telemetry.histogram("t.nope_h").summary()["count"] == 0
+    telemetry.disarm()
+    # per-call cost of the disarmed gate: generous bound, catches only
+    # a lost fast path (a lock or a clock read would blow way past it)
+    n = 3000
+    t0 = time.perf_counter()
+    for i in range(n):
+        with telemetry.span("t/hot", step=i):
+            pass
+        telemetry.count("t.hot")
+    per_call = (time.perf_counter() - t0) / n
+    assert per_call < 50e-6, "disarmed telemetry cost %.1fus" % (
+        per_call * 1e6)
+
+
+# ---------------------------------------------------------------------------
+# spans + merged trace
+# ---------------------------------------------------------------------------
+
+def _check_nesting(events, eps_us=0.5):
+    """Every pair of X events on one (pid, tid) lane must be disjoint or
+    properly nested."""
+    lanes = {}
+    for e in events:
+        assert e["ph"] == "X" and e["dur"] >= 0, e
+        lanes.setdefault((e.get("pid", 0), e["tid"]), []).append(e)
+    for lane_events in lanes.values():
+        lane_events.sort(key=lambda e: (e["ts"], -e["dur"]))
+        stack = []
+        for e in lane_events:
+            end = e["ts"] + e["dur"]
+            while stack and e["ts"] >= stack[-1] - eps_us:
+                stack.pop()
+            if stack:
+                assert end <= stack[-1] + eps_us, \
+                    ("overlap, not nesting", e)
+            stack.append(end)
+
+
+def test_span_nesting_across_threads(tmp_path):
+    profiler.set_config(filename=str(tmp_path / "t.json"))
+    profiler.set_state("run")
+
+    def work(tag):
+        with telemetry.span("outer/%s" % tag, cat="test"):
+            with telemetry.span("inner/%s" % tag, cat="test"):
+                time.sleep(0.005)
+
+    threads = [threading.Thread(target=work, args=("t%d" % i,))
+               for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    work("main")
+    profiler.set_state("stop")
+    events = json.load(open(profiler.dump_profile()))["traceEvents"]
+    names = {e["name"] for e in events}
+    assert {"outer/t0", "inner/t0", "outer/t1", "inner/t1",
+            "outer/main", "inner/main"} <= names
+    # the two worker threads and main each get their own lane
+    assert len({e["tid"] for e in events}) == 3
+    _check_nesting(events)
+    for tag in ("t0", "t1", "main"):
+        outer = next(e for e in events if e["name"] == "outer/%s" % tag)
+        inner = next(e for e in events if e["name"] == "inner/%s" % tag)
+        assert outer["tid"] == inner["tid"]
+        assert outer["ts"] <= inner["ts"]
+        assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 0.5
+
+
+def test_open_spans_visible_cross_thread():
+    telemetry.arm()
+    entered = threading.Event()
+    release = threading.Event()
+
+    def work():
+        with telemetry.span("t/holding", cat="test", step=3):
+            entered.set()
+            release.wait(5)
+
+    t = threading.Thread(target=work, name="holder")
+    t.start()
+    try:
+        assert entered.wait(5)
+        spans = telemetry.open_spans()
+        holder = [v for k, v in spans.items() if k.startswith("holder")]
+        assert holder and holder[0][0]["name"] == "t/holding"
+        assert holder[0][0]["attrs"]["step"] == "3"
+    finally:
+        release.set()
+        t.join()
+    assert not any(k.startswith("holder")
+                   for k in telemetry.open_spans())
+
+
+def test_dump_keeps_events_across_dumps(tmp_path):
+    """Per-thread buffers: a dump must not drop or drain events — events
+    recorded after one dump appear alongside the old ones in the next
+    (the old global-lock store lost in-flight events on restart)."""
+    profiler.set_config(filename=str(tmp_path / "d.json"))
+    profiler.set_state("run")
+    profiler.record_event("first", 1.0, 2.0)
+    p1 = profiler.dump_profile()
+    assert len(json.load(open(p1))["traceEvents"]) == 1
+    profiler.record_event("second", 5.0, 2.0)
+    profiler.set_state("stop")
+    events = json.load(open(profiler.dump_profile()))["traceEvents"]
+    assert {e["name"] for e in events} == {"first", "second"}
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 5 acceptance: ONE merged trace from training + serving
+# ---------------------------------------------------------------------------
+
+class _SyntheticServed:
+    """Program-like stand-in (servebench's trick): fixed batch shape,
+    no device — the serving RUNTIME's spans are what this test needs."""
+
+    def __init__(self, batch=4, features=8):
+        self.input_names = ["data"]
+        self.input_shapes = {"data": (batch, features)}
+        self.input_dtypes = {"data": np.dtype(np.float32)}
+
+    def forward(self, data):
+        time.sleep(0.001)
+        return [np.tanh(data)]
+
+
+def test_merged_trace_end_to_end(tmp_path):
+    """Short sharded-training run + served-inference burst -> ONE Chrome
+    trace with nested spans from >= 4 subsystems (trainer, collective,
+    data iter, serving), every event JSON-valid and properly nested."""
+    import jax
+    from mxnet_tpu.parallel.mesh import MeshSpec, make_mesh
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+    from mxnet_tpu.parallel.ring import ring_attention, reference_attention
+    from mxnet_tpu.serving import ServingRuntime
+
+    profiler.set_config(filename=str(tmp_path / "merged.json"))
+    telemetry.arm()
+    profiler.set_state("run")
+    try:
+        # -- sharded training fed from a real data iterator ------------
+        n = 2
+        mesh = make_mesh((n,), ("dp",))
+        data = mx.sym.Variable("data")
+        fc = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+        net = mx.sym.SoftmaxOutput(fc, name="softmax")
+        trainer = ShardedTrainer(net, MeshSpec(mesh))
+        shapes = {"data": (8, 4), "softmax_label": (8,)}
+        params, mom, aux = trainer.init_state(shapes)
+        rs = np.random.RandomState(0)
+        X = rs.rand(24, 4).astype(np.float32)
+        y = rs.randint(0, 2, 24).astype(np.float32)
+        it = mx.io.NDArrayIter(X, y, batch_size=8)
+        for batch in it:
+            feed = {"data": batch.data[0].asnumpy(),
+                    "softmax_label": batch.label[0].asnumpy()}
+            params, mom, aux, loss = trainer.step(params, mom, aux, feed)
+
+        # -- an explicit collective entry point ------------------------
+        sp_mesh = make_mesh((n,), ("sp",))
+        q = rs.rand(1, 4, 2, 4).astype(np.float32)
+        out = ring_attention(q, q, q, sp_mesh, axis="sp")
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(reference_attention(q, q, q)),
+                                   rtol=2e-4, atol=2e-5)
+
+        # -- served-inference burst ------------------------------------
+        with ServingRuntime(_SyntheticServed(), name="e2e") as rt:
+            for _ in range(5):
+                rt.predict({"data": np.zeros(8, np.float32)},
+                           deadline=5.0)
+            stats = rt.stats()
+    finally:
+        profiler.set_state("stop")
+        telemetry.disarm()
+
+    path = profiler.dump_profile()
+    with open(path) as f:
+        events = json.load(f)["traceEvents"]       # every event parses
+    assert events
+    names = {e["name"] for e in events}
+    cats = {e["cat"] for e in events}
+
+    # >= 4 subsystems present, nested spans each
+    assert "train/step" in names and "train/host_enqueue" in names
+    assert "data/next" in names
+    assert "collective/ring_attention" in names
+    assert "collective/psum" in names              # trainer grad psum marker
+    assert {"serve/request", "serve/queue_wait", "serve/exec"} <= names
+    assert {"train", "io", "collective", "serve"} <= cats
+
+    _check_nesting(events)
+
+    # nested: host_enqueue inside its train/step
+    step1 = next(e for e in events if e["name"] == "train/step")
+    enq = next(e for e in events if e["name"] == "train/host_enqueue"
+               and e["ts"] >= step1["ts"] - 0.5)
+    assert enq["ts"] + enq["dur"] <= step1["ts"] + step1["dur"] + 0.5
+    # the collective marker carries kind + operand bytes
+    psum = next(e for e in events if e["name"] == "collective/psum")
+    assert psum["args"]["kind"] == "psum" and psum["args"]["bytes"] > 0
+
+    # and the same run fed the metrics side: step histogram + serving
+    # percentiles out of the telemetry histogram
+    assert telemetry.histogram("train.step_seconds").summary()["count"] == 3
+    assert telemetry.counter_total("train.steps") == 3
+    assert stats["latency_s"]["p50"] > 0
+    assert stats["counters"]["completed"] == 5
+
+
+# ---------------------------------------------------------------------------
+# cross-rank digests (single-process seams; dist drill in test_dist)
+# ---------------------------------------------------------------------------
+
+def _fake_kv_client():
+    from tests.test_watchdog import FakeKVClient
+    return FakeKVClient()
+
+
+def test_heartbeat_digest_roundtrip(monkeypatch):
+    telemetry.arm()
+    client = _fake_kv_client()
+    lane = watchdog.HeartbeatLane(client=client)
+    monkeypatch.setattr(watchdog, "_LANE", lane)
+    for _ in range(4):
+        telemetry.observe("train.step_seconds", 0.012)
+    telemetry.count("train.steps", 4)
+    assert lane.beat(7, force=True)
+    # digest piggybacked on the SAME lane: one overwritten key per rank
+    md_keys = [k for k in client.kv if k.startswith(lane.MD_PREFIX)]
+    assert md_keys == ["%s/0" % lane.MD_PREFIX]
+    d = lane.digests()[0]
+    assert d["step"] == 7
+    assert d["step_ms"]["n"] == 4
+    assert abs(d["step_ms"]["p50"] - 12.0) < 1.0
+    assert d["counters"]["steps_done"] == 4
+
+    # a slow peer: higher p50 -> step-time straggler despite fresh beats
+    now = time.time()
+    client.kv["mxt_hb/1"] = "7:%.6f" % now
+    client.kv["mxt_md/1"] = json.dumps(
+        {"t": now, "step": 7, "step_ms": {"p50": 240.0, "p95": 260.0,
+                                          "mean": 241.0, "n": 4}})
+    rep = lane.straggler_report()
+    assert rep["lag_steps"] == 0                    # invisible to lag...
+    st = rep["step_time"]
+    assert st["slowest_rank"] == 1                  # ...visible to skew
+    assert st["fastest_rank"] == 0
+    assert st["skew"] > 5
+
+    view = telemetry.fleet_view()
+    assert set(view["ranks"]) == {"0", "1"}
+    assert view["ranks"]["1"]["digest"]["step_ms"]["p50"] == 240.0
+    rendered = telemetry.render_fleet(view)
+    assert "step-time straggler: rank 1" in rendered
+
+
+def test_digest_not_published_when_disarmed():
+    client = _fake_kv_client()
+    lane = watchdog.HeartbeatLane(client=client)
+    assert lane.beat(3, force=True)
+    assert not [k for k in client.kv if k.startswith(lane.MD_PREFIX)]
+    assert lane.digests() == {}
+
+
+# ---------------------------------------------------------------------------
+# post-mortems show what the process was DOING
+# ---------------------------------------------------------------------------
+
+def test_postmortem_embeds_metrics_window_and_open_spans(tmp_path):
+    telemetry.arm()
+    telemetry.count("train.steps", 5)
+    telemetry.observe("train.step_seconds", 0.03)
+    telemetry.window_tick()
+    fired = []
+    watchdog.configure(step_timeout=0.25, action="wait",
+                       report_dir=str(tmp_path), poll=0.05,
+                       on_expire=fired.append)
+    with telemetry.span("train/step", cat="train", step=9):
+        with watchdog.watch("unit.step", step=9):
+            time.sleep(0.6)
+    assert fired and fired[0]
+    rep = json.load(open(fired[0]))
+    win = rep["metrics_window"]
+    assert win["armed"] is True
+    assert win["snapshots"] >= 1
+    assert "train.steps" in win["last"]["metrics"]
+    assert "delta" in win
+    names = [s["name"] for spans in rep["open_spans"].values()
+             for s in spans]
+    assert "train/step" in names
+
+
+# ---------------------------------------------------------------------------
+# chaos + retry counters
+# ---------------------------------------------------------------------------
+
+def test_chaos_faults_are_counted():
+    telemetry.arm()
+    with chaos.inject("io_error", count=2):
+        for _ in range(2):
+            with pytest.raises(OSError):
+                chaos.maybe_io_error("unit")
+    with chaos.inject("exec_error", count=1):
+        with pytest.raises(RuntimeError):
+            chaos.maybe_exec_error(1)
+    c = telemetry.counter("chaos.faults_injected")
+    assert c.value(kind="io_error") == 2
+    assert c.value(kind="exec_error") == 1
+    assert c.total() == 3
+
+
+def test_retry_absorption_is_counted():
+    from mxnet_tpu.resilience.retry import call_with_retry
+    telemetry.arm()
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 2:
+            raise OSError("transient")
+        return "ok"
+
+    assert call_with_retry(flaky, backoff=0.001, desc="unit.flaky") == "ok"
+    assert telemetry.counter("retry.absorbed").value(desc="unit.flaky") == 1
+    # N injected == N absorbed, assertable without grepping logs
+    with chaos.inject("io_error", count=1):
+        def chaotic():
+            chaos.maybe_io_error("unit2")
+            return "ok"
+        assert call_with_retry(chaotic, backoff=0.001,
+                               desc="unit.chaotic") == "ok"
+    assert telemetry.counter("chaos.faults_injected").value(
+        kind="io_error") == 1
+    assert telemetry.counter("retry.absorbed").value(
+        desc="unit.chaotic") == 1
+
+
+# ---------------------------------------------------------------------------
+# single-source-of-truth percentiles (serving + checkpoints)
+# ---------------------------------------------------------------------------
+
+def test_serving_stats_read_from_telemetry_histogram():
+    from mxnet_tpu.serving import ServingRuntime
+    with ServingRuntime(_SyntheticServed(), name="hist") as rt:
+        for _ in range(6):
+            rt.predict({"data": np.zeros(8, np.float32)}, deadline=5.0)
+        stats = rt.stats()
+        # stats percentiles == the histogram's percentiles, to the digit
+        ps = rt._lat_hist.percentiles((0.50, 0.95, 0.99))
+        assert stats["latency_s"]["p50"] == round(ps[0.50], 6)
+        assert stats["latency_s"]["p99"] == round(ps[0.99], 6)
+        assert stats["latency_s"]["max"] == rt._lat_hist.summary()["max"]
+        assert rt._lat_hist.summary()["count"] == 6
+        assert stats["queue_wait_s"]["max"] >= 0
+    # works with telemetry disarmed (always=True instruments)
+    assert not telemetry.is_armed()
+
+
+def test_checkpoint_save_restore_counted_and_spanned(tmp_path):
+    from mxnet_tpu.resilience.checkpoint import CheckpointManager
+    telemetry.arm()
+    mgr = CheckpointManager(str(tmp_path), keep=2)
+    mgr.save(1, {"w": np.ones((3,), np.float32)}, meta={"kind": "unit"})
+    ck = mgr.latest()
+    assert ck is not None and ck.meta["kind"] == "unit"
+    assert telemetry.counter_total("checkpoint.saves") == 1
+    assert telemetry.counter_total("checkpoint.restores") == 1
+    assert telemetry.histogram(
+        "checkpoint.save_seconds").summary()["count"] == 1

@@ -1,0 +1,151 @@
+#!/usr/bin/env python3
+"""Live-tail the telemetry JSONL feed (stdlib-only operator console).
+
+A process exporting metrics (``MXNET_TPU_TELEMETRY=1`` +
+``MXNET_TPU_TELEMETRY_JSONL=/path/metrics.jsonl``, or explicit
+``telemetry.export_jsonl(path)`` calls) appends one snapshot per line.
+This tool renders those snapshots the way an operator watches a job:
+counters as RATES between consecutive snapshots, gauges as values,
+histograms as count/mean/p50/p95/p99.
+
+Usage:
+    python tools/metricsdump.py METRICS.jsonl [options]
+
+    --follow, -f       keep the file open and render new snapshots as
+                       they are appended (tail -f mode; ctrl-C to stop)
+    --interval S       follow-mode poll interval (default 1.0)
+    --filter PREFIX    only show metric names starting with PREFIX
+                       (repeatable)
+    --last N           non-follow mode: render only the last N snapshots
+                       (default 1)
+    --raw              print the snapshot JSON lines unrendered
+
+Exit status: 0, or 2 on a missing/unreadable file.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _fmt_labels(labels):
+    if not labels:
+        return ""
+    return "{%s}" % ",".join("%s=%s" % kv for kv in sorted(labels.items()))
+
+
+def _fmt_num(v):
+    if v is None:
+        return "-"
+    if isinstance(v, float) and not v.is_integer():
+        return "%.4g" % v
+    return "%d" % v
+
+
+def render(snap, prev=None, filters=()):
+    """One snapshot -> printable block.  ``prev`` enables counter
+    rates."""
+    dt = None
+    if prev is not None:
+        dt = max(1e-9, snap["time"] - prev["time"])
+    lines = ["--- snapshot @ %s%s" % (
+        time.strftime("%H:%M:%S", time.localtime(snap["time"])),
+        " (+%.1fs)" % dt if dt else "")]
+
+    def prev_value(name, labels):
+        desc = (prev or {}).get("metrics", {}).get(name)
+        if not desc:
+            return None
+        for s in desc["series"]:
+            if s["labels"] == labels:
+                return s
+        return None
+
+    for name, desc in sorted(snap.get("metrics", {}).items()):
+        if filters and not any(name.startswith(f) for f in filters):
+            continue
+        for s in desc["series"]:
+            label = "%s%s" % (name, _fmt_labels(s["labels"]))
+            if desc["kind"] == "counter":
+                rate = ""
+                p = prev_value(name, s["labels"])
+                if dt and p is not None:
+                    rate = "  (%.4g/s)" % ((s["value"] - p["value"]) / dt)
+                lines.append("  %-52s %s%s"
+                             % (label, _fmt_num(s["value"]), rate))
+            elif desc["kind"] == "gauge":
+                lines.append("  %-52s %s" % (label, _fmt_num(s["value"])))
+            else:
+                lines.append(
+                    "  %-52s n=%d mean=%s p50=%s p95=%s p99=%s max=%s"
+                    % (label, s["count"], _fmt_num(s.get("sum", 0)
+                                                   / max(s["count"], 1)),
+                       _fmt_num(s.get("p50")), _fmt_num(s.get("p95")),
+                       _fmt_num(s.get("p99")), _fmt_num(s.get("max"))))
+    return "\n".join(lines)
+
+
+def _parse_lines(chunk):
+    out = []
+    for line in chunk:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            out.append(json.loads(line))
+        except ValueError:
+            continue      # half-written tail line; next poll gets it
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path")
+    ap.add_argument("--follow", "-f", action="store_true")
+    ap.add_argument("--interval", type=float, default=1.0)
+    ap.add_argument("--filter", action="append", default=[])
+    ap.add_argument("--last", type=int, default=1)
+    ap.add_argument("--raw", action="store_true")
+    args = ap.parse_args(argv)
+
+    if not os.path.isfile(args.path):
+        print("metricsdump: no such file: %s" % args.path, file=sys.stderr)
+        return 2
+
+    with open(args.path) as f:
+        snaps = _parse_lines(f.readlines())
+        if not args.follow:
+            if args.raw:
+                for s in snaps[-args.last:]:
+                    print(json.dumps(s))
+                return 0
+            shown = snaps[-args.last:]
+            for i, s in enumerate(shown):
+                prev = (shown[i - 1] if i else
+                        (snaps[-args.last - 1] if len(snaps) > args.last
+                         else None))
+                print(render(s, prev, args.filter))
+            return 0
+
+        prev = snaps[-1] if snaps else None
+        if prev is not None:
+            print(render(prev, snaps[-2] if len(snaps) > 1 else None,
+                         args.filter))
+        try:
+            while True:
+                fresh = _parse_lines(f.readlines())
+                for s in fresh:
+                    if args.raw:
+                        print(json.dumps(s))
+                    else:
+                        print(render(s, prev, args.filter))
+                    prev = s
+                sys.stdout.flush()
+                time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -59,34 +59,43 @@ class SyntheticProgram:
         return [self._np.tanh(data)]
 
 
-def _percentiles(latencies):
-    if not latencies:
+def _percentiles(hist):
+    """Latency block from a telemetry histogram — the SAME percentile
+    implementation the serving runtime's stats() uses (single source of
+    truth; the old private sorted-list math is gone)."""
+    s = hist.summary()
+    if not s["count"]:
         return {}
-    xs = sorted(latencies)
-
-    def pct(p):
-        return xs[min(len(xs) - 1, int(p * (len(xs) - 1)))]
-
-    return {"p50_ms": round(pct(0.50) * 1e3, 3),
-            "p95_ms": round(pct(0.95) * 1e3, 3),
-            "p99_ms": round(pct(0.99) * 1e3, 3),
-            "max_ms": round(xs[-1] * 1e3, 3),
-            "mean_ms": round(statistics.fmean(xs) * 1e3, 3)}
+    ps = hist.percentiles((0.50, 0.95, 0.99))
+    return {"p50_ms": round(ps[0.50] * 1e3, 3),
+            "p95_ms": round(ps[0.95] * 1e3, 3),
+            "p99_ms": round(ps[0.99] * 1e3, 3),
+            "max_ms": round(s["max"] * 1e3, 3),
+            "mean_ms": round(s["mean"] * 1e3, 3)}
 
 
 class Collector:
-    """Thread-safe outcome tally: ok latencies + typed-error counts."""
+    """Thread-safe outcome tally: ok latencies (into a telemetry
+    histogram) + typed-error counts."""
 
     def __init__(self):
+        from mxnet_tpu import telemetry
         self._lock = threading.Lock()
-        self.latencies = []
+        # reservoir sized past any bench run so percentiles stay exact
+        self.hist = telemetry.Histogram("servebench.latency_seconds",
+                                        registered=False, always=True,
+                                        reservoir=1 << 17)
         self.errors = {}
         self.total = 0
+
+    @property
+    def ok(self):
+        return self.hist.summary()["count"]
 
     def record_ok(self, latency):
         with self._lock:
             self.total += 1
-            self.latencies.append(latency)
+        self.hist.observe(latency)
 
     def record_error(self, exc):
         kind = type(exc).__name__
@@ -219,16 +228,16 @@ def main(argv=None):
 
     shed = sum(v for k, v in collector.errors.items()
                if k in ("Overloaded", "CircuitOpen"))
+    n_ok = collector.ok
     report = {
         "mode": args.mode,
         "duration_s": round(elapsed, 3),
         "requests": collector.total,
-        "ok": len(collector.latencies),
-        "throughput_rps": round(len(collector.latencies) /
-                                max(elapsed, 1e-9), 1),
+        "ok": n_ok,
+        "throughput_rps": round(n_ok / max(elapsed, 1e-9), 1),
         "errors": collector.errors,
         "shed_rate": round(shed / max(collector.total, 1), 4),
-        "latency": _percentiles(collector.latencies),
+        "latency": _percentiles(collector.hist),
         "queue_depth_max": max(depth_samples) if depth_samples else 0,
         "queue_depth_mean": round(statistics.fmean(depth_samples), 2)
         if depth_samples else 0.0,
