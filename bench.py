@@ -65,6 +65,46 @@ def _transformer_flops_per_step(batch, seq, layers, hidden, vocab):
     return mod.transformer_flops_per_step(batch, seq, layers, hidden, vocab)
 
 
+def _attach_phases(result, step, n_dev, step_time_s, tag):
+    """Attribution phases block: roofline shares + MFU + report path in
+    the bench JSON line, so every BENCH_* artifact is self-describing
+    (telemetry/perf.py; needs the AOT-compiled step — BENCH_AUTO_LAYOUT=0
+    skips it).  Never fails the bench."""
+    try:
+        if not hasattr(step, "as_text"):
+            return
+        from mxnet_tpu.telemetry import perf as _perf
+        rep = _perf.attribute_compiled(step, "bench.%s" % tag,
+                                       n_devices=n_dev,
+                                       measured_step_s=step_time_s)
+        path = os.environ.get(
+            "BENCH_ATTRIBUTION_PATH",
+            "/tmp/mxnet_tpu_bench_attr_%s_%d.json" % (tag, os.getpid()))
+        rep.save(path)
+        result["phases"] = _perf.phases_block(rep, path)
+    except Exception as e:
+        result["phases"] = {"error": str(e)[:200]}
+
+
+def _maybe_ledger(result):
+    """BENCH_LEDGER=path: append this run to the benchwatch trajectory
+    ledger (tools/benchwatch.py gates it in CI)."""
+    path = os.environ.get("BENCH_LEDGER")
+    if not path:
+        return
+    try:
+        import importlib.util
+        here = os.path.dirname(os.path.abspath(__file__))
+        spec = importlib.util.spec_from_file_location(
+            "benchwatch_feed", os.path.join(here, "tools", "benchwatch.py"))
+        bw = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bw)
+        bw.append_entry(path, bw.extract_metrics(result),
+                        source="bench.py")
+    except Exception as e:
+        print("bench: ledger append failed: %s" % e, file=sys.stderr)
+
+
 def _transformer_main(as_dict=False, batch=None, iters=None):
     """BENCH_MODEL=transformer: decoder-only LM training tokens/sec —
     the attention-path number of record (GPT-2-small-ish geometry by
@@ -132,6 +172,7 @@ def _transformer_main(as_dict=False, batch=None, iters=None):
             layers, hidden, seq_len, batch, dtype),
         "vs_baseline": None,
     }
+    _attach_phases(result, step, n_dev, dt / iters, "transformer")
     if as_dict:
         return result
     print(json.dumps(result))
@@ -139,7 +180,9 @@ def _transformer_main(as_dict=False, batch=None, iters=None):
 
 def main():
     if os.environ.get("BENCH_MODEL", "resnet50") == "transformer":
-        _transformer_main()
+        result = _transformer_main(as_dict=True)
+        _maybe_ledger(result)
+        print(json.dumps(result))
         return
     batch = int(os.environ.get("BENCH_BATCH", "32"))
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
@@ -297,6 +340,7 @@ def main():
             ", RecordIO+native decode in loop" if io_mode else ""),
         "vs_baseline": round(img_s_chip / BASELINE_IMG_S, 2),
     }
+    _attach_phases(result, step, n_dev, dt / iters, "resnet50")
     if not io_mode and os.environ.get("BENCH_TRANSFORMER", "1") != "0":
         # attention-path number of record, captured in the same artifact.
         # Runs in a fresh subprocess: HBM must start empty (the resident
@@ -304,7 +348,11 @@ def main():
         # BENCH_BATCH/BENCH_ITERS knobs must not leak into LM geometry.
         import subprocess
         env = dict(os.environ, BENCH_MODEL="transformer")
-        for knob in ("BENCH_BATCH", "BENCH_ITERS", "BENCH_WARMUP"):
+        # the LM subprocess must not inherit ResNet geometry knobs, the
+        # parent's attribution path (it has its own), or the ledger (the
+        # parent appends ONE entry carrying both metrics)
+        for knob in ("BENCH_BATCH", "BENCH_ITERS", "BENCH_WARMUP",
+                     "BENCH_ATTRIBUTION_PATH", "BENCH_LEDGER"):
             env.pop(knob, None)
         r = subprocess.run([sys.executable, os.path.abspath(__file__)],
                            env=env, capture_output=True, text=True,
@@ -316,6 +364,7 @@ def main():
             result["transformer"] = {
                 "error": (r.stderr.strip().splitlines() or ["no output"])
                 [-1][:200]}
+    _maybe_ledger(result)
     print(json.dumps(result))
 
 

@@ -11,6 +11,10 @@ Two engines over one finding/report model (``report.py``):
   env reads / Python RNG / tracer leaks) and unarmed collective entry
   points.
 
+Plus :mod:`~mxnet_tpu.analysis.costmodel`: the analytic FLOPs / byte /
+collective / roofline model over optimized HLO that the performance
+attribution plane (:mod:`mxnet_tpu.telemetry.perf`) is built on.
+
 Wired into ``ShardedTrainer.step`` / ``Module.bind`` as an opt-in
 pre-flight (``MXNET_TPU_PREFLIGHT=1``, see
 :mod:`~mxnet_tpu.analysis.preflight`), into CI via
@@ -20,7 +24,7 @@ pre-flight (``MXNET_TPU_PREFLIGHT=1``, see
 from __future__ import annotations
 
 from .report import Finding, PreflightError, Report, SEVERITIES
-from . import graphcheck, preflight, srclint
+from . import costmodel, graphcheck, preflight, srclint
 
 __all__ = ["Finding", "Report", "PreflightError", "SEVERITIES",
-           "graphcheck", "preflight", "srclint"]
+           "costmodel", "graphcheck", "preflight", "srclint"]

@@ -1,0 +1,499 @@
+"""Analytic cost model over optimized HLO text.
+
+The static half of the performance attribution plane (the dynamic half —
+telemetry histograms and the span split — lives in
+:mod:`mxnet_tpu.telemetry.perf`).  Given the optimized HLO of a compiled
+program this module computes, WITHOUT executing anything:
+
+* **analytic FLOPs** — dot/convolution contractions from their shapes
+  (2·|out|·K), elementwise arithmetic at one flop per output element,
+  reduces at one flop per input element; transcendentals counted in
+  their own bucket the way ``HloCostAnalysis`` does.  Validated against
+  ``Compiled.cost_analysis()`` within 5% on seeded programs
+  (tests/test_perf_attribution.py).
+* **instruction bytes by op class × dtype** — every instruction's
+  result bytes grouped by ``(opcode, dtype)``: the accounting PERF.md
+  r4/r5 derived by hand ("+4.9 GB f32 add around every BatchNorm") now
+  computed mechanically, with the f32-vs-bf16 split and top-N
+  contributors a perf round starts from.
+* **collective payloads** — via :func:`parallel.audit
+  .collective_accounting` (one parser, already CI-validated to 1.00× of
+  the analytic ring model at dp8).
+* **collective/compute overlap** — walks each computation's instruction
+  schedule and reports what fraction of collective payload bytes is
+  issued async (``-start``/``-done``) with real compute between start
+  and done: the standing instrument behind ROADMAP item 2's "spans
+  prove the overlap" criterion.  Synchronous collectives are by
+  construction 0% overlapped.
+* **roofline** — peak-normalized compute/HBM/collective times and which
+  roof binds, against per-chip peaks (see :func:`chip_peaks`).
+
+"A Learned Performance Model for TPUs" (PAPERS.md) starts from exactly
+these analytic features; TVM automates the same accounting with a
+measurement harness.  This module is the feature extractor both
+directions share.
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, Iterator, List, Optional, Tuple
+
+__all__ = ["HloInstr", "iter_instructions", "analytic_flops",
+           "instruction_bytes", "bytes_by_dtype", "top_contributors",
+           "collective_compute_overlap", "chip_peaks", "roofline"]
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+    "c64": 8, "c128": 16,
+}
+
+_SHAPE_RE = re.compile(r"(pred|bf16|f8e4m3fn|f8e5m2|[sufc]\d+)\[([\d,]*)\]")
+
+# instruction line: `[ROOT ]%name = TYPE opcode(operands...), attrs...`
+# (same shape as parallel/audit.py's collective matcher, kept permissive:
+# TYPE may be a tuple of shapes, opcode is the lowercase HLO op name)
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*(\(?.+?\)?)\s+([a-z][\w\-]*)\(")
+
+# one flop per output element (XLA HloCostAnalysis HandleElementwiseOp)
+_ELEMENTWISE = frozenset({
+    "add", "subtract", "multiply", "divide", "maximum", "minimum",
+    "negate", "abs", "sign", "floor", "ceil", "round-nearest-afz",
+    "round-nearest-even", "compare", "select", "clamp", "and", "or",
+    "xor", "not", "shift-left", "shift-right-arithmetic",
+    "shift-right-logical", "remainder", "atan2", "convert", "is-finite",
+})
+
+# counted in HloCostAnalysis's transcendental bucket, not flops
+_TRANSCENDENTAL = frozenset({
+    "exponential", "exponential-minus-one", "log", "log-plus-one",
+    "tanh", "sqrt", "rsqrt", "cbrt", "power", "logistic", "sine",
+    "cosine", "tan", "erf",
+})
+
+_COLLECTIVE_BASES = ("all-reduce", "reduce-scatter", "all-gather",
+                     "all-to-all", "collective-permute")
+
+# opcodes that do real work between an async collective's start and done
+# (data movement like copy/bitcast/tuple does not hide latency)
+_COMPUTE_OPS = frozenset(
+    {"dot", "convolution", "fusion", "custom-call", "reduce",
+     "reduce-window", "scatter", "gather", "sort", "while", "call",
+     "conditional", "cholesky", "triangular-solve"}
+    | _ELEMENTWISE | _TRANSCENDENTAL)
+
+
+def _elems(dims: str) -> int:
+    n = 1
+    for d in dims.split(","):
+        if d:
+            n *= int(d)
+    return n
+
+
+def _shape_tokens(expr: str) -> List[Tuple[str, int]]:
+    """Every (dtype, element_count) in a type expression (handles
+    tuples)."""
+    return [(dt, _elems(dims)) for dt, dims in _SHAPE_RE.findall(expr)]
+
+
+def _type_bytes(expr: str) -> int:
+    return sum(n * _DTYPE_BYTES.get(dt, 4) for dt, n in _shape_tokens(expr))
+
+
+def _balanced_operands(line: str, open_idx: int) -> Tuple[str, str]:
+    """Split an instruction line at the opcode's argument list: returns
+    (operands_text, trailing_attrs_text).  ``open_idx`` is the index of
+    the opening paren."""
+    depth = 0
+    for i in range(open_idx, len(line)):
+        ch = line[i]
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth == 0:
+                return line[open_idx + 1:i], line[i + 1:]
+    return line[open_idx + 1:], ""
+
+
+class HloInstr:
+    """One parsed HLO instruction."""
+
+    __slots__ = ("name", "opcode", "result_type", "result_dtype",
+                 "result_bytes", "operands", "operand_shapes", "attrs",
+                 "computation")
+
+    def __init__(self, name, opcode, result_type, operands, attrs,
+                 computation):
+        self.name = name
+        self.opcode = opcode
+        self.result_type = result_type
+        toks = _SHAPE_RE.findall(result_type)
+        self.result_dtype = toks[0][0] if toks else "?"
+        self.result_bytes = _type_bytes(result_type)
+        self.operands = operands
+        # [(dtype, [dims...]), ...] in operand order
+        self.operand_shapes = [
+            (dt, [int(d) for d in dims.split(",") if d])
+            for dt, dims in _SHAPE_RE.findall(operands)]
+        self.attrs = attrs
+        self.computation = computation
+
+    def __repr__(self):
+        return "<HloInstr %s = %s (%s)>" % (self.name, self.opcode,
+                                            self.result_type)
+
+
+def iter_instructions(hlo_text: str) -> Iterator[HloInstr]:
+    """Parse every instruction line of an HLO module dump, tracking which
+    computation (ENTRY, fused_computation, region, ...) each belongs to.
+    Fusion bodies are listed as their own computations, so their inner
+    dot/convolution instructions are visible — which is exactly what the
+    per-op-class accounting wants."""
+    computation = ""
+    for line in hlo_text.splitlines():
+        stripped = line.strip()
+        if stripped.endswith("{") and ("->" in stripped
+                                       or stripped.startswith("ENTRY")):
+            head = stripped.split("(", 1)[0].strip()
+            computation = head.lstrip("%") or "?"
+            continue
+        m = _INSTR_RE.match(line)
+        if not m:
+            continue
+        name, rtype, opcode = m.groups()
+        operands, attrs = _balanced_operands(line, m.end() - 1)
+        yield HloInstr(name, opcode, rtype, operands, attrs, computation)
+
+
+# ---------------------------------------------------------------------------
+# analytic FLOPs
+# ---------------------------------------------------------------------------
+
+def _dot_flops(ins: HloInstr) -> int:
+    out_elems = sum(n for _, n in _shape_tokens(ins.result_type))
+    m = re.search(r"lhs_contracting_dims=\{([\d,]*)\}", ins.attrs)
+    if not m or not ins.operand_shapes:
+        return 2 * out_elems          # degenerate: no contraction info
+    lhs_dims = ins.operand_shapes[0][1]
+    k = 1
+    for idx in (int(d) for d in m.group(1).split(",") if d):
+        if idx < len(lhs_dims):
+            k *= lhs_dims[idx]
+    return 2 * out_elems * max(1, k)
+
+
+def _parse_window(attrs: str, n: int):
+    """(size, stride, pad_lo, pad_hi, lhs_dilate, rhs_dilate) per spatial
+    dim from a ``window={...}`` spec; None when unparsable."""
+    m = re.search(r"window=\{([^}]*)\}", attrs)
+    fields = {}
+    if m:
+        for part in m.group(1).split():
+            if "=" in part:
+                k, v = part.split("=", 1)
+                fields[k] = v
+
+    def ints(key, default):
+        v = fields.get(key)
+        if v is None:
+            return [default] * n
+        return [int(t) for t in v.split("x") if t]
+
+    size = ints("size", 1)
+    if len(size) != n:
+        return None
+    pad = fields.get("pad")
+    if pad is None:
+        plo, phi = [0] * n, [0] * n
+    else:
+        plo, phi = [], []
+        for t in pad.split("x"):
+            lo, hi = t.split("_")
+            plo.append(int(lo))
+            phi.append(int(hi))
+        if len(plo) != n:
+            return None
+    return (size, ints("stride", 1), plo, phi,
+            ints("lhs_dilate", 1), ints("rhs_dilate", 1))
+
+
+def _dim_valid_taps(I, k, plo, phi, s, ld, rd):
+    """Count (output position, kernel tap) pairs that land on a real
+    input element along one spatial dim — in bounds AND not a zero hole
+    interleaved by lhs dilation.  This is the per-dim factor XLA's
+    HloCostAnalysis multiplies into conv flops, so padded borders and
+    strided-conv gradients (lhs_dilate) cost what they actually cost."""
+    Id = (I - 1) * ld + 1 if I > 0 else 0
+    ke = (k - 1) * rd + 1
+    O = (Id + plo + phi - ke) // s + 1
+    valid = 0
+    for o in range(max(0, O)):
+        start = o * s - plo
+        for j in range(k):
+            pos = start + j * rd
+            if 0 <= pos < Id and pos % ld == 0:
+                valid += 1
+    return valid
+
+
+def _conv_flops(ins: HloInstr) -> int:
+    """2 · batch · out_features · kernel_in_features · valid spatial
+    taps, matching ``HloCostAnalysis::HandleConvolution`` (grouping folds
+    in through the kernel's input-feature extent)."""
+    out_toks = _SHAPE_RE.findall(ins.result_type)
+    out_elems = sum(n for _, n in _shape_tokens(ins.result_type))
+    m = re.search(r"dim_labels=([\w?]+)_([\w?]+)->([\w?]+)", ins.attrs)
+    if not m or len(ins.operand_shapes) < 2 or not out_toks:
+        return 2 * out_elems
+    lhs_spec, ker_spec, out_spec = m.groups()
+    lhs = ins.operand_shapes[0][1]
+    ker = ins.operand_shapes[1][1]
+    out_dims = [int(d) for d in out_toks[0][1].split(",") if d]
+    if len(lhs) != len(lhs_spec) or len(ker) != len(ker_spec) \
+            or len(out_dims) != len(out_spec):
+        return 2 * out_elems
+    digits = [c for c in lhs_spec if c.isdigit()]
+    win = _parse_window(ins.attrs, len(digits))
+    if win is None:
+        return 2 * out_elems
+    size, stride, plo, phi, ld, rd = win
+    batch = out_dims[out_spec.index("b")] if "b" in out_spec else 1
+    out_f = out_dims[out_spec.index("f")] if "f" in out_spec else 1
+    ker_i = ker[ker_spec.index("i")] if "i" in ker_spec else 1
+    valid = 1
+    for si, c in enumerate(digits):
+        valid *= _dim_valid_taps(lhs[lhs_spec.index(c)], size[si],
+                                 plo[si], phi[si], stride[si], ld[si],
+                                 rd[si])
+    return 2 * batch * out_f * ker_i * valid
+
+
+def analytic_flops(hlo_text: str) -> Dict[str, float]:
+    """``{"flops": total, "transcendentals": total, "by_op": {...}}`` —
+    the pre-execution FLOP model over the optimized module.  Note: a
+    while-loop body is counted ONCE (trip counts are dynamic); the
+    repo's hot programs are scan-free unrolled steps where this is
+    exact."""
+    flops = 0
+    trans = 0
+    by_op: Dict[str, float] = {}
+    for ins in iter_instructions(hlo_text):
+        op = ins.opcode
+        base = op[:-len("-start")] if op.endswith("-start") else op
+        if op == "dot":
+            f = _dot_flops(ins)
+        elif op == "convolution":
+            f = _conv_flops(ins)
+        elif base in ("all-reduce", "reduce-scatter"):
+            # HloCostAnalysis charges the reduction one flop per output
+            # element; '-done' carries no work of its own
+            f = sum(n for _, n in _shape_tokens(ins.result_type))
+        elif op in _ELEMENTWISE:
+            f = sum(n for _, n in _shape_tokens(ins.result_type))
+        elif op in ("reduce", "reduce-window"):
+            # one flop per reduced input element (first operand)
+            f = _prod(ins.operand_shapes[0][1]) if ins.operand_shapes \
+                else sum(n for _, n in _shape_tokens(ins.result_type))
+        elif op in _TRANSCENDENTAL:
+            trans += sum(n for _, n in _shape_tokens(ins.result_type))
+            continue
+        else:
+            continue
+        flops += f
+        by_op[op] = by_op.get(op, 0) + f
+    return {"flops": float(flops), "transcendentals": float(trans),
+            "by_op": {k: float(v) for k, v in
+                      sorted(by_op.items(), key=lambda kv: -kv[1])}}
+
+
+def _prod(dims):
+    n = 1
+    for d in dims:
+        n *= d
+    return n
+
+
+# ---------------------------------------------------------------------------
+# instruction bytes by op class × dtype
+# ---------------------------------------------------------------------------
+
+_SKIP_BYTE_OPS = frozenset({
+    # zero-cost views / bookkeeping: counting them as byte traffic would
+    # double every value once per alias
+    "bitcast", "tuple", "get-tuple-element", "parameter", "constant",
+    "after-all", "partition-id", "replica-id", "opt-barrier",
+})
+
+
+def instruction_bytes(hlo_text: str) -> Dict[str, Dict[str, int]]:
+    """Result bytes per op class, split by dtype:
+    ``{opcode: {dtype: bytes}}``.  This is "instruction bytes" in the
+    PERF.md r4/r5 sense — a per-op-class traffic proxy over the whole
+    module (fusion bodies included), NOT the deduplicated HBM footprint
+    (use ``Compiled.cost_analysis()['bytes accessed']`` for the roofline
+    number)."""
+    out: Dict[str, Dict[str, int]] = {}
+    for ins in iter_instructions(hlo_text):
+        if ins.opcode in _SKIP_BYTE_OPS or not ins.result_bytes:
+            continue
+        slot = out.setdefault(ins.opcode, {})
+        slot[ins.result_dtype] = slot.get(ins.result_dtype, 0) \
+            + ins.result_bytes
+    return out
+
+
+def bytes_by_dtype(per_class: Dict[str, Dict[str, int]]) -> Dict[str, int]:
+    """Collapse the per-class table to the f32-vs-bf16 (etc.) split."""
+    out: Dict[str, int] = {}
+    for dts in per_class.values():
+        for dt, b in dts.items():
+            out[dt] = out.get(dt, 0) + b
+    return dict(sorted(out.items(), key=lambda kv: -kv[1]))
+
+
+def top_contributors(per_class: Dict[str, Dict[str, int]],
+                     n: int = 10) -> List[Dict]:
+    """The top-N ``(op class, dtype)`` byte contributors, largest
+    first — the "name the top-3" table a perf round opens with."""
+    flat = [{"op": op, "dtype": dt, "bytes": b}
+            for op, dts in per_class.items() for dt, b in dts.items()]
+    flat.sort(key=lambda e: -e["bytes"])
+    return flat[:n]
+
+
+# ---------------------------------------------------------------------------
+# collective/compute overlap
+# ---------------------------------------------------------------------------
+
+def collective_compute_overlap(hlo_text: str) -> Dict:
+    """Static overlap instrument: of the module's collective payload
+    bytes, how much is issued as an async ``-start`` whose matching
+    ``-done`` has at least one real compute instruction scheduled in
+    between (i.e. XLA gave the transfer latency something to hide
+    behind)?  Synchronous collectives count as unoverlapped.
+
+    Returns ``{"collective_bytes", "overlapped_bytes", "overlap_pct",
+    "async_ops", "sync_ops", "by_kind"}``; ``overlap_pct`` is None when
+    the program has no collectives."""
+    total = 0
+    overlapped = 0
+    async_ops = 0
+    sync_ops = 0
+    by_kind: Dict[str, Dict[str, int]] = {}
+    # per-computation schedule walk
+    open_starts: Dict[Tuple[str, str], dict] = {}
+
+    def kind_slot(kind):
+        return by_kind.setdefault(kind, {"bytes": 0, "overlapped": 0,
+                                         "async": 0, "sync": 0})
+
+    for ins in iter_instructions(hlo_text):
+        op = ins.opcode
+        base = op
+        is_start = op.endswith("-start")
+        is_done = op.endswith("-done")
+        if is_start:
+            base = op[:-len("-start")]
+        elif is_done:
+            base = op[:-len("-done")]
+        if base in _COLLECTIVE_BASES:
+            if is_done:
+                # match by operand reference to the -start's name
+                for (comp, sname), rec in list(open_starts.items()):
+                    if comp == ins.computation and \
+                            "%" + sname in ins.operands:
+                        if rec["compute_between"]:
+                            overlapped += rec["bytes"]
+                            kind_slot(base)["overlapped"] += rec["bytes"]
+                        del open_starts[(comp, sname)]
+                        break
+                continue
+            payload = _type_bytes(ins.operands)
+            total += payload
+            slot = kind_slot(base)
+            slot["bytes"] += payload
+            if is_start:
+                async_ops += 1
+                slot["async"] += 1
+                open_starts[(ins.computation, ins.name)] = {
+                    "bytes": payload, "compute_between": False}
+            else:
+                sync_ops += 1
+                slot["sync"] += 1
+            continue
+        if op in _COMPUTE_OPS:
+            for rec in open_starts.values():
+                rec["compute_between"] = True
+    return {
+        "collective_bytes": total,
+        "overlapped_bytes": overlapped,
+        "overlap_pct": round(100.0 * overlapped / total, 2) if total
+        else None,
+        "async_ops": async_ops,
+        "sync_ops": sync_ops,
+        "by_kind": by_kind,
+    }
+
+
+# ---------------------------------------------------------------------------
+# roofline
+# ---------------------------------------------------------------------------
+
+def chip_peaks() -> Dict[str, float]:
+    """Per-chip peak rates the roofline normalizes against.  Defaults are
+    TPU v5e (bf16): 197 TFLOP/s, 819 GB/s HBM, 2×45 GB/s ICI per link —
+    override with ``BENCH_PEAK_TFLOPS`` / ``MXNET_TPU_PEAK_HBM_GBS`` /
+    ``MXNET_TPU_PEAK_ICI_GBS`` (bench.py already owns the first knob; the
+    attribution plane reads the same one so MFU can never disagree)."""
+    def envf(name, default):
+        try:
+            return float(os.environ[name])
+        except (KeyError, ValueError):
+            return default
+    return {
+        "flops": envf("BENCH_PEAK_TFLOPS", 197.0) * 1e12,
+        "hbm_bytes_s": envf("MXNET_TPU_PEAK_HBM_GBS", 819.0) * 1e9,
+        "ici_bytes_s": envf("MXNET_TPU_PEAK_ICI_GBS", 90.0) * 1e9,
+    }
+
+
+def roofline(flops: float, hbm_bytes: float, collective_wire_bytes: float,
+             peaks: Optional[Dict[str, float]] = None,
+             measured_step_s: Optional[float] = None) -> Dict:
+    """Peak-normalized component times and the binding roof.
+
+    ``measured_step_s`` (when known) anchors the shares: each share is
+    that component's lower-bound time over the measured step, and the
+    residue the device math cannot explain is the host-bound share.
+    Without a measurement the shares are relative to the slowest
+    component (pure static mode)."""
+    peaks = peaks or chip_peaks()
+    compute_s = flops / peaks["flops"] if peaks["flops"] else 0.0
+    hbm_s = hbm_bytes / peaks["hbm_bytes_s"] if peaks["hbm_bytes_s"] \
+        else 0.0
+    coll_s = collective_wire_bytes / peaks["ici_bytes_s"] \
+        if peaks["ici_bytes_s"] else 0.0
+    comp = {"compute": compute_s, "hbm": hbm_s, "collective": coll_s}
+    device_roof = max(comp.values())
+    bound = max(comp, key=comp.get) if device_roof > 0 else "unknown"
+    out = {"compute_s": compute_s, "hbm_s": hbm_s, "collective_s": coll_s,
+           "device_roof_s": device_roof, "bound": bound,
+           "peaks": {k: peaks[k] for k in
+                     ("flops", "hbm_bytes_s", "ici_bytes_s")}}
+    denom = measured_step_s if measured_step_s else device_roof
+    if denom:
+        shares = {k: round(v / denom, 4) for k, v in comp.items()}
+        if measured_step_s:
+            host = max(0.0, 1.0 - device_roof / measured_step_s)
+            shares["host"] = round(host, 4)
+            if host > 0.5:
+                out["bound"] = "host"
+            out["measured_vs_analytic"] = round(
+                measured_step_s / device_roof, 3) if device_roof else None
+        out["shares"] = shares
+    return out

@@ -211,6 +211,11 @@ class ServedProgram:
             arrays, meta, blobs = read_container(path)
             prog = cls(arrays, meta, blobs)
         telemetry.count("deploy.loads")
+        # opt-in attribution of the serving program (static: the exec
+        # side is measured by ServingRuntime's exec histogram instead)
+        telemetry.perf.maybe_attribute(
+            prog._compiled,
+            "ServedProgram(%s)" % os.path.basename(os.fspath(path)))
         return prog
 
     def forward(self, **inputs):

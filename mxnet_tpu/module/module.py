@@ -258,6 +258,10 @@ class Module(BaseModule):
             from ..analysis import preflight as _preflight
             if _preflight.enabled():
                 _preflight.run_module_preflight(self)
+            # opt-in attribution (MXNET_TPU_ATTRIBUTION=1): roofline/MFU
+            # report for the bound program, same forensics dir
+            from ..telemetry import perf as _perf
+            _perf.maybe_attribute_module(self)
 
         if shared_module is not None and shared_module.params_initialized:
             self._arg_params, self._aux_params = (shared_module._arg_params,

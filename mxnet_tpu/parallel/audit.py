@@ -181,4 +181,15 @@ def audit_report(tag, hlo_text, n_devices, params=None, ring_n=None):
         measured = acct.get("all-reduce", {}).get("bytes", 0)
         text += " | analytic grad payload %.2f MB (measured/model = %.2f)" \
             % (model / 1e6, measured / model if model else float("nan"))
+    if acct:
+        # collective/compute overlap: the standing instrument behind the
+        # "collectives overlap compute, spans prove it" perf criterion
+        # (telemetry/perf.py folds the same number into attribution
+        # reports)
+        from ..analysis import costmodel
+        ov = costmodel.collective_compute_overlap(hlo_text)
+        if ov["overlap_pct"] is not None:
+            text += " | collective/compute overlap %.1f%% " \
+                "(%d async, %d sync)" % (ov["overlap_pct"],
+                                         ov["async_ops"], ov["sync_ops"])
     return text, acct

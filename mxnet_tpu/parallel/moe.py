@@ -143,4 +143,7 @@ def moe_ffn(x, wg, w1, w2, mesh: Mesh, axis: str = "ep",
                       bytes=a2a_bytes)
     record_collective("all-reduce", "parallel.moe_ffn aux-loss pmean",
                       bytes=4)
+    from ..telemetry import perf as _perf
+    _perf.maybe_attribute_fn(sharded, (x, wg, w1, w2), "moe_ffn",
+                             n_devices=n_dev)
     return out

@@ -113,6 +113,9 @@ def pipeline_apply(stage_fn: Callable, num_stages: int, mesh: Mesh,
                       bytes=hop_bytes)
     record_collective("all-reduce", "parallel.pipeline_apply output psum",
                       bytes=act_bytes)
+    from ..telemetry import perf as _perf
+    _perf.maybe_attribute_fn(mapped, (params_sharded, x_rep),
+                             "pipeline_apply", n_devices=S)
     return out
 
 

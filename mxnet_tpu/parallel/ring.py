@@ -132,6 +132,9 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
         out = jax.jit(mapped)(q, k, v)
     record_collective("collective-permute", "parallel.ring_attention",
                       bytes=kv_bytes)
+    from ..telemetry import perf as _perf
+    _perf.maybe_attribute_fn(mapped, (q, k, v), "ring_attention",
+                             n_devices=n)
     return out
 
 

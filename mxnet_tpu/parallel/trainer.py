@@ -108,6 +108,10 @@ class ShardedTrainer:
         # device executes it; runs once per trainer.
         self._step_donated = True
         self._preflight_done = False
+        # -- attribution (telemetry/perf.py): with MXNET_TPU_ATTRIBUTION=1
+        # one roofline/MFU report per step program, written a few steps in
+        # so the telemetry histograms carry real measurements.
+        self._attribution_done = False
 
     # -- tensor-parallel sharding rules -----------------------------------
     def param_sharding(self, name: str, shape) -> NamedSharding:
@@ -335,7 +339,11 @@ class ShardedTrainer:
         the donated update aliases cleanly.  Batch inputs and rng keys
         keep default layouts (they arrive fresh from the host each
         step)."""
-        from jax.experimental.layout import Format, Layout
+        try:
+            from jax.experimental.layout import Format, Layout
+        except ImportError:     # jax <= 0.4.x: pre-rename names
+            from jax.experimental.layout import (
+                DeviceLocalLayout as Layout, Layout as Format)
 
         step_fn = self._make_step_fn()
         rep = self.spec.replicated()
@@ -370,7 +378,15 @@ class ShardedTrainer:
                 tuple(sds(p) for p in params), tuple(sds(m) for m in mom),
                 tuple(sds(a) for a in aux), inputs, sds(keys),
                 (sds(guard[0]), sds(guard[1]))).compile()
-        p_fmt, m_fmt, a_fmt = compiled.input_formats[0][:3]
+        from ..telemetry import perf as _perf
+        _perf.maybe_attribute(
+            compiled,
+            "ShardedTrainer.auto_layout(%s)" % (self.symbol.name
+                                                or "symbol"),
+            n_devices=self.spec.mesh.size, ring_n=self.spec.dp_size)
+        fmts = getattr(compiled, "input_formats",
+                       None) or compiled.input_layouts
+        p_fmt, m_fmt, a_fmt = fmts[0][:3]
         params = tuple(jax.device_put(p, f) for p, f in zip(params, p_fmt))
         mom = tuple(jax.device_put(m, f) for m, f in zip(mom, m_fmt))
         aux = tuple(jax.device_put(a, f) for a, f in zip(aux, a_fmt))
@@ -414,6 +430,7 @@ class ShardedTrainer:
                                 step=self._step_count):
             _chaos.maybe_hang(self._step_count)
             with _tel.span("train/host_enqueue", cat="train",
+                           metric="train.host_enqueue_seconds",
                            step=self._step_count):
                 inputs = {n: jax.device_put(v, self.spec.batch_sharding())
                           for n, v in batch.items()}
@@ -427,6 +444,7 @@ class ShardedTrainer:
             # only when spans record — the disarmed hot path keeps the
             # pipelined async dispatch untouched.
             with _tel.span("train/device_wait", cat="train",
+                           metric="train.device_wait_seconds",
                            step=self._step_count) as _dw:
                 if _dw.active:
                     jax.block_until_ready((loss, ok))
@@ -437,7 +455,39 @@ class ShardedTrainer:
                           step=self._step_count, bytes=self._grad_bytes())
         _watchdog.heartbeat(self._step_count)
         _tel.window_tick()
+        self._maybe_attribute_step(params, mom, aux, inputs, keys)
         return params, mom, aux, loss
+
+    def _maybe_attribute_step(self, params, mom, aux, inputs, keys):
+        """Opt-in attribution of the lazily-jitted step program (the
+        build_step_auto_layout path attributes its Compiled directly).
+        Runs once, a few steps in (MXNET_TPU_ATTRIBUTION_AFTER), so the
+        train.step_seconds/host_enqueue/device_wait histograms already
+        hold measurements for the report's measured side."""
+        from ..telemetry import perf as _perf
+        if self._attribution_done or not _perf.enabled():
+            return
+        if self._step_count < _perf.attribute_after_steps():
+            return
+        self._attribution_done = True
+
+        def sds(x):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+
+        try:
+            structs = jax.tree_util.tree_map(
+                sds, (params, mom, aux, inputs, keys,
+                      self._guard_arrays()))
+            compiled = self._step.lower(*structs).compile()
+        except Exception:
+            import logging
+            logging.exception("attribution: step lowering failed "
+                              "(continuing)")
+            return
+        _perf.maybe_attribute(
+            compiled,
+            "ShardedTrainer.step(%s)" % (self.symbol.name or "symbol"),
+            n_devices=self.spec.mesh.size, ring_n=self.spec.dp_size)
 
     def _grad_bytes(self):
         """Analytic dp all-reduce payload (f32 grads), cached — feeds the

@@ -103,6 +103,20 @@ def record_event(name: str, start_us: float, dur_us: float, cat="operator",
     _buf().append(ev)
 
 
+def record_counter(name: str, values: dict, ts_us: Optional[float] = None,
+                   pid: int = 2):
+    """Counter-track event (``ph: "C"``) in the merged trace — the
+    attribution plane's roofline/MFU headline numbers ride these so
+    Perfetto shows them as tracks above the span timeline (pid 2: their
+    own process group, clear of real threads and serving lanes)."""
+    if not _state["running"]:
+        return
+    _buf().append({"name": name, "cat": "counter", "ph": "C",
+                   "ts": time.perf_counter() * 1e6 if ts_us is None
+                   else ts_us,
+                   "pid": pid, "tid": 0, "args": dict(values)})
+
+
 def dump_profile():
     """reference: MXDumpProfile — write the merged Chrome trace JSON.
 

@@ -167,6 +167,7 @@ class ServingRuntime:
         self._exec_hist = telemetry.Histogram(
             "serve.exec_seconds", registered=False, always=True)
         self._exec_ewma = 0.0
+        self._t_started = time.time()    # device-utilization denominator
         self._seq = 0
         self._batch_seq = 0
         self._wd: Optional[Watchdog] = None
@@ -329,6 +330,13 @@ class ServingRuntime:
             "breaker": self._breaker.describe(),
             "counters": counters,
         }
+        # device-utilization ratio from the attribution plane's exec
+        # spans: time the executor spent running batches / wall time
+        # since the runtime started (additive schema; an idle runtime
+        # reads 0.0, a saturated one approaches 1.0)
+        wall = max(1e-9, time.time() - self._t_started)
+        busy = self._exec_hist.summary()["sum"]
+        out["device_utilization"] = round(min(1.0, busy / wall), 4)
         # percentiles come from the telemetry histogram — single source
         # of truth shared with servebench (schema unchanged)
         lat = self._lat_hist.summary()

@@ -12,6 +12,11 @@ Three pieces (full catalog + knobs in docs/observability.md):
 * :mod:`.digest` — compact per-rank digests piggybacked on the PR-2
   heartbeat lane; rank 0 renders a fleet view and finds stragglers by
   step-time skew.
+* :mod:`.perf` — the performance attribution plane: automatic
+  roofline/MFU accounting per compiled program
+  (``MXNET_TPU_ATTRIBUTION=1``), combining the
+  :mod:`~mxnet_tpu.analysis.costmodel` analytics with the step/span
+  histograms above.
 
 Quick start::
 
@@ -29,6 +34,7 @@ from .registry import (DEFAULT_BUCKETS, Counter, Gauge, Histogram, arm,
                        reset_metrics, set_gauge, snapshot, window_tick)
 from .spans import open_spans, record_span, span, spans_active
 from .digest import fleet_view, rank_digest, render_fleet
+from . import perf
 
 __all__ = [
     "DEFAULT_BUCKETS", "Counter", "Gauge", "Histogram", "arm", "count",
@@ -37,6 +43,7 @@ __all__ = [
     "reset_metrics", "set_gauge", "snapshot", "window_tick",
     "open_spans", "record_span", "span", "spans_active",
     "fleet_view", "rank_digest", "render_fleet",
+    "perf",
 ]
 
 

@@ -1,0 +1,492 @@
+"""Performance attribution plane: automatic roofline/MFU accounting.
+
+Every perf round so far (PERF.md r2-r5) re-derived the same numbers by
+hand: HLO FLOPs, HBM bytes by op class, copy counts, collective
+payloads, roofline shares.  This module makes that accounting an
+always-available instrument: point it at any compiled program and it
+emits one **attribution report** combining
+
+* the static analytics from :mod:`mxnet_tpu.analysis.costmodel`
+  (analytic FLOPs, instruction bytes by op class × dtype with the
+  f32-vs-bf16 split, collective payloads + wire model, static
+  collective/compute overlap),
+* XLA's own ``Compiled.cost_analysis()`` (flops / bytes-accessed — the
+  5%-agreement cross-check is CI-enforced), and
+* the measured side from the telemetry layer: the ``train.step_seconds``
+  histogram and the host-enqueue vs device-block span split recorded by
+  ``ShardedTrainer.step``
+
+into roofline position (compute- vs HBM- vs collective- vs host-bound),
+MFU vs chip peak, top-N byte/FLOP contributors, and the
+measured-vs-analytic step-time ratio.  Rendered as JSON (atomic write,
+``analysis/report.py`` discipline), pretty text, and a Perfetto counter
+track that drops into the merged trace.
+
+Wire-up (``MXNET_TPU_ATTRIBUTION=1``): every compiled entry point —
+``ShardedTrainer`` step (lazy jit and ``build_step_auto_layout``),
+``Module.bind``, the ring/pipeline/moe collectives, ``ServedProgram``
+— writes one report per distinct program into the watchdog/preflight
+report dir (``attribution-<name>-*.json``).  Each is attributed ONCE
+per (name, input signature); the hooks never raise into the entry
+point.  ``bench.py`` calls :func:`attribute_compiled` directly and
+embeds :func:`phases_block` in its JSON line.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+__all__ = ["AttributionReport", "attribute_after_steps",
+           "attribute_compiled", "attribute_fn", "attribute_module",
+           "enabled", "maybe_attribute", "maybe_attribute_fn",
+           "maybe_attribute_module", "phases_block", "report_dir",
+           "reset_attributed"]
+
+_SEQ = [0]
+_DONE_LOCK = threading.Lock()
+_DONE = set()          # (name, signature) pairs already attributed
+
+
+def enabled() -> bool:
+    return os.environ.get("MXNET_TPU_ATTRIBUTION", "0") not in (
+        "0", "", "false", "off")
+
+
+def attribute_after_steps() -> int:
+    """How many steps the trainer hook waits before attributing (so the
+    step histograms hold real samples); MXNET_TPU_ATTRIBUTION_AFTER."""
+    try:
+        return max(1, int(os.environ.get("MXNET_TPU_ATTRIBUTION_AFTER",
+                                         "3")))
+    except ValueError:
+        return 3
+
+
+def report_dir() -> str:
+    """Same forensics directory as preflight reports and watchdog
+    post-mortems: one place to look."""
+    explicit = os.environ.get("MXNET_TPU_ATTRIBUTION_DIR")
+    if explicit:
+        return explicit
+    from ..analysis import preflight as _preflight
+    return _preflight.report_dir()
+
+
+class AttributionReport:
+    """One program's attribution: analytics + measurement, renderable as
+    JSON / pretty text / a Perfetto counter track."""
+
+    def __init__(self, data: Dict):
+        self.data = data
+
+    # -- accessors used by gates/tests ---------------------------------
+    @property
+    def program(self) -> str:
+        return self.data.get("program", "?")
+
+    @property
+    def mfu(self) -> Optional[float]:
+        return self.data.get("step", {}).get("mfu")
+
+    def to_dict(self) -> Dict:
+        return self.data
+
+    def to_json(self, indent: int = 2) -> str:
+        return json.dumps(self.data, indent=indent, default=repr)
+
+    @classmethod
+    def load(cls, path: str) -> "AttributionReport":
+        with open(path) as f:
+            return cls(json.load(f))
+
+    def save(self, path: str) -> str:
+        """Atomic JSON write (temp+replace, analysis/report.py model)."""
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(self.to_json())
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return path
+
+    def perfetto_counters(self, ts_us: Optional[float] = None) -> list:
+        """Chrome-trace counter events (``ph: "C"``) for the headline
+        numbers — merged into the profiler trace when it runs, so the
+        roofline shares sit as counter tracks above the span timeline."""
+        ts = time.perf_counter() * 1e6 if ts_us is None else ts_us
+        shares = self.data.get("roofline", {}).get("shares", {})
+        events = []
+        base = "attribution/%s" % self.program
+        if shares:
+            events.append({"name": base + "/roofline_share", "ph": "C",
+                           "ts": ts, "pid": 2, "tid": 0,
+                           "args": {k: shares[k] for k in sorted(shares)}})
+        step = self.data.get("step", {})
+        vals = {k: step[k] for k in ("mfu", "measured_s")
+                if step.get(k) is not None}
+        if vals:
+            events.append({"name": base + "/step", "ph": "C", "ts": ts,
+                           "pid": 2, "tid": 0, "args": vals})
+        ov = self.data.get("overlap", {})
+        if ov.get("overlap_pct") is not None:
+            events.append({"name": base + "/overlap_pct", "ph": "C",
+                           "ts": ts, "pid": 2, "tid": 0,
+                           "args": {"pct": ov["overlap_pct"]}})
+        return events
+
+    def pretty(self) -> str:
+        d = self.data
+        rule = "=" * 72
+        lines = [rule, "ATTRIBUTION %s" % d.get("program", "?"), rule]
+        topo = d.get("topology", {})
+        lines.append("topology: %s %s x%d" % (
+            topo.get("platform", "?"), topo.get("device_kind", "?"),
+            topo.get("n_devices", 1)))
+        a = d.get("analytic", {})
+        hc = d.get("hlo_cost", {})
+        lines.append(
+            "flops/device-step: analytic %.3e | XLA cost analysis %s "
+            "(ratio %s)" % (
+                a.get("flops", 0.0),
+                ("%.3e" % hc["flops"]) if hc.get("flops") else "n/a",
+                hc.get("flops_ratio_analytic_vs_hlo", "n/a")))
+        lines.append("bytes: instruction %.3e | HBM accessed %s" % (
+            a.get("instruction_bytes_total", 0.0),
+            ("%.3e" % hc["bytes_accessed"]) if hc.get("bytes_accessed")
+            else "n/a"))
+        split = a.get("bytes_by_dtype", {})
+        if split:
+            lines.append("dtype split: " + ", ".join(
+                "%s %.2f GB" % (dt, b / 1e9) for dt, b in split.items()))
+        for i, c in enumerate(a.get("top_contributors", [])[:5]):
+            lines.append("  top%d  %-24s %-5s %10.3f MB"
+                         % (i + 1, c["op"], c["dtype"], c["bytes"] / 1e6))
+        coll = a.get("collectives") or {}
+        for kind in sorted(coll):
+            info = coll[kind]
+            lines.append("collective %-20s %3d ops  %.2f MB payload"
+                         % (kind, info["count"], info["bytes"] / 1e6))
+        ov = d.get("overlap", {})
+        if ov.get("overlap_pct") is not None:
+            lines.append("collective/compute overlap: %.1f%% of %.2f MB "
+                         "(%d async / %d sync ops)"
+                         % (ov["overlap_pct"],
+                            ov["collective_bytes"] / 1e6,
+                            ov["async_ops"], ov["sync_ops"]))
+        r = d.get("roofline", {})
+        if r:
+            lines.append(
+                "roofline: compute %.3es | hbm %.3es | collective %.3es "
+                "-> %s-bound" % (r.get("compute_s", 0.0),
+                                 r.get("hbm_s", 0.0),
+                                 r.get("collective_s", 0.0),
+                                 r.get("bound", "?")))
+            if r.get("shares"):
+                lines.append("shares of step: " + ", ".join(
+                    "%s %.0f%%" % (k, 100 * v)
+                    for k, v in sorted(r["shares"].items())))
+        s = d.get("step", {})
+        if s.get("measured_s"):
+            lines.append(
+                "step: measured %.4fs (host-enqueue %s, device-wait %s); "
+                "measured/analytic %s" % (
+                    s["measured_s"],
+                    "%.4fs" % s["host_enqueue_s"]
+                    if s.get("host_enqueue_s") is not None else "n/a",
+                    "%.4fs" % s["device_wait_s"]
+                    if s.get("device_wait_s") is not None else "n/a",
+                    r.get("measured_vs_analytic", "n/a")))
+        if s.get("mfu") is not None:
+            lines.append("MFU vs chip peak: %.4f" % s["mfu"])
+        lines.append("")
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# core: attribute a compiled program
+# ---------------------------------------------------------------------------
+
+def _cost_analysis(compiled) -> Dict:
+    """Normalized ``Compiled.cost_analysis()``: {} when the executable
+    cannot report (e.g. a deserialized AOT artifact on some backends)."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return dict(ca) if ca else {}
+
+
+def _measured_from_telemetry():
+    """(step_s, host_s, device_s) medians from the registry histograms
+    ShardedTrainer.step feeds — None where nothing was observed."""
+    from . import registry as _registry
+
+    def p50(name):
+        try:
+            h = _registry.histogram(name)
+        except TypeError:
+            return None
+        ps = h.percentiles((0.5,))
+        return ps.get(0.5)
+
+    return (p50("train.step_seconds"), p50("train.host_enqueue_seconds"),
+            p50("train.device_wait_seconds"))
+
+
+def attribute_compiled(compiled, name: str, n_devices: int = 1,
+                       ring_n: Optional[int] = None,
+                       measured_step_s: Optional[float] = None,
+                       host_s: Optional[float] = None,
+                       device_s: Optional[float] = None,
+                       hlo_text: Optional[str] = None,
+                       extra: Optional[Dict] = None) -> AttributionReport:
+    """Build the attribution report for one compiled program.
+
+    ``measured_step_s`` anchors the roofline shares and MFU; when None
+    the telemetry ``train.step_seconds`` histogram is consulted (armed
+    runs), else the report is static-only.  ``ring_n`` is the all-reduce
+    replica-group extent (the dp degree on dp×tp meshes) for the wire
+    model.  ``hlo_text`` skips the ``as_text()`` call when the caller
+    already has the dump."""
+    from ..analysis import costmodel
+    from ..parallel import audit
+
+    if hlo_text is None:
+        hlo_text = compiled.as_text()
+    ring_n = ring_n or n_devices
+
+    fl = costmodel.analytic_flops(hlo_text)
+    per_class = costmodel.instruction_bytes(hlo_text)
+    dtype_split = costmodel.bytes_by_dtype(per_class)
+    acct = audit.collective_accounting(hlo_text)
+    wire = 0
+    for kind, info in acct.items():
+        if kind == "all-reduce":
+            wire += audit.ring_allreduce_wire_bytes(info["bytes"], ring_n)
+        else:
+            wire += info["bytes"]
+    overlap = costmodel.collective_compute_overlap(hlo_text)
+
+    cost = _cost_analysis(compiled)
+    hlo_flops = cost.get("flops")
+    bytes_accessed = cost.get("bytes accessed")
+    hlo_cost = {}
+    if hlo_flops:
+        hlo_cost["flops"] = float(hlo_flops)
+        hlo_cost["flops_ratio_analytic_vs_hlo"] = round(
+            fl["flops"] / float(hlo_flops), 4) if hlo_flops else None
+    if bytes_accessed:
+        hlo_cost["bytes_accessed"] = float(bytes_accessed)
+
+    if measured_step_s is None and host_s is None and device_s is None:
+        measured_step_s, host_s, device_s = _measured_from_telemetry()
+
+    peaks = costmodel.chip_peaks()
+    # HBM roofline prefers XLA's deduplicated traffic number; the
+    # instruction-byte table is the per-class breakdown, not the roof
+    instr_total = sum(b for dts in per_class.values()
+                      for b in dts.values())
+    hbm_bytes = float(bytes_accessed) if bytes_accessed else \
+        float(instr_total)
+    roof = costmodel.roofline(fl["flops"], hbm_bytes, float(wire),
+                              peaks=peaks,
+                              measured_step_s=measured_step_s)
+
+    step: Dict = {}
+    if measured_step_s:
+        step["measured_s"] = round(float(measured_step_s), 6)
+        step["mfu"] = round(fl["flops"] / measured_step_s
+                            / peaks["flops"], 6)
+    if host_s is not None:
+        step["host_enqueue_s"] = round(float(host_s), 6)
+    if device_s is not None:
+        step["device_wait_s"] = round(float(device_s), 6)
+    if measured_step_s and host_s is not None:
+        step["host_share"] = round(float(host_s) / measured_step_s, 4)
+
+    topo = {"n_devices": int(n_devices), "ring_n": int(ring_n)}
+    try:
+        import jax
+        devs = jax.devices()
+        topo["platform"] = jax.default_backend()
+        topo["device_kind"] = devs[0].device_kind
+    except Exception:
+        pass
+
+    data = {
+        "kind": "attribution_report",
+        "program": name,
+        "time": time.time(),
+        "topology": topo,
+        "analytic": {
+            "flops": fl["flops"],
+            "transcendentals": fl["transcendentals"],
+            "flops_by_op": fl["by_op"],
+            "instruction_bytes": per_class,
+            "instruction_bytes_total": float(instr_total),
+            "bytes_by_dtype": dtype_split,
+            "top_contributors": costmodel.top_contributors(per_class),
+            "collectives": acct,
+            "collective_wire_bytes": int(wire),
+        },
+        "hlo_cost": hlo_cost,
+        "overlap": overlap,
+        "roofline": roof,
+        "step": step,
+    }
+    if extra:
+        data.update(extra)
+    return AttributionReport(data)
+
+
+def attribute_fn(fn, *args, name: str = "", n_devices: int = 1,
+                 **kwargs) -> AttributionReport:
+    """Jit-compile ``fn`` with example args and attribute the result
+    (ring/pipeline/moe-style callables; one extra compile)."""
+    import jax
+    compiled = jax.jit(fn).lower(*args).compile()
+    return attribute_compiled(compiled, name or getattr(fn, "__name__",
+                                                        "fn"),
+                              n_devices=n_devices, **kwargs)
+
+
+def attribute_module(module) -> AttributionReport:
+    """Attribute a bound Module's fused forward program (the
+    executor-path entry point; mirrors graphcheck.check_executor)."""
+    import jax
+    executor = module._exec_group.execs[0]
+    prog = executor._prog
+    args = tuple(a._handle for a in executor.arg_arrays)
+    aux = tuple(a._handle for a in executor.aux_arrays)
+    keys = executor._keys()
+    fwd = prog._jit_forward(bool(module.for_training))
+    compiled = jax.jit(fwd).lower(args, aux, keys).compile()
+    return attribute_compiled(
+        compiled, "Module(%s)" % (executor._symbol.name or "symbol"))
+
+
+# ---------------------------------------------------------------------------
+# gated entry-point hooks (never raise into the caller)
+# ---------------------------------------------------------------------------
+
+def _write(report: AttributionReport, name: str) -> str:
+    d = report_dir()
+    os.makedirs(d, exist_ok=True)
+    _SEQ[0] += 1
+    safe = "".join(ch if (ch.isalnum() or ch in "._-") else "_"
+                   for ch in name)
+    path = os.path.join(d, "attribution-%s-%d-%d.json"
+                        % (safe, os.getpid(), _SEQ[0]))
+    report.save(path)
+    from .. import profiler
+    if profiler.is_running():
+        for ev in report.perfetto_counters():
+            profiler.record_counter(ev["name"], ev["args"], ts_us=ev["ts"])
+    return path
+
+
+def _once(name: str, signature) -> bool:
+    key = (name, signature)
+    with _DONE_LOCK:
+        if key in _DONE:
+            return False
+        _DONE.add(key)
+        return True
+
+
+def maybe_attribute(compiled, name: str, **kwargs) -> Optional[str]:
+    """Gated hook for entry points that already hold a Compiled: write
+    one report per program name into the forensics dir.  Returns the
+    path, or None (disabled / already done / attribution failed —
+    failures are logged, never raised)."""
+    if not enabled() or not _once(name, None):
+        return None
+    try:
+        rep = attribute_compiled(compiled, name, **kwargs)
+        path = _write(rep, name)
+        logging.info("attribution report for %s: %s", name, path)
+        return path
+    except Exception:
+        logging.exception("attribution failed for %s (continuing)", name)
+        return None
+
+
+def maybe_attribute_fn(fn, args, name: str, **kwargs) -> Optional[str]:
+    """Gated hook for callable entry points (ring/pipeline/moe): compile
+    once per (name, input signature) and write the report."""
+    if not enabled():
+        return None
+    try:
+        import jax
+        sig = tuple((tuple(x.shape), str(x.dtype))
+                    for x in jax.tree_util.tree_leaves(args)
+                    if hasattr(x, "shape"))
+        if not _once(name, sig):
+            return None
+        rep = attribute_fn(fn, *args, name=name, **kwargs)
+        path = _write(rep, name)
+        logging.info("attribution report for %s: %s", name, path)
+        return path
+    except Exception:
+        logging.exception("attribution failed for %s (continuing)", name)
+        return None
+
+
+def maybe_attribute_module(module) -> Optional[str]:
+    """Gated hook for ``Module.bind`` (one report per bound symbol +
+    shape set)."""
+    if not enabled():
+        return None
+    try:
+        executor = module._exec_group.execs[0]
+        name = "Module(%s)" % (executor._symbol.name or "symbol")
+        sig = tuple(tuple(a.shape) for a in executor.arg_arrays)
+        if not _once(name, sig):
+            return None
+        rep = attribute_module(module)
+        path = _write(rep, name)
+        logging.info("attribution report for %s: %s", name, path)
+        return path
+    except Exception:
+        logging.exception("attribution failed for Module.bind "
+                          "(continuing)")
+        return None
+
+
+def reset_attributed():
+    """Forget the attributed-programs memo (tests)."""
+    with _DONE_LOCK:
+        _DONE.clear()
+
+
+# ---------------------------------------------------------------------------
+# bench integration
+# ---------------------------------------------------------------------------
+
+def phases_block(report: AttributionReport,
+                 report_path: Optional[str] = None) -> Dict:
+    """The compact ``phases`` block bench.py embeds in its JSON line so
+    every BENCH_* artifact is self-describing: roofline shares, MFU,
+    overlap, and where the full report lives."""
+    d = report.to_dict()
+    roof = d.get("roofline", {})
+    shares = roof.get("shares", {})
+    out = {
+        "bound": roof.get("bound"),
+        "compute_share": shares.get("compute"),
+        "hbm_share": shares.get("hbm"),
+        "collective_share": shares.get("collective"),
+        "host_share": shares.get("host"),
+        "measured_vs_analytic": roof.get("measured_vs_analytic"),
+        "mfu": d.get("step", {}).get("mfu"),
+        "overlap_pct": d.get("overlap", {}).get("overlap_pct"),
+    }
+    if report_path:
+        out["report"] = report_path
+    return out

@@ -43,17 +43,35 @@ def timed_windows(step_once):
     return spans
 
 
-def report(tag, seq, batch, spans, flops_per_step):
+def report(tag, seq, batch, spans, flops_per_step, phases=None):
     toks = [batch * seq * ITERS / s for s in spans]
     mfus = [flops_per_step * ITERS / s / PEAK for s in spans]
-    print(json.dumps({
+    doc = {
         "program": tag, "seq": seq, "batch": batch,
         "tokens_per_sec_mean": round(statistics.mean(toks), 1),
         "tokens_per_sec_std": round(statistics.stdev(toks), 1),
         "mfu_mean": round(statistics.mean(mfus), 4),
         "mfu_std": round(statistics.stdev(mfus), 4),
         "windows": WINDOWS, "iters_per_window": ITERS,
-    }), flush=True)
+    }
+    if phases:
+        doc["phases"] = phases
+    print(json.dumps(doc), flush=True)
+
+
+def attribution_phases(step, measured_step_s):
+    """bench.py's phases block, reused here (satellite: every sweep line
+    is self-describing).  ``step`` must be an AOT Compiled (the
+    framework path); returns None for plain jitted callables."""
+    try:
+        if not hasattr(step, "as_text"):
+            return None
+        from mxnet_tpu.telemetry import perf as _perf
+        rep = _perf.attribute_compiled(step, "sweep.framework",
+                                       measured_step_s=measured_step_s)
+        return _perf.phases_block(rep)
+    except Exception as e:
+        return {"error": str(e)[:200]}
 
 
 def run_framework(seq, batch):
@@ -88,7 +106,10 @@ def run_framework(seq, batch):
         state[0], state[1], state[2], state[3], _ok, state[4] = step(
             state[0], state[1], state[2], feed, keys, state[4])
     step_once.sync = lambda: float(state[3])
-    return timed_windows(step_once)
+    spans = timed_windows(step_once)
+    phases = attribution_phases(
+        step, statistics.mean(spans) / ITERS)
+    return spans, phases
 
 
 def run_ideal(seq, batch):
@@ -141,7 +162,9 @@ def _one(program, seq):
     batch = max(1, TOKENS // seq)
     flops = bi.transformer_flops_per_step(batch, seq, LAYERS, HIDDEN, VOCAB)
     runner = run_framework if program == "framework" else run_ideal
-    report(program, seq, batch, runner(seq, batch), flops)
+    result = runner(seq, batch)
+    spans, phases = result if isinstance(result, tuple) else (result, None)
+    report(program, seq, batch, spans, flops, phases=phases)
 
 
 def main():

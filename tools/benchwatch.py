@@ -1,0 +1,259 @@
+#!/usr/bin/env python3
+"""Bench-trajectory ledger + statistical regression gate.
+
+The bench numbers of record (bench.py's JSON line, the driver's
+BENCH_r*.json artifacts) accumulate into ONE append-only ledger —
+``PERF_LEDGER.jsonl``, one JSON object per bench round — and ``check``
+gates new rounds against the trajectory: a drop beyond the noise the
+history itself exhibits exits nonzero, so a perf regression fails CI
+the same run it lands instead of being noticed three rounds later
+(exactly how the r01→r05 plateau went unflagged).
+
+Usage:
+    python tools/benchwatch.py append --from-bench BENCH_r05.json
+    python tools/benchwatch.py append --metric transformer_mfu=0.41
+    python tools/benchwatch.py check [--json]       # or: --check
+    python tools/benchwatch.py show
+
+    --ledger PATH   ledger file (default: PERF_LEDGER.jsonl next to the
+                    repo root)
+    --sigma N       regression threshold in noise sigmas (default 4)
+    --floor F       minimum relative drop to flag regardless of sigma
+                    (default 0.05 = 5%: sub-noise-floor trajectories
+                    would otherwise flag measurement jitter)
+
+Gate semantics (per metric, higher-is-better):  the latest entry is
+compared against the best-known value in the history; the noise scale is
+the sigma of historical DRAWDOWNS (relative drops below the running max
+— improvements are signal, not noise, and must not widen the band).  A
+drop beyond ``max(sigma * noise, floor)`` is a regression.  ``append``
+accepts bench.py's raw JSON line or the driver's BENCH_r*.json wrapper
+(``{"parsed": {...}}``); bench.py appends automatically when
+``BENCH_LEDGER`` names a ledger path.
+
+Exit status: check → 0 clean, 1 regression(s), 2 unreadable ledger.
+"""
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_LEDGER = os.path.join(_REPO, "PERF_LEDGER.jsonl")
+
+# metrics where lower is better would invert the gate; everything the
+# bench emits today (img/s, tok/s, MFU) is higher-is-better
+SIGMA_MULT = 4.0
+FLOOR = 0.05
+
+
+# ---------------------------------------------------------------------------
+# ledger I/O
+# ---------------------------------------------------------------------------
+
+def extract_metrics(doc):
+    """Flat {metric_name: value} from a bench document: bench.py's JSON
+    line, or the driver's BENCH_r*.json wrapper carrying it under
+    'parsed'."""
+    if not isinstance(doc, dict):
+        return {}
+    if "parsed" in doc and isinstance(doc["parsed"], dict):
+        doc = doc["parsed"]
+    out = {}
+    name = doc.get("metric")
+    if name and isinstance(doc.get("value"), (int, float)):
+        out[name] = float(doc["value"])
+    if isinstance(doc.get("mfu"), (int, float)):
+        out[(name or "bench") + "_mfu"] = float(doc["mfu"])
+    sub = doc.get("transformer")
+    if isinstance(sub, dict):
+        for k, v in extract_metrics(sub).items():
+            out[k] = v
+    return out
+
+
+def append_entry(ledger_path, metrics, source="", t=None, extra=None):
+    """Append one round to the ledger (plain append: the ledger is an
+    event log, each line self-contained)."""
+    if not metrics:
+        raise ValueError("no metrics to append")
+    entry = {"t": time.time() if t is None else t, "source": source,
+             "metrics": {k: float(v) for k, v in metrics.items()}}
+    if extra:
+        entry["extra"] = extra
+    with open(ledger_path, "a") as f:
+        f.write(json.dumps(entry, sort_keys=True) + "\n")
+    return entry
+
+
+def read_ledger(path):
+    entries = []
+    with open(path) as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                e = json.loads(line)
+            except ValueError:
+                raise ValueError("ledger %s line %d is not JSON"
+                                 % (path, i + 1))
+            if isinstance(e, dict) and isinstance(e.get("metrics"), dict):
+                entries.append(e)
+    return entries
+
+
+def metric_series(entries):
+    """{metric: [values in ledger order]} (rounds missing a metric are
+    simply absent from that series)."""
+    out = {}
+    for e in entries:
+        for k, v in e["metrics"].items():
+            if isinstance(v, (int, float)):
+                out.setdefault(k, []).append(float(v))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the gate
+# ---------------------------------------------------------------------------
+
+def drawdown_sigma(history):
+    """Noise scale of a higher-is-better series: the sigma of relative
+    drawdowns below the running max.  Improvements are signal and do not
+    widen the band; a flat-with-jitter series yields its jitter."""
+    if len(history) < 2:
+        return 0.0
+    run_max = history[0]
+    draws = []
+    for v in history[1:]:
+        run_max = max(run_max, v)
+        draws.append((run_max - v) / run_max if run_max > 0 else 0.0)
+    if len(draws) < 2:
+        return draws[0] if draws else 0.0
+    return statistics.stdev(draws)
+
+
+def check_series(values, sigma_mult=SIGMA_MULT, floor=FLOOR):
+    """Gate one metric's trajectory: is the LATEST value a regression
+    against the best-known, beyond the history's own noise?
+
+    Returns {"checked", "regression", "latest", "best", "drop",
+    "threshold", "noise_sigma"}."""
+    if len(values) < 2:
+        return {"checked": False, "regression": False,
+                "n": len(values)}
+    history, latest = values[:-1], values[-1]
+    best = max(history)
+    drop = (best - latest) / best if best > 0 else 0.0
+    noise = drawdown_sigma(history)
+    threshold = max(sigma_mult * noise, floor)
+    return {"checked": True,
+            "regression": drop > threshold,
+            "latest": latest, "best": best,
+            "drop": round(drop, 4), "threshold": round(threshold, 4),
+            "noise_sigma": round(noise, 4), "n": len(values)}
+
+
+def check_ledger(entries, sigma_mult=SIGMA_MULT, floor=FLOOR):
+    """(ok, {metric: verdict}) over every metric series in the ledger."""
+    results = {}
+    ok = True
+    for name, values in sorted(metric_series(entries).items()):
+        r = check_series(values, sigma_mult=sigma_mult, floor=floor)
+        results[name] = r
+        if r["regression"]:
+            ok = False
+    return ok, results
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def _cmd_append(args):
+    metrics = {}
+    sources = []
+    for path in args.from_bench or []:
+        with open(path) as f:
+            metrics.update(extract_metrics(json.load(f)))
+        sources.append(os.path.basename(path))
+    for kv in args.metric or []:
+        k, _, v = kv.partition("=")
+        metrics[k] = float(v)
+    entry = append_entry(args.ledger, metrics,
+                         source=args.source or ",".join(sources))
+    print(json.dumps(entry, sort_keys=True))
+    return 0
+
+
+def _cmd_check(args):
+    try:
+        entries = read_ledger(args.ledger)
+    except (OSError, ValueError) as e:
+        print("benchwatch: %s" % e, file=sys.stderr)
+        return 2
+    ok, results = check_ledger(entries, sigma_mult=args.sigma,
+                               floor=args.floor)
+    if args.json:
+        print(json.dumps({"ok": ok, "rounds": len(entries),
+                          "metrics": results}, indent=2, sort_keys=True))
+    else:
+        print("benchwatch: %d rounds in %s" % (len(entries), args.ledger))
+        for name, r in results.items():
+            if not r["checked"]:
+                print("  %-48s %d point(s), not gated" % (name, r["n"]))
+                continue
+            verdict = "REGRESSION" if r["regression"] else "ok"
+            print("  %-48s latest %.4g vs best %.4g  drop %.1f%% "
+                  "(threshold %.1f%%, noise sigma %.2f%%)  %s"
+                  % (name, r["latest"], r["best"], 100 * r["drop"],
+                     100 * r["threshold"], 100 * r["noise_sigma"],
+                     verdict))
+        if not ok:
+            print("benchwatch: REGRESSION beyond noise — investigate "
+                  "before merging (PERF.md workflow)")
+    return 0 if ok else 1
+
+
+def _cmd_show(args):
+    try:
+        entries = read_ledger(args.ledger)
+    except (OSError, ValueError) as e:
+        print("benchwatch: %s" % e, file=sys.stderr)
+        return 2
+    for i, e in enumerate(entries):
+        when = time.strftime("%Y-%m-%d %H:%M",
+                             time.localtime(e["t"])) if e.get("t") else "-"
+        ms = "  ".join("%s=%.4g" % kv for kv in
+                       sorted(e["metrics"].items()))
+        print("%3d  %s  %-14s %s" % (i + 1, when, e.get("source") or "-",
+                                     ms))
+    return 0
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # `--check` as the first token is an alias for the check command
+    if argv and argv[0] == "--check":
+        argv[0] = "check"
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("command", choices=["append", "check", "show"])
+    ap.add_argument("--ledger", default=DEFAULT_LEDGER)
+    ap.add_argument("--from-bench", action="append", default=[],
+                    metavar="JSON")
+    ap.add_argument("--metric", action="append", default=[],
+                    metavar="NAME=VALUE")
+    ap.add_argument("--source", default="")
+    ap.add_argument("--sigma", type=float, default=SIGMA_MULT)
+    ap.add_argument("--floor", type=float, default=FLOOR)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    return {"append": _cmd_append, "check": _cmd_check,
+            "show": _cmd_show}[args.command](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -99,6 +99,51 @@ def _parse_lines(chunk):
     return out
 
 
+class FollowReader:
+    """Tail a JSONL feed across truncation and rotation.
+
+    A plain ``f.readlines()`` loop stalls silently the moment the file
+    is truncated (the kept offset is past EOF, so every read returns
+    nothing) or rotated (the fd points at the old inode forever).  Each
+    :meth:`poll` therefore stats the path first and reopens from the
+    start when the inode changed or the file shrank below the current
+    offset; a missing path (mid-rotation window) just yields nothing
+    until it reappears."""
+
+    def __init__(self, path):
+        self.path = path
+        self._f = None
+        self._ino = None
+        self.reopened = 0
+
+    def _open(self):
+        self._f = open(self.path)
+        self._ino = os.fstat(self._f.fileno()).st_ino
+
+    def close(self):
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+    def poll(self):
+        """New snapshots since the last poll (possibly empty)."""
+        try:
+            st = os.stat(self.path)
+        except OSError:
+            self.close()      # rotated away; wait for the new file
+            return []
+        if self._f is not None and (st.st_ino != self._ino
+                                    or st.st_size < self._f.tell()):
+            self.close()      # rotated in place, or truncated
+        if self._f is None:
+            try:
+                self._open()
+            except OSError:
+                return []
+            self.reopened += 1
+        return _parse_lines(self._f.readlines())
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("path")
@@ -113,38 +158,44 @@ def main(argv=None):
         print("metricsdump: no such file: %s" % args.path, file=sys.stderr)
         return 2
 
-    with open(args.path) as f:
-        snaps = _parse_lines(f.readlines())
-        if not args.follow:
-            if args.raw:
-                for s in snaps[-args.last:]:
-                    print(json.dumps(s))
-                return 0
-            shown = snaps[-args.last:]
-            for i, s in enumerate(shown):
-                prev = (shown[i - 1] if i else
-                        (snaps[-args.last - 1] if len(snaps) > args.last
-                         else None))
-                print(render(s, prev, args.filter))
+    if not args.follow:
+        with open(args.path) as f:
+            snaps = _parse_lines(f.readlines())
+        if args.raw:
+            for s in snaps[-args.last:]:
+                print(json.dumps(s))
             return 0
+        shown = snaps[-args.last:]
+        for i, s in enumerate(shown):
+            prev = (shown[i - 1] if i else
+                    (snaps[-args.last - 1] if len(snaps) > args.last
+                     else None))
+            print(render(s, prev, args.filter))
+        return 0
 
-        prev = snaps[-1] if snaps else None
-        if prev is not None:
-            print(render(prev, snaps[-2] if len(snaps) > 1 else None,
-                         args.filter))
-        try:
-            while True:
-                fresh = _parse_lines(f.readlines())
-                for s in fresh:
-                    if args.raw:
-                        print(json.dumps(s))
-                    else:
-                        print(render(s, prev, args.filter))
-                    prev = s
-                sys.stdout.flush()
-                time.sleep(args.interval)
-        except KeyboardInterrupt:
-            return 0
+    # follow mode: the reader survives truncation/rotation of the feed
+    # (an exporter restart or a logrotate must not silently stall the
+    # console)
+    reader = FollowReader(args.path)
+    snaps = reader.poll()
+    prev = snaps[-1] if snaps else None
+    if prev is not None:
+        print(render(prev, snaps[-2] if len(snaps) > 1 else None,
+                     args.filter))
+    try:
+        while True:
+            for s in reader.poll():
+                if args.raw:
+                    print(json.dumps(s))
+                else:
+                    print(render(s, prev, args.filter))
+                prev = s
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        reader.close()
 
 
 if __name__ == "__main__":

@@ -241,6 +241,10 @@ def main(argv=None):
         "queue_depth_max": max(depth_samples) if depth_samples else 0,
         "queue_depth_mean": round(statistics.fmean(depth_samples), 2)
         if depth_samples else 0.0,
+        # exec-span device time / wall, from the attribution plane's
+        # serving exec histogram (surfaced top-level: the one number an
+        # operator sizes a fleet by)
+        "device_utilization": stats.get("device_utilization"),
         "runtime_stats": stats,
     }
     if args.json:
@@ -263,6 +267,9 @@ def main(argv=None):
              stats["counters"].get("rows", 0) /
              max(stats["counters"].get("batches", 1), 1),
              stats["health"]))
+    if report["device_utilization"] is not None:
+        print("  device util     %.1f%% (exec-span time / wall)"
+              % (100 * report["device_utilization"]))
     return 0
 
 
