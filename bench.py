@@ -100,7 +100,8 @@ def _maybe_ledger(result):
         bw = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(bw)
         bw.append_entry(path, bw.extract_metrics(result),
-                        source="bench.py")
+                        source="bench.py",
+                        extra=bw.extract_extra(result) or None)
     except Exception as e:
         print("bench: ledger append failed: %s" % e, file=sys.stderr)
 

@@ -41,7 +41,8 @@ from typing import Dict, Iterator, List, Optional, Tuple
 
 __all__ = ["HloInstr", "iter_instructions", "analytic_flops",
            "instruction_bytes", "bytes_by_dtype", "top_contributors",
-           "collective_compute_overlap", "chip_peaks", "roofline"]
+           "collective_compute_overlap", "chip_peaks", "roofline",
+           "entry_io_bytes", "memory_breakdown", "predicted_peak_bytes"]
 
 _DTYPE_BYTES = {
     "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
@@ -364,6 +365,86 @@ def top_contributors(per_class: Dict[str, Dict[str, int]],
             for op, dts in per_class.items() for dt, b in dts.items()]
     flat.sort(key=lambda e: -e["bytes"])
     return flat[:n]
+
+
+# ---------------------------------------------------------------------------
+# memory: entry-signature prediction + compiled breakdown
+# ---------------------------------------------------------------------------
+
+_ENTRY_RE = re.compile(r"^\s*ENTRY\s+%?[\w.\-]+\s*")
+
+
+def entry_io_bytes(hlo_text: str) -> Dict[str, int]:
+    """Predicted argument/output bytes of a module from its ENTRY
+    signature alone: ``{"argument_bytes", "output_bytes"}``.
+
+    This is the costmodel side of the memory reconciliation — the
+    numbers ``Compiled.memory_analysis()`` reports as
+    ``argument_size_in_bytes``/``output_size_in_bytes`` re-derived from
+    the HLO text (they differ only by layout padding), so the
+    attribution report can cross-check the parser against XLA the same
+    way the FLOP model is cross-checked against ``cost_analysis()``."""
+    for line in hlo_text.splitlines():
+        if not _ENTRY_RE.match(line):
+            continue
+        open_idx = line.find("(")
+        if open_idx < 0:
+            continue
+        params, rest = _balanced_operands(line, open_idx)
+        out_type = rest.split("->", 1)[1] if "->" in rest else ""
+        return {"argument_bytes": _type_bytes(params),
+                "output_bytes": _type_bytes(out_type.split("{")[0])}
+    return {"argument_bytes": 0, "output_bytes": 0}
+
+
+def memory_breakdown(compiled_or_stats) -> Dict[str, int]:
+    """Normalize ``Compiled.memory_analysis()`` (or an already-fetched
+    ``CompiledMemoryStats``) into plain ints:
+    ``{argument,output,temp,alias,generated_code,peak}_bytes``.
+
+    ``peak_bytes`` follows XLA's accounting: arguments + outputs +
+    temps − aliased bytes (a donated train step aliases params/momenta
+    in-place, so its peak is ~1× state, not 2×).  Empty dict when the
+    executable cannot report (some deserialized AOT artifacts)."""
+    stats = compiled_or_stats
+    if hasattr(stats, "memory_analysis"):
+        try:
+            stats = stats.memory_analysis()
+        except Exception:
+            return {}
+    if stats is None:
+        return {}
+    def grab(field):
+        try:
+            return int(getattr(stats, field))
+        except (AttributeError, TypeError, ValueError):
+            return 0
+    out = {
+        "argument_bytes": grab("argument_size_in_bytes"),
+        "output_bytes": grab("output_size_in_bytes"),
+        "temp_bytes": grab("temp_size_in_bytes"),
+        "alias_bytes": grab("alias_size_in_bytes"),
+        "generated_code_bytes": grab("generated_code_size_in_bytes"),
+    }
+    if not any(out.values()):
+        return {}
+    out["peak_bytes"] = max(0, out["argument_bytes"] + out["output_bytes"]
+                            + out["temp_bytes"] - out["alias_bytes"])
+    return out
+
+
+def predicted_peak_bytes(state_bytes: float, batch_bytes: float = 0.0,
+                         temp_bytes: float = 0.0,
+                         donated: bool = True) -> int:
+    """Pre-compile peak-HBM prediction for a training-step-shaped
+    program (the GC501 input): persistent state (params + optimizer +
+    aux) once when the update donates its buffers, TWICE when it does
+    not (old and new live simultaneously — the GC202 hazard), plus the
+    batch and whatever temp estimate the caller has (0 before a
+    compile; ``memory_breakdown()['temp_bytes']`` after one)."""
+    factor = 1.0 if donated else 2.0
+    return int(factor * float(state_bytes) + float(batch_bytes)
+               + float(temp_bytes))
 
 
 # ---------------------------------------------------------------------------

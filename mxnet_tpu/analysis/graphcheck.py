@@ -47,6 +47,12 @@ GC402     registry-dynamic-gap     warning   registered op schema declares a
                                              dynamic_params mechanism
 GC403     unhashable-attr          error     op attrs that cannot be hashed
                                              into a jit cache key
+GC501     hbm-over-capacity        error     predicted peak HBM (costmodel
+                                             state/batch accounting +
+                                             ``memory_analysis`` temp bytes)
+                                             exceeds per-device capacity —
+                                             refused BEFORE dispatch instead
+                                             of an opaque RESOURCE_EXHAUSTED
 ========  =======================  ========  ==================================
 
 The per-step attr names behind GC401/GC402 are the scheduled-hyperparam
@@ -70,8 +76,8 @@ except ImportError:                     # older: the classic namespace
 
 __all__ = ["CollectiveEvent", "collect_collectives", "check_jaxpr",
            "check_fn", "check_symbol", "check_registry",
-           "check_replication", "check_trainer", "check_executor",
-           "PER_STEP_ATTRS", "COLLECTIVE_PRIMS"]
+           "check_replication", "check_capacity", "check_trainer",
+           "check_executor", "PER_STEP_ATTRS", "COLLECTIVE_PRIMS"]
 
 # every collective primitive we track (axis_index is deliberately absent:
 # it reads the axis env but moves no data and cannot desync)
@@ -540,6 +546,44 @@ def check_replication(entries: Iterable[Tuple], mesh,
     return rep
 
 
+def check_capacity(predicted_bytes, capacity_bytes=None, target: str = "",
+                   detail: Optional[Dict] = None) -> Report:
+    """GC501: pre-flight HBM capacity check — the memory-plane twin of
+    the collective-schedule rules.  ``predicted_bytes`` comes from
+    :func:`~mxnet_tpu.analysis.costmodel.predicted_peak_bytes` (state +
+    batch, plus ``memory_analysis`` temps when a compile happened);
+    ``capacity_bytes`` defaults to what the backend/env reports
+    (``telemetry.memory.device_capacity_bytes``).  Silently passes when
+    either side is unknown — a missing capacity must not block a dev
+    box, the TPU allocator reports its own."""
+    rep = Report("graphcheck", target)
+    if capacity_bytes is None:
+        from ..telemetry import memory as _memory
+        capacity_bytes = _memory.device_capacity_bytes()
+    if not predicted_bytes or not capacity_bytes:
+        return rep
+    if float(predicted_bytes) <= float(capacity_bytes):
+        return rep
+    extra = {"predicted_bytes": int(predicted_bytes),
+             "capacity_bytes": int(capacity_bytes)}
+    if detail:
+        extra.update(detail)
+    rep.add(
+        "GC501", "error",
+        "predicted peak HBM %.2f GB exceeds the %.2f GB device capacity "
+        "(%.1fx): this program would die in the allocator as an opaque "
+        "RESOURCE_EXHAUSTED mid-launch"
+        % (predicted_bytes / 1e9, capacity_bytes / 1e9,
+           predicted_bytes / capacity_bytes),
+        location=target,
+        fix_hint="cut the microbatch, enable gradient remat "
+                 "(backward_mirror_policy), shard optimizer state "
+                 "(shard_optimizer_state=True) or params (__shard__/tp), "
+                 "and check buffer donation (GC202)",
+        extra=extra)
+    return rep
+
+
 def check_donation(donated: bool, what: str, target: str = "") -> Report:
     """GC202: the training step's state buffers (params/momenta/guard)
     must be donated or the update holds old+new copies live — 2x peak."""
@@ -583,6 +627,34 @@ def check_trainer(trainer, params, mom, aux, inputs, keys=None,
                                  target=target))
     rep.extend(check_donation(getattr(trainer, "_step_donated", True),
                               "ShardedTrainer jitted step", target=target))
+    # GC501: predicted peak HBM (state + batch; the costmodel's donated
+    # vs undonated accounting) against the device capacity, BEFORE any
+    # buffer is allocated
+    from . import costmodel
+
+    def _leaf_bytes(tree):
+        import numpy as np
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(tree):
+            shape = getattr(leaf, "shape", None)
+            dtype = getattr(leaf, "dtype", None)
+            if shape is None or dtype is None:
+                continue
+            n = 1
+            for d in shape:
+                n *= int(d)
+            total += n * np.dtype(dtype).itemsize
+        return total
+
+    state_bytes = _leaf_bytes((params, mom, aux))
+    batch_bytes = _leaf_bytes(inputs)
+    predicted = costmodel.predicted_peak_bytes(
+        state_bytes, batch_bytes,
+        donated=getattr(trainer, "_step_donated", True))
+    rep.extend(check_capacity(
+        predicted, target=target,
+        detail={"state_bytes": state_bytes, "batch_bytes": batch_bytes,
+                "donated": getattr(trainer, "_step_donated", True)}))
     rep.target = target
     return rep, closed
 

@@ -119,8 +119,21 @@ def run_trainer_preflight(trainer, params, mom, aux, inputs):
         try:
             keys = trainer._keys()
             guard = trainer._guard_arrays()
-            hlo_text = trainer._step.lower(
-                params, mom, aux, inputs, keys, guard).compile().as_text()
+            compiled = trainer._step.lower(
+                params, mom, aux, inputs, keys, guard).compile()
+            hlo_text = compiled.as_text()
+            # feed the memory plane: the OOM forensics and the GC501
+            # refinement both want this program's compiled breakdown
+            from ..telemetry import memory as _memory
+            _memory.note_program(
+                "ShardedTrainer.step(%s)" % (trainer.symbol.name
+                                             or "symbol"), compiled)
+            from . import costmodel, graphcheck
+            breakdown = costmodel.memory_breakdown(compiled)
+            if breakdown.get("peak_bytes"):
+                rep.extend(graphcheck.check_capacity(
+                    breakdown["peak_bytes"], target=rep.target,
+                    detail={"basis": "memory_analysis", **breakdown}))
         except Exception:
             logging.exception("pre-flight: HLO dump failed (continuing)")
     return _finish(rep, "trainer", jaxpr=closed, hlo_text=hlo_text)

@@ -207,15 +207,20 @@ class ServedProgram:
     @classmethod
     def load(cls, path):
         from . import telemetry
+        name = "ServedProgram(%s)" % os.path.basename(os.fspath(path))
         with telemetry.span("deploy/load", cat="deploy", path=str(path)):
             arrays, meta, blobs = read_container(path)
             prog = cls(arrays, meta, blobs)
         telemetry.count("deploy.loads")
+        # memory plane: served weights are a first-class HBM bucket (a
+        # hot-swap briefly holds two models — the accounting shows it),
+        # and the executable's breakdown feeds OOM forensics
+        telemetry.memory.tag(prog._params, "served", label=name)
+        if telemetry.memory.enabled():
+            telemetry.memory.note_program(name, prog._compiled)
         # opt-in attribution of the serving program (static: the exec
         # side is measured by ServingRuntime's exec histogram instead)
-        telemetry.perf.maybe_attribute(
-            prog._compiled,
-            "ServedProgram(%s)" % os.path.basename(os.fspath(path)))
+        telemetry.perf.maybe_attribute(prog._compiled, name)
         return prog
 
     def forward(self, **inputs):

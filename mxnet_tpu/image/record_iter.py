@@ -206,9 +206,14 @@ class ImageRecordIter(DataIter):
 
     def next(self):
         from .. import telemetry
+        from ..telemetry import memory as _memory
         with telemetry.span("data/next", cat="io",
                             metric="data.next_seconds"):
-            return self._next_batch()
+            batch = self._next_batch()
+            # memory plane: bucket the decoded batch buffers
+            _memory.tag(list(batch.data) + list(batch.label or []),
+                        "batch", label="ImageRecordIter")
+            return batch
 
     def _next_batch(self):
         if self._native is not None:

@@ -262,6 +262,18 @@ class Module(BaseModule):
             # report for the bound program, same forensics dir
             from ..telemetry import perf as _perf
             _perf.maybe_attribute_module(self)
+        # memory plane: bucket the executor buffers this binding just
+        # allocated (params/aux as model state, grads as the backward's
+        # working set) so live-HBM accounting can name them
+        from ..telemetry import memory as _memory
+        if _memory.enabled():
+            for ex in self._exec_group.execs:
+                _memory.tag(list(ex.arg_arrays), "params",
+                            label="Module.arg")
+                _memory.tag(list(ex.aux_arrays), "params",
+                            label="Module.aux")
+                _memory.tag([g for g in ex.grad_arrays if g is not None],
+                            "activations", label="Module.grad")
 
         if shared_module is not None and shared_module.params_initialized:
             self._arg_params, self._aux_params = (shared_module._arg_params,

@@ -683,6 +683,12 @@ class Updater:
             self.states[index] = self.optimizer.create_state_multi_precision(
                 index, weight)
             self.states_synced[index] = True
+            # memory plane: optimizer slots (momentum/adam moments/...)
+            # are the classic invisible HBM consumer — bucket them at
+            # the one seam every optimizer's state passes through
+            from .telemetry import memory as _memory
+            _memory.tag(self.states[index], "optimizer",
+                        label="Updater[%s]" % index)
         self.optimizer.update_multi_precision(index, weight, grad,
                                               self.states[index])
 

@@ -122,13 +122,17 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
     from .audit import record_collective
     # k/v blocks each make n-1 ppermute hops around the ring
     kv_bytes = int(getattr(k, "nbytes", 0) + getattr(v, "nbytes", 0))
+    from ..telemetry import memory as _memory
     with _tel.span("collective/ring_attention", cat="collective",
                    metric="parallel.collective_seconds",
                    kind="collective-permute", bytes=kv_bytes), \
-            _wd.watch("parallel.ring_attention", kind="collective"):
+            _wd.watch("parallel.ring_attention", kind="collective"), \
+            _memory.oom_guard("parallel.ring_attention",
+                              program="ring_attention"):
         q = jax.device_put(q, sharding)
         k = jax.device_put(k, sharding)
         v = jax.device_put(v, sharding)
+        _memory.tag((q, k, v), "activations", label="ring_attention.qkv")
         out = jax.jit(mapped)(q, k, v)
     record_collective("collective-permute", "parallel.ring_attention",
                       bytes=kv_bytes)

@@ -234,6 +234,12 @@ class ShardedTrainer:
         aux = tuple(jax.device_put(
             (np.zeros if "mean" in n else np.ones)(known[n].shape, np.float32),
             rep) for n in self.prog.aux_names)
+        # memory plane: bucket the trainer's persistent state so live-HBM
+        # accounting and OOM forensics can name it (one bool when off)
+        from ..telemetry import memory as _memory
+        _memory.tag(params, "params", label="ShardedTrainer")
+        _memory.tag(mom, "optimizer", label="ShardedTrainer.mom")
+        _memory.tag(aux, "params", label="ShardedTrainer.aux")
         return tuple(params), mom, aux
 
     # -- the step ---------------------------------------------------------
@@ -390,6 +396,16 @@ class ShardedTrainer:
         params = tuple(jax.device_put(p, f) for p, f in zip(params, p_fmt))
         mom = tuple(jax.device_put(m, f) for m, f in zip(mom, m_fmt))
         aux = tuple(jax.device_put(a, f) for a, f in zip(aux, a_fmt))
+        from ..telemetry import memory as _memory
+        if _memory.enabled():
+            # re-laid state carries fresh buffers; re-tag them and record
+            # this program's compiled memory breakdown for OOM forensics
+            _memory.tag(params, "params", label="ShardedTrainer")
+            _memory.tag(mom, "optimizer", label="ShardedTrainer.mom")
+            _memory.tag(aux, "params", label="ShardedTrainer.aux")
+            _memory.note_program(
+                "ShardedTrainer.auto_layout(%s)" % (self.symbol.name
+                                                    or "symbol"), compiled)
         return compiled, params, mom, aux
 
     def step(self, params, mom, aux, batch: Dict[str, np.ndarray]):
@@ -406,6 +422,7 @@ class ShardedTrainer:
         from ..executor import backward_mirror_policy
         from ..resilience import chaos as _chaos
         from ..resilience import watchdog as _watchdog
+        from ..telemetry import memory as _memory
         from .audit import record_collective
         remat = backward_mirror_policy()
         if self._step is None or remat != self._built_remat:
@@ -422,18 +439,27 @@ class ShardedTrainer:
             batch[poison] = np.full_like(np.asarray(batch[poison]), np.nan)
         # the deadline covers everything a stall can hide in: the chaos
         # hang drill, host->device transfer, and the jitted step with its
-        # fused gradient psum (a dead peer blocks right here)
+        # fused gradient psum (a dead peer blocks right here); the oom
+        # guard turns an allocator RESOURCE_EXHAUSTED anywhere inside
+        # into a post-mortem naming the live buffers + this program
+        _prog_name = "ShardedTrainer.step(%s)" % (self.symbol.name
+                                                  or "symbol")
         with _tel.span("train/step", cat="train",
                        metric="train.step_seconds",
                        step=self._step_count) as _sp, \
                 _watchdog.watch("ShardedTrainer.step", kind="step",
-                                step=self._step_count):
+                                step=self._step_count), \
+                _memory.oom_guard("ShardedTrainer.step",
+                                  program=_prog_name,
+                                  step=self._step_count):
             _chaos.maybe_hang(self._step_count)
+            _chaos.maybe_oom(self._step_count)
             with _tel.span("train/host_enqueue", cat="train",
                            metric="train.host_enqueue_seconds",
                            step=self._step_count):
                 inputs = {n: jax.device_put(v, self.spec.batch_sharding())
                           for n, v in batch.items()}
+                _memory.tag(inputs, "batch", label="ShardedTrainer.step")
                 keys = self._keys()
                 params, mom, aux, loss, ok, guard = self._step(
                     params, mom, aux, inputs, keys, self._guard_arrays())
@@ -455,6 +481,15 @@ class ShardedTrainer:
                           step=self._step_count, bytes=self._grad_bytes())
         _watchdog.heartbeat(self._step_count)
         _tel.window_tick()
+        if _memory.enabled():
+            # donated updates return fresh buffers each step: keep them
+            # bucketed, tick the memory timeline + leak watchdog, and
+            # make sure the background sampler runs (armed only)
+            _memory.tag(params, "params", label="ShardedTrainer")
+            _memory.tag(mom, "optimizer", label="ShardedTrainer.mom")
+            _memory.tag(aux, "params", label="ShardedTrainer.aux")
+            _memory.note_step(self._step_count)
+            _memory.maybe_start_sampler()
         self._maybe_attribute_step(params, mom, aux, inputs, keys)
         return params, mom, aux, loss
 

@@ -27,6 +27,12 @@ recovery machinery is *proven* by tests instead of trusted:
 * ``bad_swap``     — the hot model-swap canary run produces non-finite
   outputs, so swap validation must reject the incoming model and keep
   serving the previous one (serving/runtime.py swap/rollback drill).
+* ``oom``          — request an impossibly large device allocation
+  INSIDE the watchdog-armed step region, so the REAL allocator raises
+  ``RESOURCE_EXHAUSTED`` through the real dispatch path and the memory
+  plane's OOM forensics (telemetry/memory.py ``oom_guard``) are proven
+  by the drill, not mocked.  Size via the fault's ``elems`` param or
+  ``MXNET_TPU_CHAOS_OOM_ELEMS`` (default 2**44 f32 = 64 TB).
 
 Faults are armed either with the :func:`inject` context manager (tests)
 or the ``MXNET_TPU_CHAOS`` env var (whole-run drills), a comma list of
@@ -43,7 +49,8 @@ from typing import List, Optional
 
 __all__ = ["SimulatedPreemption", "inject", "fire", "maybe_preempt",
            "maybe_io_error", "maybe_hang", "maybe_slow_exec",
-           "maybe_exec_error", "corrupt_latest", "active", "reset"]
+           "maybe_exec_error", "maybe_oom", "corrupt_latest", "active",
+           "reset"]
 
 
 class SimulatedPreemption(RuntimeError):
@@ -192,6 +199,30 @@ def maybe_exec_error(step: Optional[int] = None):
     if fire("exec_error", step) is not None:
         raise RuntimeError(
             "chaos: injected executor failure at batch %s" % step)
+
+
+def maybe_oom(step: Optional[int] = None):
+    """Allocate an impossibly large device buffer if an ``oom`` fault
+    fires now — the OOM-forensics drill.  The allocation happens INSIDE
+    the watchdog-armed, oom_guard-wrapped step region, so the drill
+    proves that a real allocator ``RESOURCE_EXHAUSTED`` produces a
+    post-mortem naming the top live buffers and the tripping program —
+    not a shortcut exception."""
+    params = fire("oom", step)
+    if params is None:
+        return
+    import jax.numpy as jnp
+    elems = int(params.get(
+        "elems", os.environ.get("MXNET_TPU_CHAOS_OOM_ELEMS",
+                                str(1 << 44))))
+    print("chaos: requesting %d f32 elems (%.1f TB) at step %s"
+          % (elems, elems * 4 / 1e12, step), flush=True)
+    huge = jnp.zeros((elems,), jnp.float32)
+    huge.block_until_ready()
+    # unreachable on any real allocator; fail the drill loudly if not
+    raise RuntimeError(
+        "RESOURCE_EXHAUSTED: chaos oom fault — the %d-element allocation "
+        "unexpectedly succeeded, raising synthetically" % elems)
 
 
 def maybe_io_error(desc: str = ""):

@@ -264,13 +264,23 @@ def save_trainer(manager, trainer, params, mom, aux, step, extra_meta=None,
     return manager.save(step, arrays, meta)
 
 
-def restore_trainer(manager, trainer, step=None, data_iter=None):
+def restore_trainer(manager, trainer, step=None, data_iter=None,
+                    old_state=None):
     """Restore (params, mom, aux) onto ``trainer``'s mesh — each tensor is
     ``device_put`` with the trainer's OWN sharding rule, so the snapshot
     reshards correctly even if the mesh/topology changed across restarts.
     Returns ``(params, mom, aux, step, meta)`` or None if no valid
-    checkpoint exists."""
+    checkpoint exists.
+
+    ``old_state``: the (params, mom, aux) being REPLACED.  Pass it so
+    the old device buffers are freed BEFORE the restored tree is
+    ``device_put`` — without this the caller's references keep the old
+    copy alive while the new one materializes, a ~2x peak-HBM spike
+    that OOMs exactly the jobs big enough to need checkpoints.  The
+    snapshot is already validated on disk at that point, so freeing
+    first is safe: a failed device_put can always re-restore."""
     import jax
+    from ..telemetry import memory as _memory
     ck = manager.restore(step) if step is not None else manager.latest()
     if ck is None:
         return None
@@ -278,6 +288,14 @@ def restore_trainer(manager, trainer, step=None, data_iter=None):
     if meta.get("kind") != "sharded_trainer":
         raise MXNetError("checkpoint %s holds %r state, not a "
                          "sharded_trainer" % (ck.path, meta.get("kind")))
+    if old_state is not None:
+        # the container read above fully CRC-validated the snapshot;
+        # dropping the old residency now caps peak at ~1x model size
+        freed = _memory.release(old_state)
+        if freed:
+            logging.info("checkpoint restore: released %.1f MB of old "
+                         "device state before materializing step %d",
+                         freed / 1e6, ck.step)
     if meta.get("shapes"):
         trainer._last_shapes = {k: tuple(v)
                                 for k, v in meta["shapes"].items()}
@@ -295,6 +313,9 @@ def restore_trainer(manager, trainer, step=None, data_iter=None):
     rep = trainer.spec.replicated()
     aux = tuple(jax.device_put(ck.arrays["aux/" + n], rep)
                 for n in trainer.prog.aux_names)
+    _memory.tag(params, "params", label="restore")
+    _memory.tag(mom, "optimizer", label="restore")
+    _memory.tag(aux, "params", label="restore.aux")
     trainer.set_resilience_state(meta)
     _load_iter_state(data_iter, ck.arrays, meta)
     return params, mom, aux, ck.step, meta

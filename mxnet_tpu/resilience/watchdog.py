@@ -319,6 +319,19 @@ def _open_spans():
         return {"error": repr(e)}
 
 
+def _memory_snapshot():
+    """Live-HBM accounting for the report — a hang on a collective is
+    often a peer OOM-thrashing; the space axis belongs next to the
+    stacks.  Guarded: the monitor thread must never raise."""
+    try:
+        from ..telemetry import memory as _memory
+        return {"live_bytes_by_tag": _memory.live_bytes_by_tag(),
+                "peak_live_bytes": _memory.peak_live_bytes(),
+                "device_memory": _memory.device_memory_stats()}
+    except Exception as e:
+        return {"error": repr(e)}
+
+
 def write_postmortem(report_dir: str, tag: str, step=None, deadline=None,
                      armed_at=None, stuck_thread_id=None, action="abort",
                      heartbeats=None, extra=None):
@@ -367,6 +380,7 @@ def write_postmortem(report_dir: str, tag: str, step=None, deadline=None,
             "env": _env_snapshot(),
             "metrics_window": _telemetry_window(),
             "open_spans": _open_spans(),
+            "memory": _memory_snapshot(),
         }
         if extra:
             report.update(extra)

@@ -427,7 +427,11 @@ class ServingRuntime:
                      "%s.execute" % self._name, kind="step", step=seq,
                      timeout=wd_timeout))
         try:
-            with armed, telemetry.span(
+            # the oom guard shares the watchdog-armed dispatch region: a
+            # RESOURCE_EXHAUSTED out of the executor writes a memory
+            # post-mortem before the breaker/typed-error machinery runs
+            with armed, telemetry.memory.oom_guard(
+                    "%s.execute" % self._name, step=seq), telemetry.span(
                     "serve/exec", cat="serve", timed=True, batch=seq,
                     rows=sum(r.rows for r in batch)) as sp:
                 outs = call_with_retry(
@@ -478,6 +482,10 @@ class ServingRuntime:
                             float(len(batch) - delivered), outcome="late")
         self._trace_requests(batch)
         telemetry.window_tick()
+        # memory plane: tick the live-HBM timeline + leak watchdog per
+        # dispatched batch (a serving leak grows across REQUESTS, not
+        # steps); one cached-bool check when disarmed
+        telemetry.memory.note_step(seq)
 
     def _trace_requests(self, batch: List[Request]):
         """Retrospective per-request spans into the merged trace: each

@@ -17,6 +17,10 @@ Three pieces (full catalog + knobs in docs/observability.md):
   (``MXNET_TPU_ATTRIBUTION=1``), combining the
   :mod:`~mxnet_tpu.analysis.costmodel` analytics with the step/span
   histograms above.
+* :mod:`.memory` — the memory observability plane (the space axis to
+  perf's time axis): tagged live-HBM accounting, per-program memory
+  breakdowns, OOM forensics + leak watchdog
+  (``MXNET_TPU_MEMWATCH*``).
 
 Quick start::
 
@@ -35,6 +39,7 @@ from .registry import (DEFAULT_BUCKETS, Counter, Gauge, Histogram, arm,
 from .spans import open_spans, record_span, span, spans_active
 from .digest import fleet_view, rank_digest, render_fleet
 from . import perf
+from . import memory
 
 __all__ = [
     "DEFAULT_BUCKETS", "Counter", "Gauge", "Histogram", "arm", "count",
@@ -43,11 +48,13 @@ __all__ = [
     "reset_metrics", "set_gauge", "snapshot", "window_tick",
     "open_spans", "record_span", "span", "spans_active",
     "fleet_view", "rank_digest", "render_fleet",
-    "perf",
+    "perf", "memory",
 ]
 
 
 def reset():
     """Full test reset: metrics, window, arm state (spans' open tables
-    are self-healing — they empty as spans exit)."""
+    are self-healing — they empty as spans exit); the memory plane's
+    tags/timeline/peak reset with it."""
     reset_metrics()
+    memory.reset()

@@ -45,6 +45,14 @@ def rank_digest(step: Optional[int] = None) -> dict:
     tput = _throughput()
     if tput is not None:
         d["throughput_sps"] = round(tput, 3)
+    # memory plane: this rank's live/peak HBM rides the same ~200-byte
+    # digest so rank 0's fleet view shows who is near the red line
+    # BEFORE anyone OOMs (gauges are fed by telemetry.memory's sampler)
+    live = _registry.gauge("mem.live_bytes_total").value()
+    peak = _registry.gauge("mem.peak_live_bytes").value()
+    if live or peak:
+        d["mem_mb"] = {"live": round(live / 1e6, 1),
+                       "peak": round(peak / 1e6, 1)}
     counters = {}
     for name, key in _DIGEST_COUNTERS:
         total = _registry.counter_total(name)
@@ -103,15 +111,19 @@ def render_fleet(view: Optional[dict] = None) -> str:
     """Human-readable fleet table (stdlib-only; tools/metricsdump.py
     reuses the same layout)."""
     view = view or fleet_view()
-    lines = ["rank  step   age_s   p50_ms   p95_ms   tput/s  counters"]
+    lines = ["rank  step   age_s   p50_ms   p95_ms   tput/s  "
+             "live_mb  peak_mb  counters"]
     for rank, row in sorted(view["ranks"].items(), key=lambda kv: int(kv[0])):
         d = row.get("digest") or {}
         sm = d.get("step_ms") or {}
+        mm = d.get("mem_mb") or {}
         lines.append(
-            "%-5s %-6s %-7s %-8s %-8s %-7s %s"
+            "%-5s %-6s %-7s %-8s %-8s %-7s %-8s %-8s %s"
             % (rank, row.get("step", "-"), row.get("age_sec", "-"),
                sm.get("p50", "-"), sm.get("p95", "-"),
-               d.get("throughput_sps", "-"), d.get("counters", "") or ""))
+               d.get("throughput_sps", "-"),
+               mm.get("live", "-"), mm.get("peak", "-"),
+               d.get("counters", "") or ""))
     strag = (view.get("straggler") or {}).get("step_time")
     if strag:
         lines.append("step-time straggler: rank %s (p50 skew x%.2f)"

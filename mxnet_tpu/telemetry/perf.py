@@ -136,6 +136,13 @@ class AttributionReport:
             events.append({"name": base + "/overlap_pct", "ph": "C",
                            "ts": ts, "pid": 2, "tid": 0,
                            "args": {"pct": ov["overlap_pct"]}})
+        mem = self.data.get("memory", {})
+        peak = (mem.get("compiled") or {}).get("peak_bytes") \
+            or (mem.get("predicted") or {}).get("peak_bytes")
+        if peak:
+            events.append({"name": base + "/memory_bytes", "ph": "C",
+                           "ts": ts, "pid": 2, "tid": 0,
+                           "args": {"peak": peak}})
         return events
 
     def pretty(self) -> str:
@@ -189,6 +196,30 @@ class AttributionReport:
                 lines.append("shares of step: " + ", ".join(
                     "%s %.0f%%" % (k, 100 * v)
                     for k, v in sorted(r["shares"].items())))
+        mem = d.get("memory", {})
+        mc = mem.get("compiled") or {}
+        mp = mem.get("predicted") or {}
+        if mc or mp.get("peak_bytes"):
+            lines.append(
+                "memory: predicted io %.2f MB vs compiled io %s "
+                "(ratio %s); compiled peak %s (temp %s, aliased %s)" % (
+                    (mp.get("argument_bytes", 0)
+                     + mp.get("output_bytes", 0)) / 1e6,
+                    "%.2f MB" % ((mc.get("argument_bytes", 0)
+                                  + mc.get("output_bytes", 0)) / 1e6)
+                    if mc else "n/a",
+                    mem.get("predicted_vs_compiled", "n/a"),
+                    "%.2f MB" % (mc["peak_bytes"] / 1e6)
+                    if mc.get("peak_bytes") is not None else "n/a",
+                    "%.2f MB" % (mc.get("temp_bytes", 0) / 1e6)
+                    if mc else "n/a",
+                    "%.2f MB" % (mc.get("alias_bytes", 0) / 1e6)
+                    if mc else "n/a"))
+        mm = mem.get("measured") or {}
+        if mm.get("live_bytes"):
+            lines.append("measured live %.2f MB (peak %.2f MB)" % (
+                mm["live_bytes"] / 1e6,
+                mm.get("peak_live_bytes", 0) / 1e6))
         s = d.get("step", {})
         if s.get("measured_s"):
             lines.append(
@@ -264,6 +295,28 @@ def attribute_compiled(compiled, name: str, n_devices: int = 1,
     fl = costmodel.analytic_flops(hlo_text)
     per_class = costmodel.instruction_bytes(hlo_text)
     dtype_split = costmodel.bytes_by_dtype(per_class)
+    # memory plane: costmodel entry-signature prediction reconciled
+    # against the compiled memory_analysis(), plus the measured live/
+    # peak gauges when the memory plane is armed
+    io_pred = costmodel.entry_io_bytes(hlo_text)
+    mem_compiled = costmodel.memory_breakdown(compiled)
+    memory_section: Dict = {
+        "predicted": dict(io_pred,
+                          peak_bytes=io_pred["argument_bytes"]
+                          + io_pred["output_bytes"]),
+    }
+    if mem_compiled:
+        memory_section["compiled"] = mem_compiled
+        denom = (mem_compiled["argument_bytes"]
+                 + mem_compiled["output_bytes"])
+        pred = io_pred["argument_bytes"] + io_pred["output_bytes"]
+        memory_section["predicted_vs_compiled"] = (
+            round(pred / denom, 4) if denom else None)
+    from . import memory as _memory
+    measured_mem = _memory.measured_snapshot()
+    if measured_mem:
+        memory_section["measured"] = measured_mem
+    _memory.note_program(name, breakdown=mem_compiled or None)
     acct = audit.collective_accounting(hlo_text)
     wire = 0
     for kind, info in acct.items():
@@ -339,6 +392,7 @@ def attribute_compiled(compiled, name: str, n_devices: int = 1,
         "overlap": overlap,
         "roofline": roof,
         "step": step,
+        "memory": memory_section,
     }
     if extra:
         data.update(extra)
@@ -487,6 +541,11 @@ def phases_block(report: AttributionReport,
         "mfu": d.get("step", {}).get("mfu"),
         "overlap_pct": d.get("overlap", {}).get("overlap_pct"),
     }
+    mem = d.get("memory", {})
+    peak = (mem.get("compiled") or {}).get("peak_bytes") \
+        or (mem.get("predicted") or {}).get("peak_bytes")
+    if peak:
+        out["peak_hbm_bytes"] = int(peak)
     if report_path:
         out["report"] = report_path
     return out

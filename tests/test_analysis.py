@@ -659,3 +659,53 @@ def test_audit_trail_covers_every_collective_kind():
     kinds = {e["kind"] for e in audit.collective_log()
              if "moe" in e["tag"]}
     assert kinds == {"all-to-all", "all-reduce"}
+
+
+# ---------------------------------------------------------------------------
+# GC501: pre-flight HBM capacity (the memory plane's graphcheck rule)
+# ---------------------------------------------------------------------------
+
+def test_gc501_capacity_exceeded_flagged():
+    rep = graphcheck.check_capacity(32e9, capacity_bytes=16e9,
+                                    target="seeded")
+    assert _rules(rep) == ["GC501"]
+    (f,) = rep.errors()
+    assert "32.00 GB" in f.message and "16.00 GB" in f.message
+    assert f.extra["predicted_bytes"] == 32_000_000_000
+
+
+def test_gc501_clean_under_capacity_and_unknown_capacity(monkeypatch):
+    assert len(graphcheck.check_capacity(8e9, capacity_bytes=16e9)) == 0
+    # unknown capacity (CPU dev box, no env override): rule disables
+    monkeypatch.delenv("MXNET_TPU_DEVICE_HBM_GB", raising=False)
+    assert len(graphcheck.check_capacity(1e18)) == 0
+    # env override supplies the capacity where the backend reports none
+    monkeypatch.setenv("MXNET_TPU_DEVICE_HBM_GB", "16")
+    from mxnet_tpu.telemetry import memory as _memory
+    assert _memory.device_capacity_bytes() == 16e9
+    assert _rules(graphcheck.check_capacity(32e9)) == ["GC501"]
+
+
+def test_gc501_trainer_preflight_seeded_and_clean(tmp_path, monkeypatch):
+    """End-to-end: a trainer whose state+batch cannot fit the (tiny,
+    env-seeded) capacity is refused BEFORE dispatch with a GC501 ERROR;
+    with a sane capacity the same trainer passes."""
+    monkeypatch.setenv("MXNET_TPU_PREFLIGHT", "1")
+    monkeypatch.setenv("MXNET_TPU_PREFLIGHT_DIR", str(tmp_path))
+    monkeypatch.setenv("MXNET_TPU_DEVICE_HBM_GB", "0.000001")  # 1 kB
+    trainer, (params, mom, aux) = _toy_trainer()
+    batch = {"data": np.zeros((8, 32), np.float32),
+             "softmax_label": np.zeros(8, np.float32)}
+    with pytest.raises(PreflightError) as ei:
+        trainer.step(params, mom, aux, batch)
+    assert "GC501" in str(ei.value)
+    assert "RESOURCE_EXHAUSTED" in str(ei.value)
+
+    monkeypatch.setenv("MXNET_TPU_DEVICE_HBM_GB", "16")
+    trainer2, (p2, m2, a2) = _toy_trainer()
+    p2, m2, a2, loss = trainer2.step(p2, m2, a2, batch)
+    assert np.isfinite(float(loss))
+    reports = [p for p in os.listdir(str(tmp_path))
+               if p.startswith("preflight-trainer") and p.endswith(".json")]
+    clean = Report.load(str(tmp_path / sorted(reports)[-1]))
+    assert not [f for f in clean if f.rule == "GC501"]

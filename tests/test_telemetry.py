@@ -139,9 +139,12 @@ def test_disarmed_is_zero_cost_and_records_nothing():
 
 def _check_nesting(events, eps_us=0.5):
     """Every pair of X events on one (pid, tid) lane must be disjoint or
-    properly nested."""
+    properly nested.  Counter-track events (ph "C": the attribution and
+    live-HBM counters) carry no duration and are skipped."""
     lanes = {}
     for e in events:
+        if e["ph"] == "C":
+            continue
         assert e["ph"] == "X" and e["dur"] >= 0, e
         lanes.setdefault((e.get("pid", 0), e["tid"]), []).append(e)
     for lane_events in lanes.values():

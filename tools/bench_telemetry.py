@@ -26,17 +26,24 @@ sys.path.insert(0, _REPO)
 
 def bench_disarmed_gates(n=20000):
     """Per-step disarmed telemetry cost: the 3 spans + 1 counter + 1
-    window tick ShardedTrainer.step issues."""
+    window tick ShardedTrainer.step issues, PLUS the memory-plane hooks
+    it gained in ISSUE 7 (oom_guard frame, batch tag, note_step) — the
+    gate bound covers the whole instrumented surface."""
     from mxnet_tpu import telemetry
+    from mxnet_tpu.telemetry import memory
     telemetry.disarm()
+    memory.reset()
+    fake_batch = {"data": None, "softmax_label": None}
     t0 = time.perf_counter()
     for i in range(n):
-        with telemetry.span("bench/step", cat="train",
-                            metric="train.step_seconds", step=i):
+        with memory.oom_guard("bench/step", step=i), \
+                telemetry.span("bench/step", cat="train",
+                               metric="train.step_seconds", step=i):
             with telemetry.span("bench/enqueue", cat="train"):
-                pass
+                memory.tag(fake_batch, "batch")
             with telemetry.span("bench/wait", cat="train"):
                 pass
+        memory.note_step(i)
         telemetry.count("train.steps")
         telemetry.window_tick()
     per_step = (time.perf_counter() - t0) / n

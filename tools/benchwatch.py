@@ -31,6 +31,13 @@ accepts bench.py's raw JSON line or the driver's BENCH_r*.json wrapper
 (``{"parsed": {...}}``); bench.py appends automatically when
 ``BENCH_LEDGER`` names a ledger path.
 
+Ledger entry schema: ``{"t", "source", "metrics": {...}}`` plus an
+optional ``"extra"`` block for recorded-but-not-gated fields — today
+the memory plane's per-benchmark ``peak_hbm_bytes`` (and
+``transformer_peak_hbm_bytes``) lifted from the bench ``phases``
+block.  Extras never enter the gate: metrics are higher-is-better, and
+a peak-HBM improvement (a drop) must not read as a regression.
+
 Exit status: check → 0 clean, 1 regression(s), 2 unreadable ledger.
 """
 import argparse
@@ -71,6 +78,28 @@ def extract_metrics(doc):
     if isinstance(sub, dict):
         for k, v in extract_metrics(sub).items():
             out[k] = v
+    return out
+
+
+def extract_extra(doc):
+    """Recorded-but-not-gated fields from a bench document — today the
+    memory plane's peak HBM per benchmark (phases.peak_hbm_bytes).
+    These land in the ledger entry's ``extra`` block, NOT ``metrics``:
+    the gate treats every metric as higher-is-better, and a peak-HBM
+    *improvement* (a drop) must never read as a regression."""
+    if not isinstance(doc, dict):
+        return {}
+    if "parsed" in doc and isinstance(doc["parsed"], dict):
+        doc = doc["parsed"]
+    out = {}
+    phases = doc.get("phases")
+    if isinstance(phases, dict) and isinstance(
+            phases.get("peak_hbm_bytes"), (int, float)):
+        out["peak_hbm_bytes"] = int(phases["peak_hbm_bytes"])
+    sub = doc.get("transformer")
+    if isinstance(sub, dict):
+        for k, v in extract_extra(sub).items():
+            out["transformer_" + k] = v
     return out
 
 
@@ -175,16 +204,20 @@ def check_ledger(entries, sigma_mult=SIGMA_MULT, floor=FLOOR):
 
 def _cmd_append(args):
     metrics = {}
+    extra = {}
     sources = []
     for path in args.from_bench or []:
         with open(path) as f:
-            metrics.update(extract_metrics(json.load(f)))
+            doc = json.load(f)
+        metrics.update(extract_metrics(doc))
+        extra.update(extract_extra(doc))
         sources.append(os.path.basename(path))
     for kv in args.metric or []:
         k, _, v = kv.partition("=")
         metrics[k] = float(v)
     entry = append_entry(args.ledger, metrics,
-                         source=args.source or ",".join(sources))
+                         source=args.source or ",".join(sources),
+                         extra=extra or None)
     print(json.dumps(entry, sort_keys=True))
     return 0
 
@@ -229,6 +262,10 @@ def _cmd_show(args):
                              time.localtime(e["t"])) if e.get("t") else "-"
         ms = "  ".join("%s=%.4g" % kv for kv in
                        sorted(e["metrics"].items()))
+        ex = e.get("extra") or {}
+        if ex:
+            ms += "  [" + "  ".join("%s=%.4g" % kv
+                                    for kv in sorted(ex.items())) + "]"
         print("%3d  %s  %-14s %s" % (i + 1, when, e.get("source") or "-",
                                      ms))
     return 0

@@ -1,0 +1,219 @@
+#!/usr/bin/env python3
+"""Memory-plane operator console (stdlib-only).
+
+Two modes over the artifacts telemetry/memory.py produces:
+
+1. **Live tail** — watch the ``mem.*`` gauges of a process exporting
+   telemetry (``MXNET_TPU_TELEMETRY=1`` +
+   ``MXNET_TPU_TELEMETRY_JSONL=/path/metrics.jsonl``): live bytes by
+   tag, peak, per-device allocator use.  Reuses metricsdump's
+   FollowReader, so the tail survives feed truncation/rotation.
+
+2. **OOM post-mortem report** — pretty-print an
+   ``oom-postmortem-*.json`` the way tools/postmortem.py renders hang
+   reports: the error, the tripping program's compiled breakdown, the
+   top live buffers by size (with tags), the by-tag totals, the
+   timeline tail, and the actionable hint.
+
+Usage:
+    python tools/memwatch.py METRICS.jsonl [options]      # gauge tail
+    python tools/memwatch.py --report OOM.json [--top N]  # post-mortem
+
+    --follow, -f       keep tailing new snapshots (ctrl-C to stop)
+    --interval S       follow-mode poll interval (default 1.0)
+    --last N           non-follow mode: render the last N snapshots (1)
+    --report FILE      pretty-print an OOM post-mortem instead
+    --top N            rows in the buffer table (default 15); also
+                       applies to the live-tail tag table
+
+Exit status: 0, or 2 on a missing/unreadable file.
+"""
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _load_metricsdump():
+    spec = importlib.util.spec_from_file_location(
+        "mxt_metricsdump", os.path.join(_HERE, "metricsdump.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _mb(v):
+    if v is None:
+        return "-"
+    return "%.1f MB" % (float(v) / 1e6)
+
+
+# ---------------------------------------------------------------------------
+# live tail: the mem.* slice of a telemetry JSONL feed
+# ---------------------------------------------------------------------------
+
+def _gauge_series(snap, name):
+    desc = snap.get("metrics", {}).get(name)
+    return desc["series"] if desc else []
+
+
+def render_mem(snap, top=15):
+    """One telemetry snapshot -> the memory console block."""
+    when = time.strftime("%H:%M:%S", time.localtime(snap.get("time", 0)))
+    lines = ["--- memory @ %s" % when]
+    total = peak = None
+    for s in _gauge_series(snap, "mem.live_bytes_total"):
+        total = s["value"]
+    for s in _gauge_series(snap, "mem.peak_live_bytes"):
+        peak = s["value"]
+    lines.append("  live %s   peak %s" % (_mb(total), _mb(peak)))
+    tags = [(s["labels"].get("tag", "?"), s["value"])
+            for s in _gauge_series(snap, "mem.live_bytes")]
+    for tag, val in sorted(tags, key=lambda kv: -kv[1])[:top]:
+        share = ""
+        if total:
+            share = "  (%4.1f%%)" % (100.0 * val / total)
+        lines.append("    %-12s %12s%s" % (tag, _mb(val), share))
+    for s in _gauge_series(snap, "mem.device_bytes_in_use"):
+        lines.append("  device %-4s in use %s"
+                     % (s["labels"].get("device", "?"), _mb(s["value"])))
+    for s in _gauge_series(snap, "mem.leak_growth_bytes"):
+        if s["value"]:
+            lines.append("  !! leak suspected: +%s over the watchdog "
+                         "window" % _mb(s["value"]))
+    return "\n".join(lines)
+
+
+def _has_mem(snap):
+    return any(name.startswith("mem.")
+               for name in snap.get("metrics", {}))
+
+
+# ---------------------------------------------------------------------------
+# OOM post-mortem rendering
+# ---------------------------------------------------------------------------
+
+def render_report(doc, top=15):
+    rule = "=" * 72
+    lines = [rule, "OOM POST-MORTEM rank %s pid %s" % (doc.get("rank"),
+                                                       doc.get("pid")),
+             rule]
+    when = doc.get("time")
+    if when:
+        lines.append("when:    %s" % time.strftime(
+            "%Y-%m-%d %H:%M:%S", time.localtime(when)))
+    lines.append("where:   %s (step %s)" % (doc.get("tag"),
+                                            doc.get("step")))
+    lines.append("error:   %s" % (doc.get("error") or "?"))
+    lines.append("program: %s" % (doc.get("program") or "?"))
+    pm = doc.get("program_memory") or {}
+    if pm:
+        lines.append(
+            "  compiled breakdown: args %s + outputs %s + temps %s "
+            "- aliased %s = peak %s"
+            % (_mb(pm.get("argument_bytes")), _mb(pm.get("output_bytes")),
+               _mb(pm.get("temp_bytes")), _mb(pm.get("alias_bytes")),
+               _mb(pm.get("peak_bytes"))))
+    cap = doc.get("capacity_bytes")
+    if cap:
+        lines.append("capacity: %s per device" % _mb(cap))
+    by_tag = doc.get("live_bytes_by_tag") or {}
+    total = by_tag.get("total")
+    lines.append("-" * 72)
+    lines.append("live bytes by tag (total %s):" % _mb(total))
+    for tag, val in sorted(by_tag.items(), key=lambda kv: -kv[1]):
+        if tag == "total":
+            continue
+        lines.append("  %-12s %12s" % (tag, _mb(val)))
+    lines.append("-" * 72)
+    lines.append("top live buffers:")
+    lines.append("  %-10s %-22s %-10s %-12s %s"
+                 % ("size", "shape", "dtype", "tag", "label"))
+    for row in (doc.get("top_buffers") or [])[:top]:
+        lines.append("  %-10s %-22s %-10s %-12s %s"
+                     % (_mb(row.get("nbytes")),
+                        "x".join(str(d) for d in row.get("shape", []))
+                        or "scalar",
+                        row.get("dtype", "?"), row.get("tag", "?"),
+                        row.get("label", "")))
+        if row.get("backtrace"):
+            for ln in str(row["backtrace"]).rstrip().splitlines()[-4:]:
+                lines.append("      | %s" % ln.strip())
+    timeline = (doc.get("timeline") or {}).get("samples") or []
+    if timeline:
+        lines.append("-" * 72)
+        lines.append("timeline (last %d samples):" % len(timeline))
+        for s in timeline[-8:]:
+            lines.append("  %s  %s" % (
+                time.strftime("%H:%M:%S", time.localtime(s["t"])),
+                _mb(s.get("total_bytes"))))
+    leak = doc.get("leak")
+    if leak:
+        lines.append("leak watchdog: +%s over %s samples"
+                     % (_mb(leak.get("growth_bytes")),
+                        leak.get("samples")))
+    hint = doc.get("hint")
+    if hint:
+        lines.append("-" * 72)
+        lines.append("hint: %s" % hint)
+    lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", nargs="?")
+    ap.add_argument("--report", metavar="FILE")
+    ap.add_argument("--top", type=int, default=15)
+    ap.add_argument("--follow", "-f", action="store_true")
+    ap.add_argument("--interval", type=float, default=1.0)
+    ap.add_argument("--last", type=int, default=1)
+    args = ap.parse_args(argv)
+
+    if args.report:
+        try:
+            with open(args.report) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print("memwatch: cannot read report: %s" % e, file=sys.stderr)
+            return 2
+        print(render_report(doc, top=args.top))
+        return 0
+
+    if not args.path or not os.path.isfile(args.path):
+        print("memwatch: no such file: %s" % args.path, file=sys.stderr)
+        return 2
+
+    md = _load_metricsdump()
+    if not args.follow:
+        with open(args.path) as f:
+            snaps = [s for s in md._parse_lines(f.readlines())
+                     if _has_mem(s)]
+        if not snaps:
+            print("memwatch: feed has no mem.* gauges yet (is the "
+                  "memory plane armed? MXNET_TPU_MEMWATCH=1)")
+            return 0
+        for s in snaps[-args.last:]:
+            print(render_mem(s, top=args.top))
+        return 0
+
+    reader = md.FollowReader(args.path)
+    try:
+        while True:
+            for s in reader.poll():
+                if _has_mem(s):
+                    print(render_mem(s, top=args.top))
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        reader.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
