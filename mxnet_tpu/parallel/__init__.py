@@ -19,7 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .mesh import (MeshSpec, current_mesh, data_parallel_mesh, make_mesh,
-                   set_current_mesh, shard_batch, replicate)
+                   reform_mesh, set_current_mesh, shard_batch, replicate)
 
 Topology = namedtuple("Topology", ["process_index", "process_count",
                                    "local_device_count",

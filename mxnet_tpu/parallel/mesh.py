@@ -19,22 +19,28 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["MeshSpec", "make_mesh", "data_parallel_mesh", "current_mesh",
-           "set_current_mesh", "shard_batch", "replicate", "P",
-           "describe_devices"]
+__all__ = ["MeshSpec", "make_mesh", "data_parallel_mesh", "reform_mesh",
+           "current_mesh", "set_current_mesh", "shard_batch", "replicate",
+           "P", "describe_devices"]
 
 
 class MeshSpec:
-    """A mesh plus the axis layout used by the sharded trainer."""
+    """A mesh plus the axis layout used by the sharded trainer.
+
+    ``generation`` is the elastic-training incarnation counter: every
+    coordinated resize (resilience/elastic.py) re-forms the mesh over
+    the surviving device set and bumps it, so telemetry digests and the
+    fleet view can tell a live row from a pre-resize ghost."""
 
     def __init__(self, mesh: Mesh, dp_axis="dp", tp_axis=None, pp_axis=None,
-                 sp_axis=None, ep_axis=None):
+                 sp_axis=None, ep_axis=None, generation=0):
         self.mesh = mesh
         self.dp_axis = dp_axis
         self.tp_axis = tp_axis
         self.pp_axis = pp_axis
         self.sp_axis = sp_axis
         self.ep_axis = ep_axis
+        self.generation = int(generation)
 
     @property
     def dp_size(self):
@@ -62,10 +68,48 @@ def make_mesh(shape: Sequence[int], axis_names: Sequence[str],
     return Mesh(arr, tuple(axis_names))
 
 
-def data_parallel_mesh(num_devices: Optional[int] = None) -> MeshSpec:
+def data_parallel_mesh(num_devices: Optional[int] = None,
+                       generation: Optional[int] = None) -> MeshSpec:
+    """Pure-dp mesh over the current (global) device set.  ``generation``
+    defaults to the elastic incarnation counter, so a gang relaunched
+    after a resize gets a correctly-stamped mesh for free."""
     devices = jax.devices()
     n = num_devices or len(devices)
-    return MeshSpec(make_mesh((n,), ("dp",)))
+    if generation is None:
+        try:
+            from ..resilience import elastic
+            generation = elastic.generation()
+        except Exception:
+            generation = 0
+    return MeshSpec(make_mesh((n,), ("dp",)), generation=generation)
+
+
+def reform_mesh(spec: MeshSpec, generation: Optional[int] = None) -> MeshSpec:
+    """Re-form ``spec`` over the CURRENT device set — the elastic-resize
+    re-layout: after survivors relaunch at a smaller (or restored) world
+    size, the same axis layout is rebuilt over however many devices now
+    exist, with the generation bumped.  Non-dp axes keep their extent
+    (model parallelism doesn't shrink with the fleet); the dp axis
+    absorbs the change, so the checkpoint's resharding restore and the
+    trainer's grad-accum adjustment see a consistent topology."""
+    devices = jax.devices()
+    axes = list(spec.mesh.axis_names)
+    sizes = dict(spec.mesh.shape)
+    other = 1
+    for a in axes:
+        if a != spec.dp_axis:
+            other *= sizes[a]
+    if other <= 0 or len(devices) % other:
+        raise ValueError(
+            "cannot re-form mesh %s over %d devices: non-dp axes need "
+            "%d-device multiples" % (dict(sizes), len(devices), other))
+    sizes[spec.dp_axis] = len(devices) // other
+    shape = tuple(sizes[a] for a in axes)
+    gen = spec.generation + 1 if generation is None else int(generation)
+    return MeshSpec(make_mesh(shape, axes), dp_axis=spec.dp_axis,
+                    tp_axis=spec.tp_axis, pp_axis=spec.pp_axis,
+                    sp_axis=spec.sp_axis, ep_axis=spec.ep_axis,
+                    generation=gen)
 
 
 def current_mesh() -> Optional[MeshSpec]:
@@ -107,7 +151,8 @@ def describe_devices() -> dict:
         spec = current_mesh()
         if spec is not None:
             out["mesh"] = {"shape": dict(spec.mesh.shape),
-                           "axes": list(spec.mesh.axis_names)}
+                           "axes": list(spec.mesh.axis_names),
+                           "generation": spec.generation}
     except Exception as e:
         out["mesh"] = repr(e)
     return out

@@ -49,7 +49,7 @@ class ShardedTrainer:
                  wd=0.0001, loss_scale=1.0, param_dtype=None,
                  shard_optimizer_state=False, dynamic_loss_scale=False,
                  loss_scale_growth_interval=2000, nonfinite_budget=None,
-                 guard_nonfinite=True):
+                 guard_nonfinite=True, grad_accum=1):
         self.symbol = symbol
         self.spec = spec
         self.prog = GraphProgram(symbol)
@@ -66,6 +66,15 @@ class ShardedTrainer:
         self.momentum = momentum
         self.wd = wd
         self.param_dtype = param_dtype
+        # gradient accumulation: one optimizer update per `grad_accum`
+        # micro-batches, all inside ONE jitted program (lax.scan over a
+        # leading micro dim).  The elastic-training resize uses this to
+        # keep the GLOBAL batch constant when the world size changes:
+        # accum = global_batch / (world * micro_batch)
+        # (resilience/elastic.py grad_accum_for).
+        if int(grad_accum) < 1:
+            raise ValueError("grad_accum must be >= 1, got %r" % grad_accum)
+        self.grad_accum = int(grad_accum)
         self._step = None
         from ..executor import backward_mirror_policy
         self._built_remat = backward_mirror_policy()
@@ -283,11 +292,41 @@ class ShardedTrainer:
             loss, extra = loss_fn(params, inputs, aux, keys)
             return loss * scale, (loss, extra)
 
+        accum = self.grad_accum
+        num_rng = prog.num_rng
+
         def step_fn(params, mom, aux, inputs, keys, guard):
             scale, good = guard
-            (_, (loss, (outs, new_aux))), grads = jax.value_and_grad(
-                scaled_loss_fn, argnums=0, has_aux=True)(
-                    params, inputs, aux, keys, scale)
+            if accum == 1:
+                (_, (loss, (outs, new_aux))), grads = jax.value_and_grad(
+                    scaled_loss_fn, argnums=0, has_aux=True)(
+                        params, inputs, aux, keys, scale)
+            else:
+                # gradient accumulation: inputs carry a leading micro
+                # dim (accum, micro_bs, ...); scan folds the micro
+                # grads into one f32 accumulator (the memory point of
+                # accumulation — one micro-batch of activations live at
+                # a time) and aux (BN stats) threads through micros
+                # exactly like consecutive steps would.  Loss heads
+                # carry per-sample gradients (normalization='null'), so
+                # the summed grads equal one big (accum*micro)-batch
+                # step bit-for-bit up to fp reassociation.
+                def micro_step(carry, micro_inputs):
+                    grads_c, aux_c, loss_c, i = carry
+                    keys_i = (jax.vmap(
+                        lambda k: jax.random.fold_in(k, i))(keys)
+                        if num_rng else keys)
+                    (_, (loss_i, (_outs, aux_n))), g = jax.value_and_grad(
+                        scaled_loss_fn, argnums=0, has_aux=True)(
+                            params, micro_inputs, aux_c, keys_i, scale)
+                    grads_c = tuple(gc + gi.astype(jnp.float32)
+                                    for gc, gi in zip(grads_c, g))
+                    return (grads_c, aux_n, loss_c + loss_i, i + 1), None
+                init = (tuple(jnp.zeros(p.shape, jnp.float32)
+                              for p in params),
+                        aux, jnp.float32(0.0), jnp.int32(0))
+                (grads, new_aux, loss, _), _ = jax.lax.scan(
+                    micro_step, init, inputs)
             new_params, new_mom = _tree_sgd(
                 params, grads, mom, lr, momentum, wd, 1.0 / scale)
             ok = _guards.all_finite(loss, grads)
@@ -309,10 +348,19 @@ class ShardedTrainer:
         return (self._param_shardings(), self._mom_shardings(),
                 tuple(rep for _ in self.prog.aux_names))
 
+    def _batch_in_sharding(self):
+        """Input sharding for one batch tensor: dp over dim 0, or — with
+        grad accumulation — dp over dim 1 under the unsharded micro
+        dim the in-jit scan walks."""
+        if self.grad_accum > 1:
+            return NamedSharding(self.spec.mesh,
+                                 P(None, self.spec.dp_axis))
+        return self.spec.batch_sharding()
+
     def _build_step(self, donate=True):
         step_fn = self._make_step_fn()
         rep = self.spec.replicated()
-        bat = self.spec.batch_sharding()
+        bat = self._batch_in_sharding()
         pshard, mshard, ashard = self._state_shardings()
         in_shardings = (
             pshard,                                 # params (tp-aware)
@@ -353,7 +401,7 @@ class ShardedTrainer:
 
         step_fn = self._make_step_fn()
         rep = self.spec.replicated()
-        bat = self.spec.batch_sharding()
+        bat = self._batch_in_sharding()
         pshard, mshard, ashard = self._state_shardings()
 
         def auto(shardings):
@@ -408,9 +456,55 @@ class ShardedTrainer:
                                                     or "symbol"), compiled)
         return compiled, params, mom, aux
 
-    def step(self, params, mom, aux, batch: Dict[str, np.ndarray]):
-        """One synchronous data-parallel SGD step.  batch arrays are global
-        (host) arrays; they get sharded over dp.
+    def set_grad_accum(self, accum: int):
+        """Change the gradient-accumulation factor (one optimizer update
+        per ``accum`` micro-batches).  The elastic resize path calls this
+        after a world-size change so ``world * micro_batch * accum`` —
+        the GLOBAL batch — stays constant.  Rebuilds the step program on
+        next use; returns self."""
+        accum = int(accum)
+        if accum < 1:
+            raise ValueError("grad_accum must be >= 1, got %r" % accum)
+        if accum != self.grad_accum:
+            self.grad_accum = accum
+            self._step = None
+        return self
+
+    def _prepare_batch(self, batch):
+        """Host-side batch shaping: with grad accumulation the per-update
+        batch (accum*micro, ...) folds into (accum, micro, ...) so the
+        in-jit scan walks the leading dim."""
+        accum = self.grad_accum
+        out = {}
+        for n, v in batch.items():
+            v = np.asarray(v) if not hasattr(v, "reshape") else v
+            if accum > 1:
+                if v.shape[0] % accum:
+                    raise ValueError(
+                        "batch dim %d of %r is not divisible by "
+                        "grad_accum=%d" % (v.shape[0], n, accum))
+                v = v.reshape((accum, v.shape[0] // accum) + v.shape[1:])
+            out[n] = v
+        return out
+
+    def _put_batch(self, v, local_batch):
+        """Device placement for one (already accum-folded) batch tensor.
+        ``local_batch``: v is this PROCESS's shard of the global batch
+        (multi-host data loading — each rank reads only its part); the
+        global array is assembled across processes without any host
+        gather."""
+        sharding = self._batch_in_sharding()
+        if local_batch:
+            return jax.make_array_from_process_local_data(
+                sharding, np.asarray(v))
+        return jax.device_put(v, sharding)
+
+    def step(self, params, mom, aux, batch: Dict[str, np.ndarray],
+             local_batch: bool = False):
+        """One synchronous data-parallel SGD step (one optimizer update =
+        ``grad_accum`` micro-batches).  batch arrays are global (host)
+        arrays sharded over dp — or, with ``local_batch=True``, each
+        process's own shard of the global batch.
 
         Resilience semantics: a non-finite loss/grad step applies NO
         update (params/mom/aux come back unchanged), backs the loss scale
@@ -428,7 +522,6 @@ class ShardedTrainer:
         if self._step is None or remat != self._built_remat:
             self._built_remat = remat
             self._step = self._build_step()
-        self._maybe_preflight(params, mom, aux, batch)
         self._step_count += 1
         _chaos.maybe_preempt(self._step_count)
         if _chaos.fire("nan_grad", self._step_count) is not None:
@@ -437,6 +530,19 @@ class ShardedTrainer:
             poison = self.data_names[0]
             batch = dict(batch)
             batch[poison] = np.full_like(np.asarray(batch[poison]), np.nan)
+        batch = self._prepare_batch(batch)
+        if not self._preflight_done:
+            # trace-check with GLOBAL shapes: under local_batch each
+            # process only holds its shard, but the program is SPMD
+            mul = jax.process_count() if local_batch else 1
+            bdim = 1 if self.grad_accum > 1 else 0
+            sds = {}
+            for n, v in batch.items():
+                shape = list(np.asarray(v).shape)
+                shape[bdim] *= mul
+                sds[n] = jax.ShapeDtypeStruct(tuple(shape),
+                                              np.asarray(v).dtype)
+            self._maybe_preflight(params, mom, aux, sds)
         # the deadline covers everything a stall can hide in: the chaos
         # hang drill, host->device transfer, and the jitted step with its
         # fused gradient psum (a dead peer blocks right here); the oom
@@ -457,7 +563,7 @@ class ShardedTrainer:
             with _tel.span("train/host_enqueue", cat="train",
                            metric="train.host_enqueue_seconds",
                            step=self._step_count):
-                inputs = {n: jax.device_put(v, self.spec.batch_sharding())
+                inputs = {n: self._put_batch(v, local_batch)
                           for n, v in batch.items()}
                 _memory.tag(inputs, "batch", label="ShardedTrainer.step")
                 keys = self._keys()

@@ -27,10 +27,16 @@ that layer (cf. "TensorFlow: a system for large-scale ML", arXiv:1605.08695
   restart path kicks in; plus a coordination-KV heartbeat lane giving
   ``num_dead_node``/straggler telemetry without issuing collectives.
 * ``chaos``      — fault injection (env or context manager): simulated
-  preemption, checkpoint corruption, NaN gradients, transient IO
-  errors, silent hangs, and serving-path faults (slow/failing
-  executors, poisoned model swaps).  The resilience tests use it to
-  prove recovery end-to-end.
+  preemption (hard and graceful ``preempt_notice``), checkpoint
+  corruption, NaN gradients, transient IO errors, silent hangs, and
+  serving-path faults (slow/failing executors, poisoned model swaps).
+  The resilience tests use it to prove recovery end-to-end.
+* ``elastic``    — elastic training: on a dead peer or a preemption
+  notice the survivors agree on a new membership over the heartbeat
+  lane (barrier-free consensus), commit a resize manifest, and exit
+  for the elastic launcher to re-form a SMALLER mesh from the latest
+  checkpoint (grad-accum adjusted so the global batch is unchanged) —
+  then grow back when capacity returns.
 
 The inference-side counterpart — admission control, deadlines, circuit
 breaking and hot model-swap built ON these primitives — is
@@ -44,7 +50,9 @@ from .checkpoint import (Checkpoint, CheckpointManager, restore_gluon_trainer,
 from .guards import GradientGuard, NonFiniteError
 from .retry import call_with_retry, retry_config
 from .watchdog import HeartbeatLane, Watchdog
+from .elastic import ElasticCoordinator
 from . import chaos
+from . import elastic
 from . import watchdog
 
 __all__ = [
@@ -52,6 +60,6 @@ __all__ = [
     "Checkpoint", "CheckpointManager", "save_trainer", "restore_trainer",
     "save_module", "restore_module", "save_gluon_trainer",
     "restore_gluon_trainer", "GradientGuard", "NonFiniteError",
-    "call_with_retry", "retry_config", "chaos", "watchdog", "Watchdog",
-    "HeartbeatLane",
+    "call_with_retry", "retry_config", "chaos", "elastic", "watchdog",
+    "Watchdog", "HeartbeatLane", "ElasticCoordinator",
 ]

@@ -7,6 +7,12 @@ recovery machinery is *proven* by tests instead of trusted:
 
 * ``preempt``      — raise :class:`SimulatedPreemption` out of the train
   step, mimicking the coordinator tearing the program down mid-epoch.
+* ``preempt_notice`` — the GRACEFUL variant: a spot/maintenance notice
+  with a grace window (``grace`` param or
+  ``MXNET_TPU_CHAOS_PREEMPT_GRACE_SECONDS``, default 30).  Nothing is
+  raised; :func:`maybe_preempt_notice` returns the grace seconds so the
+  elastic coordinator (resilience/elastic.py) can checkpoint-then-exit
+  cleanly and the survivors resize without a failed collective.
 * ``nan_grad``     — poison the step's input batch with NaN so the real
   in-step non-finite detection path fires (not a shortcut flag).
 * ``io_error``     — raise ``OSError`` from an IO read; exercises the
@@ -48,9 +54,9 @@ import os
 from typing import List, Optional
 
 __all__ = ["SimulatedPreemption", "inject", "fire", "maybe_preempt",
-           "maybe_io_error", "maybe_hang", "maybe_slow_exec",
-           "maybe_exec_error", "maybe_oom", "corrupt_latest", "active",
-           "reset"]
+           "maybe_preempt_notice", "maybe_io_error", "maybe_hang",
+           "maybe_slow_exec", "maybe_exec_error", "maybe_oom",
+           "corrupt_latest", "active", "reset"]
 
 
 class SimulatedPreemption(RuntimeError):
@@ -157,6 +163,21 @@ def maybe_preempt(step: Optional[int] = None):
     if fire("preempt", step) is not None:
         raise SimulatedPreemption(
             "chaos: simulated host preemption at step %s" % step)
+
+
+def maybe_preempt_notice(step: Optional[int] = None) -> Optional[float]:
+    """Return the grace window (seconds) if a ``preempt_notice`` fault
+    fires now, else None — the graceful spot/maintenance-notice drill.
+    Unlike ``preempt`` nothing is raised: the caller (the elastic
+    coordinator's precheck) is expected to checkpoint and exit cleanly
+    WITHIN the window, so peers resize without ever entering a doomed
+    collective."""
+    params = fire("preempt_notice", step)
+    if params is None:
+        return None
+    return float(params.get(
+        "grace",
+        os.environ.get("MXNET_TPU_CHAOS_PREEMPT_GRACE_SECONDS", "30")))
 
 
 def maybe_hang(step: Optional[int] = None):

@@ -1,0 +1,936 @@
+"""Elastic training: survivors re-form a smaller mesh and keep training.
+
+PRs 1-2 made a lost rank *survivable* — checkpoint-restart with exact-resume
+iterators — but it still costs a full gang restart at the ORIGINAL world
+size: if the capacity is gone (spot preemption, maintenance), the job cannot
+run at all until it returns.  This module closes the loop (ROADMAP item 5;
+the failure model the TensorFlow system paper treats as table stakes, and
+the re-layout-on-resize operation "Automatic Cross-Replica Sharding of
+Weight Update in Data-Parallel Training" makes first-class):
+
+* **Detection** — a dead peer (heartbeat-lane staleness, a failed
+  collective, or a watchdog-declared hang) or a *preemption notice*
+  (graceful: chaos ``preempt_notice``, or a real maintenance signal).
+* **Consensus** — the survivors agree on the new membership + mesh
+  generation over the coordination-KV heartbeat lane: barrier-free (a
+  collective among survivors would wedge on the very dead peer being
+  voted out), monotone (member sets only shrink while a round is open),
+  and self-stabilising (a survivor that dies mid-round is dropped after
+  a grace period).
+* **Resize** — the agreed generation is committed to a *manifest* on
+  disk; every survivor checkpoints (lowest live rank), evicts the dead
+  ranks' heartbeat/digest keys (no ghost rows in the fleet view), and
+  exits with the RESIZE exit code (default 44).  The elastic launcher
+  (tools/launch.py ``--elastic``) reads the manifest and relaunches the
+  gang at the new world size: the survivors re-form a smaller mesh
+  (parallel/mesh.py, generation bumped), restore the latest checkpoint
+  (the resharding restore in resilience/checkpoint.py), re-shard the
+  data-iterator order (io.NDArrayIter ``num_parts``/``reshard``), and
+  adjust the gradient-accumulation factor (ShardedTrainer
+  ``set_grad_accum``) so the global batch stays constant.
+* **Grow-back** — the launcher advertises its deliverable capacity in a
+  capacity file; once the shrunken gang has run
+  ``MXNET_TPU_ELASTIC_GROW_STEPS`` steps at the reduced size, the lowest
+  rank publishes a grow intent on the KV and the gang resizes back up
+  the same way (checkpoint → manifest → exit 44 → relaunch at full
+  size).
+
+Env knobs (all optional; constructor arguments win):
+
+=====================================  ====================================
+``MXNET_TPU_ELASTIC``                  master switch for env-driven runs
+``MXNET_TPU_ELASTIC_GEN``              current mesh generation (launcher)
+``MXNET_TPU_ELASTIC_DIR``              manifests + capacity file (default:
+                                       the checkpoint/watchdog dir)
+``MXNET_TPU_ELASTIC_MIN_WORKERS``      never resize below this (default 1)
+``MXNET_TPU_ELASTIC_DEAD_SEC``         heartbeat staleness that declares a
+                                       peer dead (default 10)
+``MXNET_TPU_ELASTIC_CHECK_INTERVAL``   min seconds between full prechecks
+                                       (default 2.0; drills use ~0.1)
+``MXNET_TPU_ELASTIC_GROW_STEPS``       steps at reduced size before trying
+                                       to grow back (default 50)
+``MXNET_TPU_ELASTIC_CKPT_EVERY``       periodic checkpoint cadence in
+                                       steps (default 25)
+``MXNET_TPU_ELASTIC_CONSENSUS_TIMEOUT`` consensus round budget (default 60)
+``MXNET_TPU_ELASTIC_EXIT_CODE``        coordinated-resize exit code (44)
+=====================================  ====================================
+
+Known limitation (documented, not hidden): the coordination-KV service
+lives in process 0, so losing *rank 0* forfeits in-band consensus — the
+guard re-raises and the launcher falls back to a full checkpoint-restart
+(``--max-restarts``).  Real fleets run the coordinator off-worker.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Sequence
+
+__all__ = ["ElasticCoordinator", "ConsensusTimeout", "propose_membership",
+           "generation", "set_generation", "enabled", "grad_accum_for",
+           "manifest_path", "write_manifest", "read_manifest",
+           "read_manifests", "read_capacity", "write_capacity",
+           "watchdog_resize", "current_coordinator", "reset",
+           "DEFAULT_RESIZE_EXIT_CODE"]
+
+DEFAULT_RESIZE_EXIT_CODE = 44
+_MANIFEST_FMT = "elastic-manifest-g%04d.json"
+_CAPACITY_FILE = "elastic-capacity.json"
+PROP_PREFIX = "mxt_el/prop"          # mxt_el/prop/<gen>/<rank> -> [members]
+COMMIT_PREFIX = "mxt_el/commit"      # mxt_el/commit/<gen> -> manifest JSON
+LEAVING_PREFIX = "mxt_el/leaving"    # mxt_el/leaving/<rank> -> notice JSON
+GROW_PREFIX = "mxt_el/grow"          # mxt_el/grow/<gen> -> {world_size}
+HISTORY_KEY = "mxt_el/history/0"     # resize history for the fleet view
+HISTORY_DIR = "mxt_el/history/"      # (dir-style: the real coordination
+                                     # client only lists keys UNDER a dir)
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ[name])
+    except (KeyError, ValueError):
+        return float(default)
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ[name])
+    except (KeyError, ValueError):
+        return int(default)
+
+
+class ConsensusTimeout(RuntimeError):
+    """The survivors could not agree on a membership within the budget."""
+
+
+# ---------------------------------------------------------------------------
+# generation — the mesh incarnation counter, stamped into heartbeats/digests
+# ---------------------------------------------------------------------------
+
+_GEN: Optional[int] = None
+_GEN_LOCK = threading.Lock()
+
+
+def generation() -> int:
+    """The current mesh generation (0 for the first incarnation).  Read
+    once from ``MXNET_TPU_ELASTIC_GEN`` (the elastic launcher sets it per
+    gang); ``set_generation``/``reset`` override for tests."""
+    global _GEN
+    with _GEN_LOCK:
+        if _GEN is None:
+            _GEN = _env_int("MXNET_TPU_ELASTIC_GEN", 0)
+        return _GEN
+
+
+def set_generation(gen: int):
+    global _GEN
+    with _GEN_LOCK:
+        _GEN = int(gen)
+
+
+def enabled() -> bool:
+    flag = os.environ.get("MXNET_TPU_ELASTIC", "")
+    return flag not in ("", "0", "false", "off")
+
+
+def grad_accum_for(global_batch: int, micro_batch: int, world: int) -> int:
+    """Gradient-accumulation factor that keeps the global batch constant:
+    ``world * micro_batch * accum == global_batch``.  Raises when the
+    target is not reachable with whole micro-steps — silently changing
+    the global batch under the optimizer is the classic elastic bug."""
+    per_step = micro_batch * world
+    if per_step <= 0 or global_batch % per_step:
+        raise ValueError(
+            "global batch %d is not divisible by world %d x micro-batch %d;"
+            " pick sizes with whole micro-steps at every world size the "
+            "job may shrink to" % (global_batch, world, micro_batch))
+    return global_batch // per_step
+
+
+# ---------------------------------------------------------------------------
+# manifests + capacity file (the launcher <-> gang contract on disk)
+# ---------------------------------------------------------------------------
+
+def manifest_path(directory: str, gen: int) -> str:
+    return os.path.join(os.fspath(directory), _MANIFEST_FMT % int(gen))
+
+
+def write_manifest(directory: str, manifest: dict) -> str:
+    """Atomically write the resize manifest for ``manifest['generation']``
+    (temp → fsync → rename, same discipline as the checkpoints)."""
+    os.makedirs(directory, exist_ok=True)
+    path = manifest_path(directory, manifest["generation"])
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=2, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def read_manifests(directory: str) -> List[dict]:
+    """Every resize manifest under ``directory``, generation-ascending."""
+    out = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return out
+    for name in sorted(names):
+        if name.startswith("elastic-manifest-g") and name.endswith(".json"):
+            try:
+                with open(os.path.join(directory, name)) as f:
+                    out.append(json.load(f))
+            except (OSError, ValueError):
+                continue
+    out.sort(key=lambda m: m.get("generation", 0))
+    return out
+
+
+def read_manifest(directory: str, gen: Optional[int] = None) -> Optional[dict]:
+    """The manifest for ``gen``, or the newest one with ``gen=None``."""
+    if gen is not None:
+        try:
+            with open(manifest_path(directory, gen)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+    all_ = read_manifests(directory)
+    return all_[-1] if all_ else None
+
+
+def write_capacity(directory: str, workers: int) -> str:
+    """The launcher's side of the grow-back contract: how many workers it
+    can currently deliver."""
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(os.fspath(directory), _CAPACITY_FILE)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"workers": int(workers), "time": time.time()}, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def read_capacity(directory: str) -> Optional[int]:
+    try:
+        with open(os.path.join(os.fspath(directory), _CAPACITY_FILE)) as f:
+            return int(json.load(f)["workers"])
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
+# ---------------------------------------------------------------------------
+# barrier-free membership consensus over the coordination KV
+# ---------------------------------------------------------------------------
+
+def _kv_set(client, key, value):
+    from .watchdog import HeartbeatLane
+    HeartbeatLane._kv_set(client, key, value)
+
+
+PROPOSAL_FRESH_SEC = 15.0     # proposals older than this are round litter
+
+
+def read_commit(client, next_gen: int) -> Optional[dict]:
+    """The committed manifest for ``next_gen`` on the KV, if any rank
+    already closed the round (the authoritative follower path).  Note:
+    the real coordination service's ``key_value_dir_get`` only matches
+    keys strictly UNDER a directory prefix, so commits are scanned from
+    the commit directory, never fetched by exact key."""
+    try:
+        entries = client.key_value_dir_get(COMMIT_PREFIX + "/")
+    except Exception:
+        return None
+    for k, v in entries:
+        if str(k).rsplit("/", 1)[-1] != str(next_gen):
+            continue
+        try:
+            return json.loads(str(v))
+        except (ValueError, TypeError):
+            continue
+    return None
+
+
+def round_proposals(client, next_gen: int,
+                    fresh_sec: float = PROPOSAL_FRESH_SEC):
+    """Fresh proposals of the ``next_gen`` round: ``{rank: set(members)}``.
+    Staleness matters: an aborted (false-alarm) round leaves its keys
+    behind, and a dead rank's old proposal must never count as proof of
+    life in a later, real round."""
+    try:
+        entries = client.key_value_dir_get("%s/%d/" % (PROP_PREFIX,
+                                                       next_gen))
+    except Exception:
+        return {}
+    now = time.time()
+    props = {}
+    for k, v in entries:
+        try:
+            r = int(str(k).rsplit("/", 1)[-1])
+            d = json.loads(str(v))
+            if now - float(d.get("t", 0)) > fresh_sec:
+                continue
+            props[r] = {int(m) for m in d["members"]}
+        except (ValueError, TypeError, KeyError):
+            continue
+    return props
+
+
+def propose_membership(client, rank: int, next_gen: int,
+                       timeout: float = 60.0, poll: float = 0.05,
+                       round_min: float = 3.0, on_wait=None) -> List[int]:
+    """Agree on the surviving membership for ``next_gen`` without issuing
+    a single collective.
+
+    The membership is JOIN-BASED: a rank is a member iff it shows up in
+    the round (publishes a fresh proposal under its own KV key) — a
+    published proposal is proof of life, and a truly dead rank can never
+    publish one.  This is what makes the protocol safe for the hardest
+    case: a survivor still WEDGED inside the dying collective joins late
+    (its elastic monitor thread sees the open round), and must not be
+    voted out just because its heartbeat went quiet.  Rules:
+
+    * every participant republishes ``{itself} | {all proposers seen}``
+      each poll (refreshing its timestamp — stale keys from an aborted
+      round never count);
+    * the round stays open at least ``round_min`` seconds, the join
+      window for wedged ranks;
+    * it closes when every member's proposal equals the merged set —
+      including when that set is the FULL current world: the caller
+      detects that nobody actually died (a false alarm, e.g. the same
+      program bug erroring on every rank) and aborts the resize;
+    * a commit record for ``next_gen`` short-circuits everything — some
+      rank already closed the round; adopt its membership.
+
+    Returns the agreed, sorted member list (original-generation rank
+    ids).  Raises :class:`ConsensusTimeout` past ``timeout``.
+    """
+    rank = int(rank)
+    members = {rank}
+    start = time.monotonic()
+    deadline = start + float(timeout)
+    open_since = start + float(round_min)
+    key = "%s/%d/%d" % (PROP_PREFIX, next_gen, rank)
+    while True:
+        committed = read_commit(client, next_gen)
+        if committed is not None:
+            return sorted(int(r) for r in committed["members"])
+        _kv_set(client, key, json.dumps({"members": sorted(members),
+                                         "t": time.time()}))
+        props = round_proposals(client, next_gen)
+        merged = {rank} | set(props)   # fresh proposers ARE the members
+        if merged != members:
+            members = merged
+            continue            # republish the grown view first
+        # agreement: every member showed up and published exactly this set
+        if time.monotonic() >= open_since and \
+                all(r in props and props[r] == members for r in members):
+            return sorted(members)
+        if time.monotonic() >= deadline:
+            raise ConsensusTimeout(
+                "no membership agreement for generation %d after %.1fs: "
+                "my view %s, proposals %s"
+                % (next_gen, timeout, sorted(members),
+                   {r: sorted(v) for r, v in props.items()}))
+        if on_wait is not None:
+            on_wait()
+        time.sleep(poll)
+
+
+# ---------------------------------------------------------------------------
+# the coordinator
+# ---------------------------------------------------------------------------
+
+_COORD: Optional["ElasticCoordinator"] = None
+
+
+def current_coordinator() -> Optional["ElasticCoordinator"]:
+    return _COORD
+
+
+def reset():
+    """Drop the registered coordinator + cached generation (tests)."""
+    global _COORD, _GEN
+    _COORD = None
+    with _GEN_LOCK:
+        _GEN = None
+
+
+class ElasticCoordinator:
+    """Drives one rank's side of the elastic protocol.
+
+    Wire it around the training loop::
+
+        coord = ElasticCoordinator(manager, trainer, data_iter=it)
+        coord.announce()
+        while updates < total:
+            coord.precheck(updates)            # may resize-exit
+            with coord.guard(updates):         # collective failure -> resize
+                params, mom, aux, loss = trainer.step(params, mom, aux, b)
+            updates += 1
+            coord.note_step(updates, (params, mom, aux))
+
+    ``precheck`` handles graceful paths (preemption notices, peers
+    leaving, grow-back) BEFORE the step dispatches — state is still
+    valid, so a fresh checkpoint is taken.  ``guard`` handles the hard
+    path (a peer died inside the collective): it waits for the heartbeat
+    lane to name the dead rank, then resizes from the last periodic
+    checkpoint.  Either way the process exits with the RESIZE code and
+    the elastic launcher relaunches the gang at the agreed size.
+    """
+
+    def __init__(self, manager=None, trainer=None, data_iter=None, *,
+                 min_workers=None, generation=None, elastic_dir=None,
+                 ckpt_every=None, grow_after_steps=None, dead_sec=None,
+                 check_interval=None, consensus_timeout=None,
+                 round_sec=None, exit_code=None, lane=None, rank=None,
+                 world=None, capacity=None, on_exit=None, register=True):
+        from . import watchdog as _watchdog
+        self.manager = manager
+        self.trainer = trainer
+        self.data_iter = data_iter
+        self.lane = lane if lane is not None else _watchdog.lane()
+        self.gen = (_env_int("MXNET_TPU_ELASTIC_GEN", 0)
+                    if generation is None else int(generation))
+        self.elastic_dir = (
+            elastic_dir
+            or os.environ.get("MXNET_TPU_ELASTIC_DIR")
+            or (manager.directory if manager is not None else None)
+            or _watchdog.default_report_dir()
+            or os.getcwd())
+        self.min_workers = (_env_int("MXNET_TPU_ELASTIC_MIN_WORKERS", 1)
+                            if min_workers is None else int(min_workers))
+        self.dead_sec = (_env_float("MXNET_TPU_ELASTIC_DEAD_SEC", 10.0)
+                         if dead_sec is None else float(dead_sec))
+        self.check_interval = (
+            _env_float("MXNET_TPU_ELASTIC_CHECK_INTERVAL", 2.0)
+            if check_interval is None else float(check_interval))
+        self.ckpt_every = (_env_int("MXNET_TPU_ELASTIC_CKPT_EVERY", 25)
+                           if ckpt_every is None else int(ckpt_every))
+        self.grow_after_steps = (
+            _env_int("MXNET_TPU_ELASTIC_GROW_STEPS", 50)
+            if grow_after_steps is None else int(grow_after_steps))
+        self.consensus_timeout = (
+            _env_float("MXNET_TPU_ELASTIC_CONSENSUS_TIMEOUT", 60.0)
+            if consensus_timeout is None else float(consensus_timeout))
+        self.exit_code = (
+            _env_int("MXNET_TPU_ELASTIC_EXIT_CODE", DEFAULT_RESIZE_EXIT_CODE)
+            if exit_code is None else int(exit_code))
+        self._rank = rank
+        self._world = world
+        self._capacity_override = capacity
+        self.on_exit = on_exit     # tests: called with the exit code
+        self.round_sec = _env_float("MXNET_TPU_ELASTIC_ROUND_SEC", 3.0) \
+            if round_sec is None else float(round_sec)
+        self._state = None         # last-good (params, mom, aux)
+        self._step = 0
+        self._steps_at_size = 0
+        self._last_check = 0.0
+        self._pending_leave = None   # {"grace": s, "after": step}
+        self._grow_published = False
+        self._resign_lock = threading.Lock()
+        self._resigning = False
+        self._resigned = False     # terminal: a resize exit was driven
+        self._monitor = None
+        self._monitor_stop = threading.Event()
+        set_generation(self.gen)
+        if register:
+            global _COORD
+            _COORD = self
+
+    # -- identity ---------------------------------------------------------
+    def rank(self) -> int:
+        if self._rank is not None:
+            return self._rank
+        try:
+            import jax
+            return jax.process_index()
+        except Exception:
+            return 0
+
+    def world(self) -> int:
+        if self._world is not None:
+            return self._world
+        try:
+            import jax
+            return jax.process_count()
+        except Exception:
+            return 1
+
+    def is_saver(self) -> bool:
+        """The lowest rank of the current generation owns checkpoint +
+        manifest writes (every rank holds the full replicated state)."""
+        return self.rank() == 0
+
+    def _client(self):
+        return self.lane._client()
+
+    # -- the per-step hooks ----------------------------------------------
+    def announce(self):
+        """Publish the resize history (from the on-disk manifests) to the
+        KV so any rank's :func:`~mxnet_tpu.telemetry.fleet_view` can show
+        the resize events of THIS job, not just this incarnation."""
+        client = self._client()
+        if client is None or not self.is_saver():
+            return
+        events = [{"generation": m.get("generation"),
+                   "world_size": m.get("world_size"),
+                   "prev_world": m.get("prev_world"),
+                   "reason": m.get("reason"), "step": m.get("step"),
+                   "time": m.get("time")}
+                  for m in read_manifests(self.elastic_dir)]
+        try:
+            _kv_set(client, HISTORY_KEY, json.dumps(events))
+        except Exception:
+            logging.exception("elastic: history announce failed (continuing)")
+
+    def note_step(self, step: int, state=None, data_iter=None):
+        """Record one COMPLETED update: remember the state for
+        resize-time checkpointing and take the periodic snapshot."""
+        self._step = int(step)
+        self._steps_at_size += 1
+        if state is not None:
+            self._state = state
+        if data_iter is not None:
+            self.data_iter = data_iter
+        if (self.manager is not None and self.is_saver()
+                and self.ckpt_every > 0 and step % self.ckpt_every == 0):
+            self._save(step)
+
+    def precheck(self, step: int):
+        """Run the graceful-path checks before dispatching a step.  May
+        not return: any resize decision ends in ``exit(44)``.
+
+        Graceful transitions are TWO-PHASE to stay deterministic in a
+        sync gang: a notice/intent published before step ``U+1``
+        dispatches is guaranteed visible to every rank by the time step
+        ``U+1`` completes (the psum orders it), so everyone acts at
+        their ``precheck(U+1)`` — nobody strands a peer inside the next
+        collective."""
+        if self._resigned:
+            return      # terminal (reachable only with an on_exit hook)
+        # a pending graceful leave bypasses the throttle: the exit must
+        # happen at the agreed step
+        if self._pending_leave is not None \
+                and step >= self._pending_leave["after"]:
+            self._finish_leave(step)
+            if self._resigned or self.on_exit is not None:
+                return
+        now = time.monotonic()
+        if self.check_interval > 0 \
+                and now - self._last_check < self.check_interval:
+            return
+        self._last_check = now
+        from . import chaos
+        grace = chaos.maybe_preempt_notice(step)
+        if grace is not None and self._pending_leave is None:
+            self._announce_leave(grace, step)
+        leavers = [r for r in self.leaving_ranks(effective_step=step)
+                   if r != self.rank()]
+        if leavers:
+            self.resign("peer_preempt_notice", step=step)
+        dead = self.dead_ranks()
+        if dead:
+            self.resign("dead_peer", step=step)
+        if self._client() is not None and self._round_open():
+            # a peer opened a resize round (it may be seeing a failure we
+            # have not hit yet) — join it rather than racing into a
+            # collective the round is about to dissolve
+            self.resign("peer_resize", step=step, save_fresh=False)
+        self._maybe_grow(step)
+
+    @contextmanager
+    def guard(self, step: Optional[int] = None):
+        """Catch a collective blown up by a lost peer and turn it into a
+        coordinated resize.  The consensus round itself discriminates
+        peer loss from a program bug: if every rank of the current world
+        shows up in the round (nobody actually died), :meth:`resign`
+        aborts the resize and the original exception re-raises — a
+        genuine bug stays a bug on every rank."""
+        try:
+            yield
+        except BaseException as e:
+            if not self._looks_like_peer_loss(e):
+                raise
+            logging.error(
+                "elastic: step failed with %s in a %d-rank gang — opening "
+                "a resize round (a full-membership round aborts back to "
+                "the original error)", type(e).__name__, self.world())
+            self.resign("collective_error:%s" % type(e).__name__,
+                        step=step if step is not None else self._step,
+                        save_fresh=False)
+            raise       # false alarm (or an on_exit test hook): re-raise
+
+    # -- detection --------------------------------------------------------
+    def dead_ranks(self) -> List[int]:
+        """Ranks of the CURRENT generation whose last heartbeat is older
+        than ``dead_sec``.  A rank that never beat is not declared dead —
+        startup must not eat the gang."""
+        beats = self.lane.peers()
+        if not beats:
+            return []
+        now = time.time()
+        me = self.rank()
+        out = []
+        for r, b in beats.items():
+            if r == me or r >= self.world():
+                continue
+            if b.get("gen", 0) != self.gen:
+                continue       # a stale-generation ghost, not a death
+            if now - b["time"] > self.dead_sec:
+                out.append(r)
+        return sorted(out)
+
+    def leaving_ranks(self, effective_step=None) -> List[int]:
+        """Ranks with a published leaving notice.  With
+        ``effective_step``, only notices whose agreed hand-off step has
+        been reached count — the two-phase discipline (see precheck);
+        without it, any notice counts (the guard's evidence check)."""
+        client = self._client()
+        if client is None:
+            return []
+        try:
+            entries = client.key_value_dir_get(LEAVING_PREFIX + "/")
+        except Exception:
+            return []
+        out = []
+        for k, v in entries:
+            try:
+                r = int(str(k).rsplit("/", 1)[-1])
+            except ValueError:
+                continue
+            if effective_step is not None:
+                try:
+                    after = int(json.loads(str(v)).get("after_step", 0))
+                except (ValueError, TypeError):
+                    after = 0
+                if effective_step < after:
+                    continue
+            out.append(r)
+        return sorted(out)
+
+    def _looks_like_peer_loss(self, e) -> bool:
+        """A candidate for the dead-peer path: a runtime/OS error in a
+        multi-process run.  This rank's OWN death sentence (simulated
+        preemption) and training-dynamics faults (non-finite budget) are
+        never peer loss; the lane evidence check in :meth:`guard` does
+        the rest."""
+        if self.world() <= 1 or not isinstance(e, Exception):
+            return False
+        from .chaos import SimulatedPreemption
+        from .guards import NonFiniteError
+        if isinstance(e, (SimulatedPreemption, NonFiniteError)):
+            return False
+        return isinstance(e, (RuntimeError, OSError, SystemError, ValueError))
+
+    def _await_dead(self) -> List[int]:
+        """After a watchdog expiry, wait for the lane to say WHO died
+        (beats go stale within ``dead_sec``).  Keeps this rank's own
+        beat fresh while waiting so peers don't declare *us* dead."""
+        deadline = time.monotonic() + self.dead_sec * 2 + 1.0
+        while time.monotonic() < deadline:
+            self.lane.beat(self._step, force=True)
+            dead = self.dead_ranks()
+            if dead:
+                return dead
+            if self.leaving_ranks() or self._round_open():
+                return []
+            time.sleep(min(0.2, max(self.dead_sec / 10.0, 0.02)))
+        return []
+
+    def _round_open(self) -> bool:
+        """True when a resize round (fresh proposals) or a commit for the
+        NEXT generation exists on the KV — some peer has started leaving
+        this generation."""
+        client = self._client()
+        if client is None:
+            return False
+        if round_proposals(client, self.gen + 1):
+            return True
+        return read_commit(client, self.gen + 1) is not None
+
+    # -- the elastic monitor thread ----------------------------------------
+    def start_monitor(self, poll: float = 0.25):
+        """Watch the KV for an open resize round from a daemon thread.
+
+        This is what rescues the hardest failure shape: OUR step is
+        wedged inside a collective whose peer just died, no exception
+        will ever surface, and only other survivors know.  When their
+        round appears, this thread joins the consensus and drives the
+        exit — abandoning the wedged main thread, which is exactly the
+        point.  No-op when already running."""
+        if self._monitor is not None and self._monitor.is_alive():
+            return
+        self._monitor_stop.clear()
+
+        def loop():
+            while not self._monitor_stop.wait(poll):
+                try:
+                    if self._client() is None or not self._round_open():
+                        continue
+                    with self._resign_lock:
+                        busy = self._resigning
+                    if busy:
+                        continue
+                    logging.warning("elastic: monitor thread sees an open "
+                                    "resize round — joining")
+                    self.resign("peer_resize", save_fresh=False)
+                except Exception:
+                    logging.exception("elastic: monitor check failed")
+
+        self._monitor = threading.Thread(target=loop, name="mxt-elastic",
+                                         daemon=True)
+        self._monitor.start()
+
+    def stop_monitor(self):
+        self._monitor_stop.set()
+        t = self._monitor
+        if t is not None:
+            t.join(timeout=2.0)
+        self._monitor = None
+
+    # -- graceful leave / grow-back ---------------------------------------
+    def _announce_leave(self, grace: float, step: int):
+        """Phase 1 of a graceful leave: publish the notice with the
+        agreed hand-off step (``step+1``) and keep training — every rank
+        including this one acts at its ``precheck(step+1)``, after one
+        last synchronized update.  One step of a toy or a pod is far
+        inside any real grace window."""
+        after = int(step) + 1
+        logging.warning("elastic: rank %d preemption notice (%.1fs grace) "
+                        "at step %d — leaving after step %d",
+                        self.rank(), grace, step, after)
+        self._pending_leave = {"grace": float(grace), "after": after}
+        client = self._client()
+        if client is not None:
+            try:
+                _kv_set(client, "%s/%d" % (LEAVING_PREFIX, self.rank()),
+                        json.dumps({"grace_sec": float(grace),
+                                    "step": int(step), "after_step": after,
+                                    "time": time.time()}))
+            except Exception:
+                logging.exception("elastic: leaving notice failed")
+
+    def _finish_leave(self, step: int):
+        """Phase 2: checkpoint (saver) and exit cleanly with the resize
+        code — the survivors' consensus and manifest carry the new
+        membership; the launcher reaps this rank without drama."""
+        logging.warning("elastic: rank %d leaving cleanly at step %d",
+                        self.rank(), step)
+        if self.is_saver():
+            self._save(step)
+        from .. import telemetry
+        telemetry.count("elastic.graceful_leaves")
+        self._resigned = True
+        self._exit(self.exit_code)
+
+    def capacity(self) -> Optional[int]:
+        if self._capacity_override is not None:
+            return self._capacity_override
+        return read_capacity(self.elastic_dir)
+
+    def _maybe_grow(self, step: int):
+        """Two-phase grow-back.  Phase 1 (initiator = lowest rank):
+        after soaking ``grow_after_steps`` at the reduced size with the
+        capacity file offering more workers, publish a grow intent for
+        ``step+1`` and KEEP TRAINING.  Phase 2 (everyone, including the
+        initiator, at ``precheck(step+1)``): the intent predates step
+        ``step+1``'s collective, so every rank is guaranteed to see it —
+        all resign together into the bigger generation."""
+        client = self._client()
+        next_gen = self.gen + 1
+        # phase 2: act on a published intent once its step has passed
+        if client is not None:
+            try:
+                raw = client.key_value_dir_get(GROW_PREFIX + "/")
+            except Exception:
+                raw = []
+            for k, v in raw:
+                try:
+                    if int(str(k).rsplit("/", 1)[-1]) != next_gen:
+                        continue
+                    intent = json.loads(str(v))
+                    target = int(intent["world_size"])
+                    after = int(intent.get("after_step", 0))
+                except (ValueError, TypeError, KeyError):
+                    continue
+                if step >= after:
+                    self.resign("grow_back", target_world=target, step=step)
+        # phase 1: publish the intent (never resign here)
+        if not self.is_saver() or self._grow_published:
+            return
+        if self._steps_at_size < self.grow_after_steps:
+            return
+        cap = self.capacity()
+        if cap is None or cap <= self.world():
+            return
+        logging.warning("elastic: capacity %d > world %d after %d steps — "
+                        "growing back after step %d", cap, self.world(),
+                        self._steps_at_size, step + 1)
+        self._grow_published = True
+        if client is not None:
+            try:
+                _kv_set(client, "%s/%d" % (GROW_PREFIX, next_gen),
+                        json.dumps({"world_size": int(cap),
+                                    "step": int(step),
+                                    "after_step": int(step) + 1,
+                                    "time": time.time()}))
+            except Exception:
+                logging.exception("elastic: grow intent failed (continuing)")
+
+    # -- the resize itself -------------------------------------------------
+    def resign(self, reason: str, target_world: Optional[int] = None,
+               step: Optional[int] = None, save_fresh: bool = True) -> bool:
+        """Drive this rank through a coordinated resize: the join-based
+        consensus round (when membership is in question), ghost-key
+        eviction, checkpoint + manifest (saver only), then exit with the
+        resize code.
+
+        Returns ``False`` — WITHOUT exiting — when the round turns out
+        to be a false alarm (every rank of the current world showed up:
+        nothing died, nothing to resize); the caller goes back to
+        training or re-raises its error.  Otherwise only returns when an
+        ``on_exit`` test hook swallows the exit."""
+        with self._resign_lock:
+            if self._resigning or self._resigned:
+                return True     # another thread (or a test's swallowed
+            self._resigning = True      # exit) already drove this
+        try:
+            done = self._resign_locked(reason, target_world, step,
+                                       save_fresh)
+            if done:
+                self._resigned = True
+            return done
+        finally:
+            with self._resign_lock:
+                self._resigning = False
+
+    def _resign_locked(self, reason, target_world, step, save_fresh):
+        step = self._step if step is None else int(step)
+        client = self._client()
+        world = self.world()
+        if target_world is None:
+            if client is not None and world > 1:
+                members = propose_membership(
+                    client, self.rank(), self.gen + 1,
+                    timeout=self.consensus_timeout, round_min=self.round_sec,
+                    on_wait=lambda: self.lane.beat(step, force=True))
+            else:
+                members = [self.rank()]
+            target_world = len(members)
+            if target_world == world and client is not None:
+                logging.warning(
+                    "elastic: round for generation %d found the FULL "
+                    "%d-rank world alive (%s) — false alarm, no resize",
+                    self.gen + 1, world, reason)
+                return False
+        else:
+            members = list(range(world))
+        evicted = sorted(set(range(world)) - set(members))
+        if target_world < self.min_workers:
+            logging.error(
+                "elastic: %d survivors < min_workers %d (%s) — giving up "
+                "so the launcher's full checkpoint-restart path recovers",
+                target_world, self.min_workers, reason)
+            self._exit(1)
+            return True
+        if client is not None and evicted:
+            self._evict(client, evicted)
+        from .. import telemetry
+        telemetry.count("elastic.resizes", reason=reason.split(":")[0])
+        saver = members and self.rank() == min(members)
+        if saver:
+            if save_fresh and self.manager is not None:
+                self._save(step)
+            manifest = {"generation": self.gen + 1,
+                        "world_size": int(target_world),
+                        "prev_world": int(world),
+                        "members": list(members),
+                        "dead": evicted,
+                        "reason": reason,
+                        "step": int(step),
+                        "time": time.time()}
+            path = write_manifest(self.elastic_dir, manifest)
+            if client is not None:
+                try:
+                    _kv_set(client, "%s/%d" % (COMMIT_PREFIX, self.gen + 1),
+                            json.dumps(manifest))
+                except Exception:
+                    logging.exception("elastic: commit publish failed")
+            logging.warning("elastic: generation %d -> %d (world %d -> %d, "
+                            "%s) committed: %s", self.gen, self.gen + 1,
+                            world, target_world, reason, path)
+        else:
+            logging.warning("elastic: rank %d following generation %d -> %d "
+                            "(world %d -> %d, %s)", self.rank(), self.gen,
+                            self.gen + 1, world, target_world, reason)
+        self._exit(self.exit_code)
+        return True
+
+    def _evict(self, client, ranks: Sequence[int]):
+        """Delete evicted ranks' heartbeat-lane keys so they can't haunt
+        ``fleet_view``/``straggler_report`` as ghost rows (their rows are
+        ALSO generation-filtered — eviction is the belt, stamping the
+        suspenders)."""
+        from .watchdog import HeartbeatLane
+        for r in ranks:
+            for prefix in (HeartbeatLane.PREFIX, HeartbeatLane.MD_PREFIX,
+                           LEAVING_PREFIX):
+                try:
+                    client.key_value_delete("%s/%d" % (prefix, r))
+                except Exception:
+                    pass
+
+    # -- plumbing ----------------------------------------------------------
+    def _save(self, step: int):
+        """Fresh checkpoint of the last-good state; never raises — a
+        failed save (e.g. donated-away buffers after a mid-step fault)
+        falls back to the newest periodic snapshot already on disk."""
+        if self.manager is None or self.trainer is None \
+                or self._state is None:
+            return
+        from .checkpoint import save_trainer
+        try:
+            save_trainer(self.manager, self.trainer, *self._state,
+                         step=step, data_iter=self.data_iter,
+                         extra_meta={"generation": self.gen})
+        except Exception:
+            logging.exception(
+                "elastic: fresh snapshot at step %d failed — the newest "
+                "periodic checkpoint on disk will be used instead", step)
+
+    def _exit(self, code: int):
+        if self.on_exit is not None:
+            self.on_exit(code)
+            return
+        logging.warning("elastic: exiting with code %d for the launcher",
+                        code)
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(code)
+
+
+def watchdog_resize(tag: str, step=None) -> bool:
+    """Watchdog action ``resize`` hook: a deadline expired (a peer is
+    silently gone and the collective will never return).  If an elastic
+    coordinator is registered and there is evidence of membership change
+    (stale/leaving peers or an already-open round), drive a resize from
+    the watchdog's monitor thread — WITHOUT a fresh snapshot (the stuck
+    thread owns the device buffers) — and never return.  Returns False
+    when elastic can't help (no coordinator, no evidence, or the round
+    proved a false alarm), so the watchdog falls back to its abort
+    path."""
+    coord = _COORD
+    if coord is None:
+        return False
+    dead = coord._await_dead()
+    if not dead and not coord.leaving_ranks() and not coord._round_open():
+        return False
+    return coord.resign("watchdog:%s" % tag, step=step, save_fresh=False)

@@ -40,7 +40,12 @@ Env knobs (all read at first use; ``reset()`` re-reads — tests):
                                            (default: the step timeout)
 ``MXNET_TPU_WATCHDOG_ACTION``      ``abort`` (default): post-mortem then
                                    ``os._exit(MXNET_TPU_WATCHDOG_EXIT_CODE)``;
-                                   ``wait``: post-mortem, log, keep waiting
+                                   ``wait``: post-mortem, log, keep waiting;
+                                   ``resize``: post-mortem, then hand the
+                                   expiry to the elastic coordinator
+                                   (resilience/elastic.py) so survivors
+                                   re-form a smaller mesh — falls back to
+                                   ``abort`` without one
 ``MXNET_TPU_WATCHDOG_EXIT_CODE``   abort exit code (default 43)
 ``MXNET_TPU_WATCHDOG_DIR``         post-mortem directory (default: the
                                    newest CheckpointManager's directory,
@@ -129,6 +134,17 @@ class HeartbeatLane:
                 pass
             client.key_value_set(key, value)
 
+    @staticmethod
+    def _generation():
+        """Mesh generation stamped into beats/digests (elastic training:
+        rows from an evicted incarnation must be distinguishable from
+        live ones).  0 outside elastic runs."""
+        try:
+            from . import elastic
+            return elastic.generation()
+        except Exception:
+            return 0
+
     def beat(self, step: int, force: bool = False):
         """Publish this rank's progress.  Throttled (default 0.5 s) so a
         fast step loop does not hammer the coordinator; cheap no-op when
@@ -143,7 +159,7 @@ class HeartbeatLane:
             self._last_beat = now
         try:
             self._kv_set(client, "%s/%d" % (self.PREFIX, self._rank()),
-                         "%d:%.6f" % (int(step), now))
+                         "%d:%.6f:%d" % (int(step), now, self._generation()))
         except Exception:
             return False
         # piggyback the compact telemetry digest on the same lane (same
@@ -161,8 +177,10 @@ class HeartbeatLane:
         return True
 
     def peers(self) -> Dict[int, Dict[str, float]]:
-        """``{rank: {"step": int, "time": float}}`` for every rank that
-        has ever beaten.  Empty dict when the lane is inactive."""
+        """``{rank: {"step": int, "time": float, "gen": int}}`` for every
+        rank that has ever beaten (``gen`` is the mesh generation the
+        beat was written under — 0 for pre-elastic beats).  Empty dict
+        when the lane is inactive."""
         client = self._client()
         if client is None:
             return {}
@@ -174,9 +192,10 @@ class HeartbeatLane:
         for key, value in entries:
             try:
                 rank = int(str(key).rsplit("/", 1)[-1])
-                step_s, _, t_s = str(value).partition(":")
-                out[rank] = {"step": int(step_s), "time": float(t_s)}
-            except (ValueError, TypeError):
+                parts = str(value).split(":")
+                out[rank] = {"step": int(parts[0]), "time": float(parts[1]),
+                             "gen": int(parts[2]) if len(parts) > 2 else 0}
+            except (ValueError, TypeError, IndexError):
                 continue
         return out
 
@@ -203,7 +222,9 @@ class HeartbeatLane:
         """Ranks whose last heartbeat is older than ``timeout_sec`` (or
         that never beat while peers did) — the ps-lite
         ``GetNumDeadNode`` analog, computed from KV reads only."""
-        beats = self.peers()
+        gen = self._generation()
+        beats = {r: b for r, b in self.peers().items()
+                 if b.get("gen", 0) == gen}
         if not beats:
             return 0      # lane not in use: no evidence either way
         try:
@@ -224,8 +245,13 @@ class HeartbeatLane:
 
     def straggler_report(self, stale_sec: float = 60.0) -> Optional[dict]:
         """Slowest-rank lag report: per-rank step/age plus the lag (in
-        steps and seconds) of the slowest rank behind the fastest."""
-        beats = self.peers()
+        steps and seconds) of the slowest rank behind the fastest.
+        Beats and digests from an older mesh generation (ranks evicted
+        by an elastic resize) are dropped — a ghost row would otherwise
+        read as an ever-worsening straggler forever."""
+        gen = self._generation()
+        beats = {r: b for r, b in self.peers().items()
+                 if b.get("gen", 0) == gen}
         if not beats:
             return None
         now = time.time()
@@ -248,6 +274,8 @@ class HeartbeatLane:
         # merely slow, not yet stuck
         p50s = {}
         for rank, d in self.digests().items():
+            if (d or {}).get("gen", 0) != gen:
+                continue        # stale-generation ghost digest
             sm = (d or {}).get("step_ms") or {}
             if sm.get("p50"):
                 p50s[rank] = float(sm["p50"])
@@ -435,9 +463,9 @@ class Watchdog:
             if collective_timeout is None else float(collective_timeout))
         self.action = (action or
                        os.environ.get("MXNET_TPU_WATCHDOG_ACTION", "abort"))
-        if self.action not in ("abort", "wait"):
-            raise ValueError("MXNET_TPU_WATCHDOG_ACTION must be 'abort' or "
-                             "'wait', got %r" % self.action)
+        if self.action not in ("abort", "wait", "resize"):
+            raise ValueError("MXNET_TPU_WATCHDOG_ACTION must be 'abort', "
+                             "'wait' or 'resize', got %r" % self.action)
         self.report_dir = report_dir
         self.exit_code = int(exit_code if exit_code is not None else
                              os.environ.get("MXNET_TPU_WATCHDOG_EXIT_CODE",
@@ -533,7 +561,25 @@ class Watchdog:
             action=self.action)
         if self.on_expire is not None:
             self.on_expire(path)
-        if self.action == "abort":
+        action = self.action
+        if action == "resize":
+            # elastic training: a hung collective usually means a dead
+            # peer — hand the expiry to the ElasticCoordinator, which
+            # (given lane evidence) runs the membership consensus and
+            # exits with the RESIZE code so the launcher re-forms a
+            # smaller gang.  Without a coordinator or evidence, fall
+            # back to abort: fail-fast beats hanging forever.
+            try:
+                from . import elastic
+                if elastic.watchdog_resize(e.tag, step=e.step):
+                    return       # on_exit test hook swallowed the exit
+            except Exception:
+                logging.exception("watchdog: resize handoff failed — "
+                                  "falling back to abort")
+            logging.error("watchdog: action=resize had no elastic "
+                          "coordinator or no dead-peer evidence; aborting")
+            action = "abort"
+        if action == "abort":
             logging.error(
                 "watchdog: aborting (exit %d) so the launcher's "
                 "checkpoint-restart path can recover; post-mortem: %s",

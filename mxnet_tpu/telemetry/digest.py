@@ -29,12 +29,32 @@ _DIGEST_COUNTERS = (
 )
 
 
+def _generation_and_world():
+    """(mesh generation, world size) for digest stamping — elastic
+    training bumps the generation on every resize, and the fleet view
+    uses the stamp to drop ghost rows from evicted incarnations."""
+    gen = 0
+    try:
+        from ..resilience import elastic
+        gen = elastic.generation()
+    except Exception:
+        pass
+    world = 1
+    try:
+        import jax
+        world = jax.process_count()
+    except Exception:
+        pass
+    return gen, world
+
+
 def rank_digest(step: Optional[int] = None) -> dict:
     """This rank's compact metrics digest (see module docstring).
     Cheap: one histogram summary + a handful of counter sums."""
     hist = _registry.histogram("train.step_seconds")
     s = hist.summary()
-    d = {"t": time.time(), "step": step}
+    gen, world = _generation_and_world()
+    d = {"t": time.time(), "step": step, "gen": gen, "world": world}
     if s["count"]:
         d["step_ms"] = {
             "p50": round(1e3 * (s.get("p50") or 0.0), 3),
@@ -86,44 +106,110 @@ def _throughput() -> Optional[float]:
 
 def fleet_view() -> dict:
     """Merge every rank's heartbeat + digest into one table (read-only KV
-    scan; callable from any rank, rendered on rank 0)."""
+    scan; callable from any rank, rendered on rank 0).
+
+    Elastic-aware: rows stamped with an older mesh generation than the
+    current one are ranks EVICTED by a resize — they are dropped (listed
+    under ``ghosts`` for forensics, never mixed into the live table) —
+    and the view carries the current generation/world plus the job's
+    resize events (published by the elastic coordinator)."""
     from ..resilience import watchdog
     lane = watchdog.lane()
     beats = lane.peers()
     digests = lane.digests()
+    gen, world = _generation_and_world()
     now = time.time()
     ranks = {}
+    ghosts = []
     for rank in sorted(set(beats) | set(digests)):
-        row = {}
         b = beats.get(rank)
+        d = digests.get(rank)
+        row_gen = (b or {}).get("gen", (d or {}).get("gen", 0))
+        if row_gen != gen:
+            ghosts.append({"rank": rank, "gen": row_gen})
+            continue
+        row = {"gen": row_gen}
         if b:
             row["step"] = b["step"]
             row["age_sec"] = round(now - b["time"], 3)
-        d = digests.get(rank)
         if d:
             row["digest"] = d
         ranks[str(rank)] = row
-    return {"time": now, "ranks": ranks,
+    return {"time": now, "generation": gen, "world_size": world,
+            "ranks": ranks, "ghosts": ghosts,
+            "resize_events": _resize_events(lane),
             "straggler": lane.straggler_report()}
+
+
+def _resize_events(lane) -> list:
+    """The job's resize history, published to the KV by the elastic
+    coordinator at startup (from the on-disk manifests) and extended by
+    the commit records of the current incarnation."""
+    client = lane._client()
+    if client is None:
+        return []
+    events = []
+    try:
+        from ..resilience import elastic
+        import json as _json
+        try:
+            raw = client.key_value_dir_get(elastic.HISTORY_DIR)
+            if raw:
+                events = _json.loads(str(raw[0][1]))
+        except Exception:
+            events = []
+        try:
+            commits = client.key_value_dir_get(elastic.COMMIT_PREFIX + "/")
+        except Exception:
+            commits = []
+        known = {e.get("generation") for e in events}
+        for _, v in commits:
+            try:
+                m = _json.loads(str(v))
+            except (ValueError, TypeError):
+                continue
+            if m.get("generation") not in known:
+                events.append({k: m.get(k) for k in
+                               ("generation", "world_size", "prev_world",
+                                "reason", "step", "time")})
+        events.sort(key=lambda e: e.get("generation") or 0)
+    except Exception:
+        pass
+    return events
 
 
 def render_fleet(view: Optional[dict] = None) -> str:
     """Human-readable fleet table (stdlib-only; tools/metricsdump.py
     reuses the same layout)."""
     view = view or fleet_view()
-    lines = ["rank  step   age_s   p50_ms   p95_ms   tput/s  "
-             "live_mb  peak_mb  counters"]
+    lines = []
+    if "generation" in view:
+        lines.append("generation %s  world %s"
+                     % (view.get("generation"), view.get("world_size")))
+    lines.append("rank  gen  step   age_s   p50_ms   p95_ms   tput/s  "
+                 "live_mb  peak_mb  counters")
     for rank, row in sorted(view["ranks"].items(), key=lambda kv: int(kv[0])):
         d = row.get("digest") or {}
         sm = d.get("step_ms") or {}
         mm = d.get("mem_mb") or {}
         lines.append(
-            "%-5s %-6s %-7s %-8s %-8s %-7s %-8s %-8s %s"
-            % (rank, row.get("step", "-"), row.get("age_sec", "-"),
+            "%-5s %-4s %-6s %-7s %-8s %-8s %-7s %-8s %-8s %s"
+            % (rank, row.get("gen", d.get("gen", "-")),
+               row.get("step", "-"), row.get("age_sec", "-"),
                sm.get("p50", "-"), sm.get("p95", "-"),
                d.get("throughput_sps", "-"),
                mm.get("live", "-"), mm.get("peak", "-"),
                d.get("counters", "") or ""))
+    for e in view.get("resize_events") or []:
+        lines.append(
+            "resize: generation %s -> world %s (from %s, %s) at step %s"
+            % (e.get("generation"), e.get("world_size"),
+               e.get("prev_world"), e.get("reason"), e.get("step")))
+    ghosts = view.get("ghosts") or []
+    if ghosts:
+        lines.append("ghosts dropped (stale generation): %s"
+                     % ", ".join("r%s@g%s" % (g["rank"], g["gen"])
+                                 for g in ghosts))
     strag = (view.get("straggler") or {}).get("step_time")
     if strag:
         lines.append("step-time straggler: rank %s (p50 skew x%.2f)"

@@ -1,0 +1,260 @@
+"""Elastic-resize e2e drill (ROADMAP item 5 acceptance; the resize analog
+of dist_elastic_train.py's checkpoint-RESTART).
+
+Run via ``tools/launch.py -n 4 --elastic --min-workers 3``:
+
+  generation 0 (world 4): every rank trains a toy MLP with ShardedTrainer
+    over a dp=4 mesh — global batch 48 held as 4 ranks x micro 4 x
+    grad-accum 3 — with per-update checkpoints (rank 0) and the elastic
+    coordinator armed.  Rank 1 is HARD-preempted (chaos ``preempt``) at
+    its 8th update, mid-epoch.  The survivors' step blows up in the dead
+    collective (or the resize-action watchdog fires on a silent hang);
+    the heartbeat lane names rank 1 dead, the three survivors agree on
+    membership {0,2,3} over the KV, commit the generation-1 manifest and
+    exit 44.
+  generation 1 (world 3): the launcher relaunches 3 ranks.  They re-form
+    a dp=3 mesh, restore the newest checkpoint (resharding restore),
+    re-shard the SAME global iterator order (num_parts 4x12 -> 3x16) and
+    raise grad-accum to 4 — global batch still 48 — resuming within one
+    update of the kill.  After a soak the coordinator sees the launcher's
+    capacity file offering 4 workers again and grows back (manifest
+    generation 2, exit 44).
+  generation 2 (world 4): full size again; training completes.  Rank 0
+    re-runs the whole schedule uninterrupted on a single-device mesh and
+    checks the elastic run's final params/loss match within tolerance,
+    and that the fleet view shows the current generation/world plus both
+    resize events.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import parallel, telemetry  # noqa: E402
+from mxnet_tpu.io.io import NDArrayIter  # noqa: E402
+from mxnet_tpu.parallel.mesh import MeshSpec, data_parallel_mesh, \
+    make_mesh, set_current_mesh  # noqa: E402
+from mxnet_tpu.parallel.trainer import ShardedTrainer  # noqa: E402
+from mxnet_tpu.resilience import (CheckpointManager, chaos, elastic,  # noqa: E402
+                                  restore_trainer, watchdog)
+
+CKPT_DIR = os.environ["ELASTIC_CKPT_DIR"]
+# "kill": hard preemption (no goodbye) -> shrink -> grow back to full.
+# "notice": graceful preempt_notice -> checkpoint-then-leave -> finish at
+#           the reduced size (no grow) — zero lost updates.
+MODE = os.environ.get("ELASTIC_DRILL_MODE", "kill")
+N_SAMPLES = 240
+DIM = 16
+GLOBAL_BATCH = 48       # must divide at every world size: 4x12 / 3x16
+MICRO = 4               # per-rank rows per micro-step
+TOTAL_UPDATES = 30      # 6 epochs x 5 updates
+KILL_AT = 8             # rank 1 hard-preempted at its 8th update (gen 0)
+NOTICE_AT = 8           # rank 1 gets the graceful notice after update 8
+GROW_AFTER = 6          # updates at reduced size before growing back
+SEED = 11
+
+
+def make_data():
+    rs = np.random.RandomState(0)
+    X = rs.randn(N_SAMPLES, DIM).astype(np.float32)
+    w = rs.randn(DIM).astype(np.float32)
+    y = (X @ w > 0).astype(np.float32)
+    return X, y
+
+
+def make_symbol():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=2, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def make_iter(X, y, world, rank):
+    accum = elastic.grad_accum_for(GLOBAL_BATCH, MICRO, world)
+    return NDArrayIter(X, y, batch_size=MICRO * accum, shuffle=True,
+                       seed=5, num_parts=world, part_index=rank), accum
+
+
+def next_update_batch(it):
+    try:
+        b = it.next()
+    except StopIteration:
+        it.reset()
+        b = it.next()
+    return {"data": b.data[0].asnumpy(),
+            "softmax_label": b.label[0].asnumpy()}
+
+
+def eval_loss(param_arrays, names, X, y):
+    """Mean cross-entropy of the MLP on the full dataset, recomputed in
+    numpy from the raw parameter tensors — the trainer's in-graph "loss"
+    output is the SoftmaxOutput forward sum, not a metric."""
+    p = {n: np.asarray(a) for n, a in zip(names, param_arrays)}
+    h = np.maximum(X @ p["fc1_weight"].T + p["fc1_bias"], 0.0)
+    logits = h @ p["fc2_weight"].T + p["fc2_bias"]
+    logits -= logits.max(axis=1, keepdims=True)
+    logp = logits - np.log(np.exp(logits).sum(axis=1, keepdims=True))
+    return float(-logp[np.arange(len(y)), y.astype(int)].mean())
+
+
+def reference_run(X, y):
+    """The uninterrupted baseline: same init seed, same global order,
+    same global batch, single-device mesh, no accumulation."""
+    spec = MeshSpec(make_mesh((1,), ("dp",),
+                    devices=jax.local_devices()[:1]))
+    tr = ShardedTrainer(make_symbol(), spec, lr=0.01, momentum=0.9, wd=0.0)
+    params, mom, aux = tr.init_state(
+        {"data": (GLOBAL_BATCH, DIM), "softmax_label": (GLOBAL_BATCH,)},
+        seed=SEED)
+    it = NDArrayIter(X, y, batch_size=GLOBAL_BATCH, shuffle=True, seed=5)
+    for _ in range(TOTAL_UPDATES):
+        params, mom, aux, _ = tr.step(params, mom, aux,
+                                      next_update_batch(it))
+    return tr.param_names, params
+
+
+def main():
+    parallel.init_distributed()
+    telemetry.arm()
+    rank, world = jax.process_index(), jax.process_count()
+    gen = elastic.generation()
+    if rank == 0 and gen == 0:
+        os.makedirs(CKPT_DIR, exist_ok=True)
+    parallel.barrier("elastic_start")
+
+    spec = data_parallel_mesh()
+    assert spec.generation == gen, (spec.generation, gen)
+    set_current_mesh(spec)
+    trainer = ShardedTrainer(make_symbol(), spec, lr=0.01, momentum=0.9,
+                             wd=0.0)
+    X, y = make_data()
+    it, accum = make_iter(X, y, world, rank)
+    trainer.set_grad_accum(accum)
+    mgr = CheckpointManager(CKPT_DIR, keep=5)
+    # watchdog backstop with the RESIZE action: if the dead peer wedges
+    # the collective instead of erroring it, the deadline still turns
+    # the hang into a coordinated resize (post-mortem included)
+    watchdog.configure(step_timeout=45, action="resize",
+                       report_dir=CKPT_DIR, poll=0.2)
+    coord = elastic.ElasticCoordinator(
+        mgr, trainer, data_iter=it, min_workers=3, ckpt_every=1,
+        grow_after_steps=GROW_AFTER if MODE == "kill" else 10 ** 6,
+        dead_sec=2.0, check_interval=0.0,
+        consensus_timeout=60.0, round_sec=2.0)
+    coord.announce()
+    # the monitor thread joins a peer-initiated round even while this
+    # rank is wedged inside a dead collective (the hard-kill drill)
+    coord.start_monitor(poll=0.2)
+
+    params, mom, aux = trainer.init_state(
+        {"data": (world * MICRO, DIM), "softmax_label": (world * MICRO,)},
+        seed=SEED)
+    updates = 0
+    restored = restore_trainer(mgr, trainer, data_iter=it,
+                               old_state=(params, mom, aux))
+    if restored is not None:
+        params, mom, aux, updates, _meta = restored
+    if gen > 0:
+        assert restored is not None, \
+            "a resized generation must resume from a checkpoint"
+        # the acceptance bound: survivors resume within ONE update of
+        # the kill (per-update checkpoints; the in-flight one is lost)
+        if gen == 1:
+            if MODE == "kill":
+                # per-update checkpoints; only the in-flight one is lost
+                assert updates >= KILL_AT - 1, \
+                    "resumed at %d, expected >= %d" % (updates, KILL_AT - 1)
+            else:
+                # graceful leave checkpoints AFTER the hand-off update:
+                # zero updates lost
+                assert updates == NOTICE_AT + 1, \
+                    "graceful resize lost work: resumed at %d" % updates
+            assert world == 3, world
+        print("dist_elastic_resize rank %d RESUMED gen=%d world=%d "
+              "updates=%d accum=%d" % (rank, gen, world, updates, accum),
+              flush=True)
+
+    if gen == 0 and rank == 1:
+        if MODE == "kill":
+            chaos.inject("preempt", at_step=KILL_AT).__enter__()
+        else:
+            chaos.inject("preempt_notice", at_step=NOTICE_AT,
+                         grace=30.0).__enter__()
+
+    while updates < TOTAL_UPDATES:
+        coord.precheck(updates)
+        batch = next_update_batch(it)
+        with coord.guard(updates):
+            try:
+                params, mom, aux, _loss = trainer.step(
+                    params, mom, aux, batch, local_batch=True)
+            except chaos.SimulatedPreemption:
+                # the hard kill: no goodbye, no checkpoint, no KV note
+                print("dist_elastic_resize rank %d PREEMPTED at update %d"
+                      % (rank, updates + 1), flush=True)
+                os._exit(77)
+        updates += 1
+        coord.note_step(updates, (params, mom, aux))
+
+    # -- completion ---------------------------------------------------------
+    if MODE == "kill":
+        # kill -> shrink -> grow: only a full-size final generation passes
+        assert gen == 2, "expected kill->shrink->grow, got gen %d" % gen
+        assert world == 4, world
+    else:
+        # notice -> shrink, no capacity pressure to grow: finish at 3
+        assert gen == 1, "expected one graceful resize, got gen %d" % gen
+        assert world == 3, world
+    # training is done — de-arm the elastic machinery and relax the
+    # watchdog before the verification phase: rank 0's solo reference
+    # run keeps the others waiting in the final barrier far longer than
+    # any training-step deadline, and that silence must not read as a
+    # death
+    coord.stop_monitor()
+    watchdog.configure(step_timeout=600, action="abort",
+                       report_dir=CKPT_DIR, poll=0.2)
+    watchdog.heartbeat(updates, force=True)   # freshen digests for the view
+
+    if rank == 0:
+        view = telemetry.fleet_view()
+        assert view["generation"] == gen and view["world_size"] == world, \
+            (view["generation"], view["world_size"])
+        events = view["resize_events"]
+        worlds = [e["world_size"] for e in events]
+        if MODE == "kill":
+            assert worlds == [3, 4], events
+            assert any("grow" in (e.get("reason") or "")
+                       for e in events), events
+        else:
+            assert worlds == [3], events
+            assert any("preempt_notice" in (e.get("reason") or "")
+                       for e in events), events
+        print("FLEET VIEW (rank 0):\n%s" % telemetry.render_fleet(view),
+              flush=True)
+
+        ref_names, ref_params = reference_run(X, y)
+        for n, a, b in zip(trainer.param_names, params, ref_params):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=5e-3, atol=5e-4,
+                err_msg="param %s diverged from the uninterrupted run" % n)
+        ref_ce = eval_loss(ref_params, ref_names, X, y)
+        el_ce = eval_loss(params, trainer.param_names, X, y)
+        assert abs(ref_ce - el_ce) <= max(0.05 * abs(ref_ce), 0.02), \
+            (ref_ce, el_ce)
+        assert el_ce < 0.2, "elastic run failed to converge: CE=%.4f" % el_ce
+        print("dist_elastic_resize LOSS ref=%.4f elastic=%.4f"
+              % (ref_ce, el_ce), flush=True)
+
+    parallel.barrier("elastic_done")
+    print("dist_elastic_resize rank %d/%d OK gen=%d updates=%d"
+          % (rank, world, gen, updates), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -54,6 +54,81 @@ def test_dist_elastic_restart_4proc(tmp_path):
     assert "CRASHING" in out and "restart 1/1" in out
 
 
+def _run_elastic(mode, tmp_path, final_world, timeout=420):
+    """Run the elastic-resize drill through the ELASTIC launcher and
+    return its combined output (asserts rc 0 + one OK per final rank)."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # workers self-configure cpu+gloo
+    env.pop("XLA_FLAGS", None)      # ... with ONE local device per rank
+    env.update({"ELASTIC_CKPT_DIR": str(tmp_path),
+                "ELASTIC_DRILL_MODE": mode,
+                "MXNET_TPU_TELEMETRY": "1"})
+    r = subprocess.run(
+        [sys.executable, LAUNCH, "-n", "4", "--elastic", "--min-workers",
+         "3", "--elastic-dir", str(tmp_path), sys.executable,
+         os.path.join(REPO, "tests", "dist", "dist_elastic_resize.py")],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO)
+    out = r.stdout + r.stderr
+    assert r.returncode == 0, out[-3000:]
+    assert out.count(" OK") == final_world, out[-1500:]
+    return out
+
+
+def test_dist_elastic_resize_4proc(tmp_path):
+    """THE elastic acceptance drill (ROADMAP item 5): rank 1 is
+    hard-preempted mid-epoch; the 3 survivors agree on membership over
+    the heartbeat-lane KV, re-form a 3-rank mesh from the latest
+    checkpoint (resuming within one update, grad-accum 3->4 so the
+    global batch stays 48), then grow back to 4 ranks when the launcher
+    re-advertises capacity, and finish with params/loss matching the
+    uninterrupted baseline.  The fleet view carries the generation bump
+    + world-size column and both resize events."""
+    import json
+
+    out = _run_elastic("kill", tmp_path, final_world=4)
+    assert "PREEMPTED at update 8" in out
+    assert "[launch] elastic resize: generation 1, world 4 -> 3" in out
+    assert "[launch] elastic resize: generation 2, world 3 -> 4" in out
+    assert "RESUMED gen=1 world=3 updates=7 accum=4" in out
+    assert "RESUMED gen=2 world=4 updates=14 accum=3" in out
+    assert "generation 2  world 4" in out          # fleet view header
+    assert "resize: generation 1 -> world 3" in out
+    assert "resize: generation 2 -> world 4" in out
+
+    # the committed manifests ARE the resize record the tooling renders
+    with open(tmp_path / "elastic-manifest-g0001.json") as f:
+        m1 = json.load(f)
+    assert m1["world_size"] == 3 and m1["dead"] == [1]
+    with open(tmp_path / "elastic-manifest-g0002.json") as f:
+        m2 = json.load(f)
+    assert m2["world_size"] == 4 and m2["reason"] == "grow_back"
+
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "postmortem.py"),
+         "--elastic", str(tmp_path)],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0
+    assert "ELASTIC RESIZE TIMELINE" in r.stdout
+    assert "4 -> 3" in r.stdout and "3 -> 4" in r.stdout
+
+
+def test_dist_elastic_notice_4proc(tmp_path):
+    """The graceful path: rank 1 gets a preemption NOTICE (chaos
+    preempt_notice with a grace window), checkpoints-then-exits cleanly
+    at the agreed hand-off step, and the 3 survivors resize with ZERO
+    lost updates (no failed collective anywhere), finishing at the
+    reduced size with the same loss as the uninterrupted run."""
+    out = _run_elastic("notice", tmp_path, final_world=3)
+    assert "preemption notice (30.0s grace)" in out
+    assert "leaving cleanly" in out
+    assert "[launch] elastic resize: generation 1, world 4 -> 3" in out
+    # graceful = nothing lost: survivors resume exactly after the
+    # hand-off update
+    assert "RESUMED gen=1 world=3 updates=9 accum=4" in out
+    assert "resize: generation 1 -> world 3 (from 4, peer_preempt_notice)" \
+        in out
+
+
 def test_dist_async_train_4proc():
     """Module.fit with kvstore('dist_async') over 4 ranks stepping at
     different speeds: no deadlock, per-rank convergence, identical params

@@ -1,0 +1,577 @@
+"""Elastic training (mxnet_tpu/resilience/elastic.py): join-based
+membership consensus over the coordination KV, resize manifests, graceful
+preemption notices, grow-back, generation-stamped heartbeats/digests (no
+ghost rows), the watchdog `resize` action, gradient accumulation in
+ShardedTrainer, and the elastic launcher's verdict logic.
+
+The 4-proc end-to-end drills (hard kill -> shrink -> grow back; graceful
+notice -> shrink) live in tests/test_dist.py::test_dist_elastic_resize_*;
+these are the single-process seams.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu.resilience import chaos, elastic, watchdog
+from mxnet_tpu.resilience.watchdog import HeartbeatLane
+from tests.test_watchdog import FakeKVClient
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    chaos.reset()
+    watchdog.reset()
+    elastic.reset()
+    yield
+    chaos.reset()
+    watchdog.reset()
+    elastic.reset()
+
+
+def _coord(client, rank, world, tmp_path, exits, **kw):
+    lane = HeartbeatLane(client=client)
+    kw.setdefault("min_workers", 1)
+    kw.setdefault("dead_sec", 0.5)
+    kw.setdefault("check_interval", 0.0)
+    kw.setdefault("consensus_timeout", 8.0)
+    kw.setdefault("round_sec", 0.3)
+    return elastic.ElasticCoordinator(
+        lane=lane, rank=rank, world=world, generation=0,
+        elastic_dir=str(tmp_path), register=False,
+        on_exit=lambda code, r=rank: exits.__setitem__(r, code), **kw)
+
+
+def _beat_all(client, ranks, gen=0, step=5, stale=()):
+    now = time.time()
+    for r in ranks:
+        t = now - 100 if r in stale else now
+        client.kv["mxt_hb/%d" % r] = "%d:%f:%d" % (step, t, gen)
+
+
+# ---------------------------------------------------------------------------
+# consensus
+# ---------------------------------------------------------------------------
+
+def test_consensus_join_based_convergence():
+    """Every rank that shows up is a member; the dead rank (which never
+    proposes) is excluded without any vote about it."""
+    client = FakeKVClient()
+    results = {}
+
+    def run(r):
+        results[r] = elastic.propose_membership(client, r, 1, timeout=5,
+                                                round_min=0.3)
+
+    ts = [threading.Thread(target=run, args=(r,)) for r in (0, 2, 3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert results == {0: [0, 2, 3], 2: [0, 2, 3], 3: [0, 2, 3]}
+
+
+def test_consensus_late_joiner_inside_round_window():
+    """A rank wedged in the dying collective joins late (its monitor
+    thread saw the round) and must still be a member."""
+    client = FakeKVClient()
+    results = {}
+
+    def run(r, delay=0.0):
+        time.sleep(delay)
+        results[r] = elastic.propose_membership(client, r, 1, timeout=5,
+                                                round_min=0.6)
+
+    ts = [threading.Thread(target=run, args=(0,)),
+          threading.Thread(target=run, args=(2, 0.3))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert results == {0: [0, 2], 2: [0, 2]}
+
+
+def test_consensus_ignores_stale_proposals():
+    """Litter from an aborted round (or a dead rank's old proposal) must
+    not count as proof of life in a later round."""
+    client = FakeKVClient()
+    client.kv["mxt_el/prop/1/7"] = json.dumps(
+        {"members": [0, 7], "t": time.time() - 3600})
+    out = elastic.propose_membership(client, 0, 1, timeout=5, round_min=0.2)
+    assert out == [0]
+
+
+def test_consensus_commit_short_circuits():
+    client = FakeKVClient()
+    client.kv["mxt_el/commit/1"] = json.dumps({"members": [0, 2]})
+    out = elastic.propose_membership(client, 3, 1, timeout=5)
+    assert out == [0, 2]
+
+
+def test_consensus_timeout():
+    class NoKV(FakeKVClient):
+        def key_value_dir_get(self, prefix):
+            return []       # my own proposal never becomes visible
+
+    with pytest.raises(elastic.ConsensusTimeout):
+        elastic.propose_membership(NoKV(), 0, 1, timeout=0.5, round_min=0.1)
+
+
+# ---------------------------------------------------------------------------
+# resign: shrink, false alarm, ghost eviction
+# ---------------------------------------------------------------------------
+
+def test_resign_shrink_manifest_eviction_and_commit(tmp_path):
+    client = FakeKVClient()
+    _beat_all(client, range(4), stale=(1,))
+    client.kv["mxt_md/1"] = json.dumps({"gen": 0})
+    exits = {}
+
+    def resign(r):
+        coord = _coord(client, r, 4, tmp_path, exits, min_workers=3)
+        assert coord.dead_ranks() == [1]
+        coord.resign("dead_peer")
+
+    ts = [threading.Thread(target=resign, args=(r,)) for r in (0, 2, 3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert exits == {0: 44, 2: 44, 3: 44}
+    m = elastic.read_manifest(str(tmp_path))
+    assert m["generation"] == 1 and m["world_size"] == 3
+    assert m["members"] == [0, 2, 3] and m["dead"] == [1]
+    assert "mxt_hb/1" not in client.kv, "dead rank's heartbeat key evicted"
+    assert "mxt_md/1" not in client.kv, "dead rank's digest key evicted"
+    assert elastic.read_commit(client, 1)["world_size"] == 3
+
+
+def test_resign_full_membership_is_false_alarm(tmp_path):
+    """If every rank of the current world shows up in the round, nothing
+    died — resign returns False and nobody exits (the guard re-raises
+    the original program bug on every rank)."""
+    client = FakeKVClient()
+    exits = {}
+    results = {}
+
+    def resign(r):
+        coord = _coord(client, r, 3, tmp_path, exits)
+        results[r] = coord.resign("collective_error:Boom")
+
+    ts = [threading.Thread(target=resign, args=(r,)) for r in (0, 1, 2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert results == {0: False, 1: False, 2: False}
+    assert exits == {}
+    assert elastic.read_manifest(str(tmp_path)) is None
+
+
+def test_resign_below_min_workers_gives_up(tmp_path):
+    client = FakeKVClient()
+    exits = {}
+    coord = _coord(client, 0, 4, tmp_path, exits, min_workers=3,
+                   consensus_timeout=1.0, round_sec=0.2)
+    coord.resign("dead_peer")   # only this rank shows up -> world 1 < 3
+    assert exits == {0: 1}, "must exit with a PLAIN failure code so the " \
+        "launcher's full checkpoint-restart path recovers"
+
+
+# ---------------------------------------------------------------------------
+# graceful preemption notice (two-phase) + grow-back (two-phase)
+# ---------------------------------------------------------------------------
+
+def test_preempt_notice_two_phase_leave(tmp_path):
+    client = FakeKVClient()
+    exits = {}
+    coord = _coord(client, 1, 4, tmp_path, exits)
+    with chaos.inject("preempt_notice", at_step=8, grace=12.5):
+        coord.precheck(8)           # phase 1: announce, keep training
+        assert exits == {}
+        notice = json.loads(client.kv["mxt_el/leaving/1"])
+        assert notice["after_step"] == 9
+        assert notice["grace_sec"] == 12.5
+        coord.precheck(8)           # idempotent: still training
+        assert exits == {}
+        coord.precheck(9)           # phase 2: the agreed step -> exit
+    assert exits == {1: 44}
+
+
+def test_peers_resize_on_leaving_notice(tmp_path):
+    client = FakeKVClient()
+    client.kv["mxt_el/leaving/1"] = json.dumps(
+        {"grace_sec": 30, "step": 8, "after_step": 9})
+    exits = {}
+    phase = threading.Barrier(3)
+
+    def run(r):
+        coord = _coord(client, r, 4, tmp_path, exits)
+        coord.precheck(8)       # before the hand-off step: keep training
+        assert r not in exits
+        phase.wait()            # align phase 2 (a rank that reaches the
+        coord.precheck(9)       # hand-off first legitimately opens the
+        # round and the laggards would join it from precheck(8))
+
+    ts = [threading.Thread(target=run, args=(r,)) for r in (0, 2, 3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert exits == {0: 44, 2: 44, 3: 44}
+    m = elastic.read_manifest(str(tmp_path))
+    assert m["world_size"] == 3 and m["reason"] == "peer_preempt_notice"
+    assert "mxt_el/leaving/1" not in client.kv, "leaver's notice evicted"
+
+
+def test_grow_back_two_phase(tmp_path):
+    elastic.write_capacity(str(tmp_path), 4)
+    client = FakeKVClient()
+    exits = {}
+    coord = _coord(client, 0, 3, tmp_path, exits, grow_after_steps=2)
+    coord.note_step(1)
+    coord.precheck(1)
+    assert exits == {} and not client.key_value_dir_get("mxt_el/grow/"), \
+        "must soak grow_after_steps before growing"
+    coord.note_step(2)
+    coord.precheck(2)           # phase 1: intent published, keep training
+    assert exits == {}
+    intent = json.loads(client.kv["mxt_el/grow/1"])
+    assert intent["world_size"] == 4 and intent["after_step"] == 3
+    coord.note_step(3)
+    coord.precheck(3)           # phase 2: resign into the bigger world
+    assert exits == {0: 44}
+    m = elastic.read_manifest(str(tmp_path))
+    assert m["generation"] == 1 and m["world_size"] == 4
+    assert m["reason"] == "grow_back" and m["prev_world"] == 3
+
+    # a follower rank acts on the same intent at its own phase-2 check
+    exits2 = {}
+    follower = _coord(client, 1, 3, tmp_path, exits2, grow_after_steps=10)
+    follower.precheck(3)
+    assert exits2 == {1: 44}
+
+
+def test_grow_respects_capacity(tmp_path):
+    elastic.write_capacity(str(tmp_path), 3)   # no spare capacity
+    client = FakeKVClient()
+    exits = {}
+    coord = _coord(client, 0, 3, tmp_path, exits, grow_after_steps=1)
+    for s in (1, 2, 3):
+        coord.note_step(s)
+        coord.precheck(s)
+    assert exits == {} and not client.key_value_dir_get("mxt_el/grow/")
+
+
+# ---------------------------------------------------------------------------
+# monitor thread: a wedged rank joins a peer-initiated round
+# ---------------------------------------------------------------------------
+
+def test_monitor_thread_joins_open_round(tmp_path):
+    client = FakeKVClient()
+    exits = {}
+    wedged = _coord(client, 2, 3, tmp_path, exits, round_sec=0.3)
+    wedged.start_monitor(poll=0.05)
+    try:
+        results = {}
+
+        def run(r):
+            coord = _coord(client, r, 3, tmp_path, exits)
+            results[r] = coord.resign("dead_peer")
+
+        # ranks 0,1 open the round (they think 2 died); the monitor must
+        # bring 2 in -> FULL membership -> false alarm everywhere
+        ts = [threading.Thread(target=run, args=(r,)) for r in (0, 1)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=10)
+        assert results == {0: False, 1: False}
+        assert elastic.read_manifest(str(tmp_path)) is None
+    finally:
+        wedged.stop_monitor()
+
+
+# ---------------------------------------------------------------------------
+# watchdog action=resize
+# ---------------------------------------------------------------------------
+
+def test_watchdog_accepts_resize_action():
+    wd = watchdog.Watchdog(action="resize", step_timeout=100)
+    assert wd.action == "resize"
+    with pytest.raises(ValueError):
+        watchdog.Watchdog(action="nonsense")
+
+
+def test_watchdog_resize_without_coordinator_falls_back():
+    assert elastic.watchdog_resize("tag") is False
+
+
+def test_watchdog_resize_with_dead_peer(tmp_path, monkeypatch):
+    client = FakeKVClient()
+    _beat_all(client, range(3), stale=(1,))
+    exits = {}
+    coord = _coord(client, 0, 3, tmp_path, exits, dead_sec=0.2,
+                   round_sec=0.2, consensus_timeout=3.0)
+    monkeypatch.setattr(elastic, "_COORD", coord)
+
+    other_exits = {}
+    peer = _coord(client, 2, 3, tmp_path, other_exits, round_sec=0.2,
+                  consensus_timeout=3.0)
+    t = threading.Thread(target=lambda: peer.resign("dead_peer"))
+    t.start()
+    assert elastic.watchdog_resize("ShardedTrainer.step", step=7) is True
+    t.join(timeout=5)
+    assert exits == {0: 44} and other_exits == {2: 44}
+    m = elastic.read_manifest(str(tmp_path))
+    assert m["members"] == [0, 2] and m["reason"].startswith("watchdog:")
+
+
+# ---------------------------------------------------------------------------
+# generation-stamped heartbeats/digests: no ghost rows after a resize
+# ---------------------------------------------------------------------------
+
+def test_beats_carry_generation_and_parse():
+    client = FakeKVClient()
+    lane = HeartbeatLane(client=client)
+    elastic.set_generation(2)
+    assert lane.beat(7, force=True)
+    value = client.kv["mxt_hb/0"]
+    assert value.endswith(":2"), value
+    peers = lane.peers()
+    assert peers[0]["step"] == 7 and peers[0]["gen"] == 2
+    # legacy two-field beats parse as generation 0
+    client.kv["mxt_hb/9"] = "3:%f" % time.time()
+    assert lane.peers()[9]["gen"] == 0
+
+
+def test_fleet_view_drops_stale_generation_ghosts(monkeypatch):
+    from mxnet_tpu import telemetry
+    client = FakeKVClient()
+    monkeypatch.setattr(
+        "jax._src.distributed.global_state.client", client, raising=False)
+    elastic.set_generation(1)
+    now = time.time()
+    # live generation-1 rows for ranks 0..2, a ghost generation-0 row for
+    # the evicted rank 3 (its keys survived the resize)
+    for r in range(3):
+        client.kv["mxt_hb/%d" % r] = "12:%f:1" % now
+        client.kv["mxt_md/%d" % r] = json.dumps(
+            {"gen": 1, "world": 3, "step_ms": {"p50": 10.0 + r}})
+    client.kv["mxt_hb/3"] = "8:%f:0" % (now - 50)
+    client.kv["mxt_md/3"] = json.dumps(
+        {"gen": 0, "world": 4, "step_ms": {"p50": 500.0}})
+
+    view = telemetry.fleet_view()
+    assert view["generation"] == 1
+    assert sorted(view["ranks"]) == ["0", "1", "2"]
+    assert view["ghosts"] == [{"rank": 3, "gen": 0}]
+    # the ghost must not poison the straggler report either
+    strag = view["straggler"]
+    assert "3" not in strag["ranks"]
+    assert strag["step_time"]["slowest_rank"] != 3
+    rendered = telemetry.render_fleet(view)
+    assert "generation 1" in rendered
+    assert "ghosts dropped" in rendered
+    # ... and num_dead must not count evicted incarnations
+    lane = HeartbeatLane(client=client)
+    assert lane.num_dead(timeout_sec=30) == 0
+
+
+def test_fleet_view_shows_resize_events(monkeypatch):
+    from mxnet_tpu import telemetry
+    client = FakeKVClient()
+    monkeypatch.setattr(
+        "jax._src.distributed.global_state.client", client, raising=False)
+    client.kv[elastic.HISTORY_KEY] = json.dumps(
+        [{"generation": 1, "world_size": 3, "prev_world": 4,
+          "reason": "dead_peer", "step": 7, "time": 1.0}])
+    client.kv["mxt_el/commit/2"] = json.dumps(
+        {"generation": 2, "world_size": 4, "prev_world": 3,
+         "reason": "grow_back", "step": 14, "time": 2.0, "members": [0, 1, 2]})
+    view = telemetry.fleet_view()
+    worlds = [e["world_size"] for e in view["resize_events"]]
+    assert worlds == [3, 4]
+    rendered = telemetry.render_fleet(view)
+    assert "resize: generation 1 -> world 3" in rendered
+    assert "resize: generation 2 -> world 4" in rendered
+
+
+def test_digest_carries_generation_and_world():
+    from mxnet_tpu import telemetry
+    elastic.set_generation(3)
+    d = telemetry.rank_digest(step=4)
+    assert d["gen"] == 3 and d["world"] == 1
+
+
+# ---------------------------------------------------------------------------
+# chaos preempt_notice
+# ---------------------------------------------------------------------------
+
+def test_preempt_notice_fire_and_grace():
+    with chaos.inject("preempt_notice", at_step=3, grace=7.0):
+        assert chaos.maybe_preempt_notice(2) is None
+        assert chaos.maybe_preempt_notice(3) == 7.0
+        assert chaos.maybe_preempt_notice(3) is None, "one-shot"
+
+
+def test_preempt_notice_env_spec_and_default_grace(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_CHAOS", "preempt_notice@5")
+    monkeypatch.setenv("MXNET_TPU_CHAOS_PREEMPT_GRACE_SECONDS", "11")
+    chaos.reset()
+    assert chaos.maybe_preempt_notice(4) is None
+    assert chaos.maybe_preempt_notice(5) == 11.0
+
+
+# ---------------------------------------------------------------------------
+# manifests, capacity, launcher verdicts
+# ---------------------------------------------------------------------------
+
+def test_manifest_roundtrip_and_ordering(tmp_path):
+    for gen, world in ((2, 4), (1, 3)):
+        elastic.write_manifest(str(tmp_path), {
+            "generation": gen, "world_size": world, "prev_world": 4,
+            "members": list(range(world)), "dead": [], "reason": "x",
+            "step": gen * 7, "time": float(gen)})
+    ms = elastic.read_manifests(str(tmp_path))
+    assert [m["generation"] for m in ms] == [1, 2]
+    assert elastic.read_manifest(str(tmp_path))["generation"] == 2
+    assert elastic.read_manifest(str(tmp_path), 1)["world_size"] == 3
+    assert elastic.read_manifest(str(tmp_path), 9) is None
+
+
+def test_capacity_file_roundtrip(tmp_path):
+    assert elastic.read_capacity(str(tmp_path)) is None
+    elastic.write_capacity(str(tmp_path), 4)
+    assert elastic.read_capacity(str(tmp_path)) == 4
+
+
+def test_launcher_decide_next(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import launch
+    finally:
+        sys.path.pop(0)
+    d = str(tmp_path)
+    assert launch.decide_next([0, 0, 0, 0], d, 0, 4, 3) == ("done", None)
+    # resize exits without a manifest are a plain failure
+    assert launch.decide_next([77, 44, 44, 44], d, 0, 4, 3) == ("fail", None)
+    elastic.write_manifest(d, {"generation": 1, "world_size": 3,
+                               "prev_world": 4, "members": [0, 2, 3],
+                               "dead": [1], "reason": "dead_peer",
+                               "step": 7, "time": 1.0})
+    assert launch.decide_next([77, 44, 44, 44], d, 0, 4, 3) == ("resize", 3)
+    # clamped to launcher capacity
+    elastic.write_manifest(d, {"generation": 2, "world_size": 9,
+                               "prev_world": 3, "members": [0, 1, 2],
+                               "dead": [], "reason": "grow_back",
+                               "step": 14, "time": 2.0})
+    assert launch.decide_next([44, 44, 44], d, 1, 4, 3) == ("resize", 4)
+    # below min-workers is a plain failure
+    elastic.write_manifest(d, {"generation": 3, "world_size": 2,
+                               "prev_world": 4, "members": [0, 1],
+                               "dead": [2, 3], "reason": "dead_peer",
+                               "step": 20, "time": 3.0})
+    assert launch.decide_next([44, 44, 1, 1], d, 2, 4, 3) == ("fail", None)
+
+
+def test_postmortem_renders_elastic_timeline(tmp_path):
+    elastic.write_manifest(str(tmp_path), {
+        "generation": 1, "world_size": 3, "prev_world": 4,
+        "members": [0, 2, 3], "dead": [1], "reason": "dead_peer",
+        "step": 7, "time": time.time()})
+    elastic.write_manifest(str(tmp_path), {
+        "generation": 2, "world_size": 4, "prev_world": 3,
+        "members": [0, 1, 2], "dead": [], "reason": "grow_back",
+        "step": 14, "time": time.time()})
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "postmortem.py"),
+         "--elastic", str(tmp_path)],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert "ELASTIC RESIZE TIMELINE" in r.stdout
+    assert "dead_peer" in r.stdout and "grow_back" in r.stdout
+    assert "4 -> 3" in r.stdout and "3 -> 4" in r.stdout
+    assert "(lost: 1)" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# grad accumulation + mesh re-form
+# ---------------------------------------------------------------------------
+
+def test_grad_accum_for():
+    assert elastic.grad_accum_for(48, 4, 4) == 3
+    assert elastic.grad_accum_for(48, 4, 3) == 4
+    assert elastic.grad_accum_for(48, 48, 1) == 1
+    with pytest.raises(ValueError):
+        elastic.grad_accum_for(48, 5, 4)
+
+
+def test_grad_accum_matches_single_big_batch():
+    """accum=k over one (k*m)-row batch must produce the SAME update as
+    accum=1 over the same rows — the invariant the elastic resize leans
+    on to keep the global batch constant across world sizes."""
+    from mxnet_tpu.models.mlp import get_symbol
+    from mxnet_tpu.parallel.mesh import MeshSpec, make_mesh
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+    shapes = {"data": (16, 8), "softmax_label": (16,)}
+    rs = np.random.RandomState(0)
+    batch = {"data": rs.rand(16, 8).astype(np.float32),
+             "softmax_label": rs.randint(0, 4, 16).astype(np.float32)}
+
+    outs = {}
+    for accum in (1, 4):
+        spec = MeshSpec(make_mesh((4,), ("dp",)))
+        tr = ShardedTrainer(get_symbol(num_classes=4), spec, lr=0.1,
+                            momentum=0.9, wd=0.0, grad_accum=accum)
+        p, m, x = tr.init_state(shapes, seed=3)
+        for _ in range(3):
+            p, m, x, loss = tr.step(p, m, x, batch)
+        outs[accum] = (p, float(loss))
+    for a, b in zip(outs[1][0], outs[4][0]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+    assert outs[1][1] == pytest.approx(outs[4][1], rel=1e-5)
+
+
+def test_grad_accum_validation():
+    from mxnet_tpu.models.mlp import get_symbol
+    from mxnet_tpu.parallel.mesh import MeshSpec, make_mesh
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+    spec = MeshSpec(make_mesh((4,), ("dp",)))
+    with pytest.raises(ValueError):
+        ShardedTrainer(get_symbol(num_classes=4), spec, grad_accum=0)
+    tr = ShardedTrainer(get_symbol(num_classes=4), spec, grad_accum=3)
+    p, m, x = tr.init_state({"data": (16, 8), "softmax_label": (16,)},
+                            seed=3)
+    bad = {"data": np.zeros((16, 8), np.float32),
+           "softmax_label": np.zeros((16,), np.float32)}
+    with pytest.raises(ValueError, match="not divisible"):
+        tr.step(p, m, x, bad)       # 16 rows don't fold into 3 micros
+
+
+def test_reform_mesh_bumps_generation_and_keeps_axes():
+    import jax
+    from mxnet_tpu.parallel.mesh import MeshSpec, make_mesh, reform_mesh
+
+    n = len(jax.devices())
+    spec = MeshSpec(make_mesh((n,), ("dp",)), generation=4)
+    out = reform_mesh(spec)
+    assert out.generation == 5
+    assert out.mesh.shape["dp"] == n
+    assert out.dp_axis == spec.dp_axis
+
+
+def test_data_parallel_mesh_stamps_elastic_generation():
+    from mxnet_tpu.parallel.mesh import data_parallel_mesh
+    elastic.set_generation(6)
+    assert data_parallel_mesh().generation == 6

@@ -377,6 +377,117 @@ def test_ndarray_iter_state_rejects_mismatched_dataset():
         other.load_state_dict(state)
 
 
+# ---------------------------------------------------------------------------
+# iterator re-shard on elastic resize (ISSUE 8: after world N -> N-1, one
+# epoch still sees every sample exactly once — no replay, no drop)
+# ---------------------------------------------------------------------------
+
+def _sharded_iters(X, y, world, per_rank):
+    return [mx.io.NDArrayIter(X, y, batch_size=per_rank, shuffle=True,
+                              seed=7, num_parts=world, part_index=r)
+            for r in range(world)]
+
+
+def test_sharded_iter_covers_dataset_exactly_once():
+    """Baseline: 4 parts x 12 rows walk one shuffled epoch with no
+    overlap and full coverage."""
+    X = np.arange(96).reshape(48, 2).astype(np.float32)
+    y = np.arange(48).astype(np.float32)
+    seen = []
+    for it in _sharded_iters(X, y, world=4, per_rank=12):
+        seen += _drain_labels(it)
+    assert sorted(seen) == list(range(48))
+
+
+def test_sharded_iter_reshard_midepoch_exactly_once():
+    """The elastic data path: world 4 (bs 12) consumes part of an epoch,
+    a rank dies, the survivors restore the SAME global cursor/order at
+    world 3 (bs 16, global batch still 48) — the epoch completes with
+    every sample exactly once across both incarnations."""
+    X = np.arange(480).reshape(240, 2).astype(np.float32)
+    y = np.arange(240).astype(np.float32)
+    iters4 = _sharded_iters(X, y, world=4, per_rank=12)
+    seen = []
+    for _ in range(2):                    # 2 of 5 global batches, then die
+        for it in iters4:
+            seen += list(it.next().label[0].asnumpy())
+    state = iters4[0].state_dict()        # what rank 0 checkpointed
+
+    iters3 = _sharded_iters(X, y, world=3, per_rank=16)
+    for it in iters3:
+        it.load_state_dict(state)         # different split, same globals
+    rest = []
+    while True:
+        try:
+            batches = [it.next() for it in iters3]
+        except StopIteration:
+            break
+        for b in batches:
+            rest += list(b.label[0].asnumpy())
+    assert len(seen) == 96 and len(rest) == 144
+    assert sorted(seen + rest) == list(range(240)), \
+        "resize must replay nothing and drop nothing"
+
+
+def test_sharded_iter_inplace_reshard_and_next_epoch():
+    """reshard() re-splits the remaining epoch in place; the following
+    epoch is a clean full pass at the new world size."""
+    X = np.arange(96).reshape(48, 2).astype(np.float32)
+    y = np.arange(48).astype(np.float32)
+    its = _sharded_iters(X, y, world=4, per_rank=4)   # global batch 16
+    first = []
+    for it in its:
+        first += list(it.next().label[0].asnumpy())
+    for r, it in enumerate(its[:2]):
+        it.reshard(r, 2, batch_size=8)                # world 4 -> 2
+    rest = []
+    while True:
+        try:
+            batches = [it.next() for it in its[:2]]
+        except StopIteration:
+            break
+        for b in batches:
+            rest += list(b.label[0].asnumpy())
+    assert sorted(first + rest) == list(range(48))
+    for it in its[:2]:                                # next epoch at 2
+        it.reset()
+    again = []
+    for it in its[:2]:
+        again += _drain_labels(it)
+    assert sorted(again) == list(range(48))
+
+
+def test_sharded_iter_state_accepts_any_split_with_same_global_batch():
+    X = np.arange(96).reshape(48, 2).astype(np.float32)
+    y = np.arange(48).astype(np.float32)
+    it4 = mx.io.NDArrayIter(X, y, batch_size=12, shuffle=True, seed=7,
+                            num_parts=4, part_index=0)
+    it4.next()
+    state = it4.state_dict()
+    assert state["num_parts"] == 4 and state["batch_size"] == 12
+    # 3x16 == 4x12: accepted; 3x12 != 48: rejected
+    it3 = mx.io.NDArrayIter(X, y, batch_size=16, shuffle=True, seed=7,
+                            num_parts=3, part_index=1)
+    it3.load_state_dict(state)
+    assert it3._pos == it4._pos
+    bad = mx.io.NDArrayIter(X, y, batch_size=12, shuffle=True, seed=7,
+                            num_parts=3, part_index=1)
+    with pytest.raises(ValueError, match="global batch"):
+        bad.load_state_dict(state)
+
+
+def test_sharded_iter_guardrails():
+    X = np.arange(96).reshape(48, 2).astype(np.float32)
+    y = np.arange(48).astype(np.float32)
+    with pytest.raises(ValueError, match="seed"):
+        mx.io.NDArrayIter(X, y, batch_size=12, shuffle=True, num_parts=4)
+    with pytest.raises(ValueError, match="roll_over"):
+        mx.io.NDArrayIter(X, y, batch_size=12, num_parts=4,
+                          last_batch_handle="roll_over")
+    with pytest.raises(ValueError, match="part_index"):
+        mx.io.NDArrayIter(X, y, batch_size=12, num_parts=4, part_index=4)
+
+
 def test_record_iter_midepoch_resume_exactly_once(tmp_path):
     """ImageRecordIter: cursor + shuffled key order + shuffle-RNG state
     round-trip, so the resumed iterator finishes the epoch exactly and

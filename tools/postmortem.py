@@ -125,13 +125,61 @@ def print_report(path, frame_limit):
     print()
 
 
+def find_manifests(target):
+    """Elastic resize manifests next to the post-mortems (written by
+    mxnet_tpu.resilience.elastic on every coordinated resize)."""
+    if os.path.isfile(target):
+        target = os.path.dirname(os.path.abspath(target))
+    return sorted(glob.glob(os.path.join(target, "elastic-manifest-g*.json")))
+
+
+def print_elastic_timeline(target):
+    """Render the job's resize history: one line per generation bump —
+    who died/left, the world-size change, and the step the survivors
+    resumed from."""
+    paths = find_manifests(target)
+    if not paths:
+        print("no elastic resize manifests under %r" % target,
+              file=sys.stderr)
+        return 1
+    hrule("=")
+    print("ELASTIC RESIZE TIMELINE (%d event(s))" % len(paths))
+    hrule("=")
+    print("%-4s %-20s %-12s %-8s %-22s %s"
+          % ("gen", "time", "world", "step", "reason", "members"))
+    for path in paths:
+        try:
+            with open(path) as f:
+                m = json.load(f)
+        except (OSError, ValueError) as e:
+            print("unreadable manifest %s: %r" % (path, e), file=sys.stderr)
+            continue
+        world = "%s -> %s" % (m.get("prev_world", "?"),
+                              m.get("world_size", "?"))
+        members = ",".join(str(r) for r in m.get("members", []))
+        dead = m.get("dead") or []
+        if dead:
+            members += "  (lost: %s)" % ",".join(str(r) for r in dead)
+        print("%-4s %-20s %-12s %-8s %-22s %s"
+              % (m.get("generation", "?"), fmt_ts(m.get("time")), world,
+                 m.get("step", "?"), m.get("reason", "?"), members))
+    hrule()
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("target", help="a post-mortem .json or a directory "
                                    "holding watchdog-postmortem-*.json")
     ap.add_argument("--frames", type=int, default=8,
                     help="stuck frames to show per report (0 = all)")
+    ap.add_argument("--elastic", action="store_true",
+                    help="render the elastic resize timeline from the "
+                         "elastic-manifest-g*.json files instead of "
+                         "(before) the watchdog reports")
     args = ap.parse_args(argv)
+    if args.elastic:
+        return print_elastic_timeline(args.target)
     reports = find_reports(args.target)
     if not reports:
         print("no watchdog post-mortem reports under %r" % args.target,
