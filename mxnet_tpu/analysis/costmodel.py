@@ -451,29 +451,99 @@ def predicted_peak_bytes(state_bytes: float, batch_bytes: float = 0.0,
 # collective/compute overlap
 # ---------------------------------------------------------------------------
 
+# ops with enough arithmetic to hide a transfer behind (MXU-class work or
+# nested control flow that contains it).  Deliberately excludes fusions
+# and elementwise: a bookkeeping scatter next to a boundary ppermute must
+# not read as "the hop is hidden" (exactly the pre-fix GPipe schedule).
+_HEAVY_COMPUTE_OPS = frozenset({
+    "dot", "convolution", "custom-call", "while", "call", "conditional",
+    "cholesky", "triangular-solve",
+})
+
+_OPERAND_REF_RE = re.compile(r"%([\w.\-]+)")
+
+
+def _pipelined_sync_collectives(instrs: List[HloInstr]) -> Dict[str, bool]:
+    """For each SYNC collective in one computation: is there at least one
+    heavy compute instruction that is neither an ancestor nor a
+    descendant of it?  If so the transfer has real work to hide behind —
+    an async backend (TPU converts these to ``-start``/``-done`` pairs)
+    overlaps it; a schedule where every collective sits on the critical
+    path between its producers and consumers cannot be overlapped by ANY
+    scheduler.  Returns ``{instr_name: pipelined}``."""
+    by_name = {ins.name: i for i, ins in enumerate(instrs)}
+    deps: List[List[int]] = []
+    users: List[List[int]] = [[] for _ in instrs]
+    for i, ins in enumerate(instrs):
+        dd = []
+        for ref in _OPERAND_REF_RE.findall(ins.operands):
+            j = by_name.get(ref)
+            if j is not None and j != i:
+                dd.append(j)
+                users[j].append(i)
+        deps.append(dd)
+    heavy = [i for i, ins in enumerate(instrs)
+             if ins.opcode in _HEAVY_COMPUTE_OPS]
+    out = {}
+    for c, ins in enumerate(instrs):
+        if ins.opcode not in _COLLECTIVE_BASES:
+            continue
+        related = {c}
+        # reverse BFS over operands (ancestors) + forward over users
+        for seed, edges in ((c, deps), (c, users)):
+            todo = [seed]
+            seen = {seed}
+            while todo:
+                cur = todo.pop()
+                for nxt in edges[cur]:
+                    if nxt not in seen:
+                        seen.add(nxt)
+                        todo.append(nxt)
+            related |= seen
+        out[ins.name] = any(h not in related for h in heavy)
+    return out
+
+
 def collective_compute_overlap(hlo_text: str) -> Dict:
     """Static overlap instrument: of the module's collective payload
-    bytes, how much is issued as an async ``-start`` whose matching
-    ``-done`` has at least one real compute instruction scheduled in
-    between (i.e. XLA gave the transfer latency something to hide
-    behind)?  Synchronous collectives count as unoverlapped.
+    bytes, how much has real compute to hide behind?
+
+    Two classifications feed ``overlapped_bytes``:
+
+    * **async** — an explicit ``-start`` whose matching ``-done`` has at
+      least one compute instruction scheduled in between (XLA already
+      realized the overlap; TPU HLO).
+    * **pipelined** — a synchronous collective whose computation holds
+      at least one heavy compute op (dot/conv-class) that is neither its
+      ancestor nor its descendant: the schedule is double-buffered, so a
+      backend with async collectives hides the transfer behind that
+      compute.  This is how overlap is proven on backends (XLA:CPU — the
+      dryrun audit) that never emit ``-start``/``-done``: a collective
+      on the critical path between its producers and consumers (the
+      pre-fix GPipe boundary hop) cannot be overlapped by ANY scheduler
+      and counts 0.
 
     Returns ``{"collective_bytes", "overlapped_bytes", "overlap_pct",
-    "async_ops", "sync_ops", "by_kind"}``; ``overlap_pct`` is None when
-    the program has no collectives."""
+    "async_ops", "sync_ops", "pipelined_ops", "by_kind"}``;
+    ``overlap_pct`` is None when the program has no collectives."""
     total = 0
     overlapped = 0
     async_ops = 0
     sync_ops = 0
+    pipelined_ops = 0
     by_kind: Dict[str, Dict[str, int]] = {}
     # per-computation schedule walk
     open_starts: Dict[Tuple[str, str], dict] = {}
+    sync_payload: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    per_comp: Dict[str, List[HloInstr]] = {}
 
     def kind_slot(kind):
         return by_kind.setdefault(kind, {"bytes": 0, "overlapped": 0,
-                                         "async": 0, "sync": 0})
+                                         "async": 0, "sync": 0,
+                                         "pipelined": 0})
 
     for ins in iter_instructions(hlo_text):
+        per_comp.setdefault(ins.computation, []).append(ins)
         op = ins.opcode
         base = op
         is_start = op.endswith("-start")
@@ -506,10 +576,24 @@ def collective_compute_overlap(hlo_text: str) -> Dict:
             else:
                 sync_ops += 1
                 slot["sync"] += 1
+                sync_payload[(ins.computation, ins.name)] = (base, payload)
             continue
         if op in _COMPUTE_OPS:
             for rec in open_starts.values():
                 rec["compute_between"] = True
+    # second pass: schedulable overlap for the sync collectives
+    if sync_payload:
+        pipelined_by_comp = {
+            comp: _pipelined_sync_collectives(instrs)
+            for comp, instrs in per_comp.items()
+            if any(c == comp for c, _ in sync_payload)}
+        for (comp, name), (base, payload) in sync_payload.items():
+            if pipelined_by_comp.get(comp, {}).get(name):
+                pipelined_ops += 1
+                overlapped += payload
+                slot = kind_slot(base)
+                slot["overlapped"] += payload
+                slot["pipelined"] += 1
     return {
         "collective_bytes": total,
         "overlapped_bytes": overlapped,
@@ -517,6 +601,7 @@ def collective_compute_overlap(hlo_text: str) -> Dict:
         else None,
         "async_ops": async_ops,
         "sync_ops": sync_ops,
+        "pipelined_ops": pipelined_ops,
         "by_kind": by_kind,
     }
 

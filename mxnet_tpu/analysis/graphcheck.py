@@ -39,6 +39,16 @@ GC301     bf16-upcast-compute      warning   bf16 values upcast to f32 and fed
                                              FLOP cost on the MXU)
 GC302     weak-type-input          warning   weak-typed scalar inputs that
                                              fragment the jit cache
+GC304     collectives-serialized   warning   multi-device program moving real
+                                             collective payload with ZERO
+                                             compute/transfer overlap: no
+                                             async -start/-done pair hides
+                                             compute and every sync collective
+                                             sits on the critical path between
+                                             its producers and consumers (the
+                                             PR-6 overlap instrument,
+                                             costmodel.collective_compute_
+                                             overlap, is the oracle)
 GC401     static-float-attr        warning   per-step float attr (lr/wd/...)
                                              reaching an op as a STATIC jit
                                              key -> recompile every step
@@ -76,8 +86,9 @@ except ImportError:                     # older: the classic namespace
 
 __all__ = ["CollectiveEvent", "collect_collectives", "check_jaxpr",
            "check_fn", "check_symbol", "check_registry",
-           "check_replication", "check_capacity", "check_trainer",
-           "check_executor", "PER_STEP_ATTRS", "COLLECTIVE_PRIMS"]
+           "check_replication", "check_capacity", "check_overlap",
+           "check_trainer", "check_executor", "PER_STEP_ATTRS",
+           "COLLECTIVE_PRIMS"]
 
 # every collective primitive we track (axis_index is deliberately absent:
 # it reads the axis env but moves no data and cannot desync)
@@ -581,6 +592,56 @@ def check_capacity(predicted_bytes, capacity_bytes=None, target: str = "",
                  "(shard_optimizer_state=True) or params (__shard__/tp), "
                  "and check buffer donation (GC202)",
         extra=extra)
+    return rep
+
+
+def _overlap_threshold_bytes() -> int:
+    try:
+        mb = float(os.environ.get("MXNET_TPU_GC304_MIN_MB", "1"))
+    except ValueError:
+        mb = 1.0
+    return int(mb * (1 << 20))
+
+
+def check_overlap(hlo_text: str, target: str = "",
+                  min_bytes: Optional[int] = None) -> Report:
+    """GC304: a compiled multi-device program that moves real collective
+    payload with ZERO collective/compute overlap — nothing async with
+    compute between ``-start``/``-done``, and every synchronous
+    collective chained on the critical path between its producers and
+    consumers (so no scheduler on any backend could hide the transfer).
+    The oracle is the PR-6 static overlap instrument
+    (:func:`~mxnet_tpu.analysis.costmodel.collective_compute_overlap`).
+
+    Tiny programs (payload under ``MXNET_TPU_GC304_MIN_MB``, default
+    1 MB) are not flagged: hiding microsecond transfers buys nothing and
+    toy traces (tpulint's built-in entry points, the test fixtures)
+    would drown the signal."""
+    from . import costmodel
+    rep = Report("graphcheck", target)
+    ov = costmodel.collective_compute_overlap(hlo_text)
+    threshold = _overlap_threshold_bytes() if min_bytes is None \
+        else int(min_bytes)
+    total_ops = ov["async_ops"] + ov["sync_ops"]
+    if not total_ops or ov["collective_bytes"] < threshold:
+        return rep
+    if ov["overlapped_bytes"] > 0:
+        return rep
+    rep.add(
+        "GC304", "warning",
+        "all %d collectives (%.2f MB payload) run synchronously with "
+        "zero compute overlap: every transfer is dead time on the "
+        "critical path" % (total_ops, ov["collective_bytes"] / 1e6),
+        location=target,
+        fix_hint="double-buffer the schedule so each collective's "
+                 "operand comes from the previous iteration and its "
+                 "result is consumed in the next (parallel/ring.py and "
+                 "parallel/pipeline.py are the worked examples), or "
+                 "overlap per-tensor collectives with other tensors' "
+                 "compute",
+        extra={"collective_bytes": ov["collective_bytes"],
+               "async_ops": ov["async_ops"], "sync_ops": ov["sync_ops"],
+               "pipelined_ops": ov["pipelined_ops"]})
     return rep
 
 

@@ -134,6 +134,10 @@ def run_trainer_preflight(trainer, params, mom, aux, inputs):
                 rep.extend(graphcheck.check_capacity(
                     breakdown["peak_bytes"], target=rep.target,
                     detail={"basis": "memory_analysis", **breakdown}))
+            # GC304: with the optimized HLO in hand, prove the step's
+            # collectives have compute to hide behind
+            rep.extend(graphcheck.check_overlap(hlo_text,
+                                                target=rep.target))
         except Exception:
             logging.exception("pre-flight: HLO dump failed (continuing)")
     return _finish(rep, "trainer", jaxpr=closed, hlo_text=hlo_text)

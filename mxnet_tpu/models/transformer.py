@@ -10,7 +10,7 @@ LN -> vocab head -> per-token SoftmaxOutput against labels (N, T).
 from .. import symbol as sym
 
 
-def _block(x, hidden, heads, seq_len, idx):
+def _block(x, hidden, heads, seq_len, idx, flash_min_seq=0):
     p = "l%d_" % idx
     head_dim = hidden // heads
     # attention (pre-norm)
@@ -24,7 +24,8 @@ def _block(x, hidden, heads, seq_len, idx):
     shape4 = (-1, seq_len, heads, head_dim)
     att = sym.contrib.fused_attention(
         sym.Reshape(q, shape=shape4), sym.Reshape(k, shape=shape4),
-        sym.Reshape(v, shape=shape4), causal=True, name=p + "attn")
+        sym.Reshape(v, shape=shape4), causal=True,
+        flash_min_seq=flash_min_seq, name=p + "attn")
     att = sym.Reshape(att, shape=(-1, seq_len, hidden))
     att = sym.FullyConnected(att, num_hidden=hidden, flatten=False,
                              name=p + "proj")
@@ -40,12 +41,15 @@ def _block(x, hidden, heads, seq_len, idx):
 
 
 def get_symbol(vocab_size=1000, seq_len=32, num_layers=2, hidden=64,
-               heads=4, **kwargs):
+               heads=4, flash_min_seq=0, **kwargs):
     """Returns a SoftmaxOutput-headed LM symbol.
 
     data: (N, T) token ids; softmax_label: (N, T) next-token ids.  The
     head flattens to (N*T, vocab) so the standard per-row softmax head
-    and Perplexity metric apply unchanged."""
+    and Perplexity metric apply unchanged.  ``flash_min_seq`` rides
+    through to every attention op (0 = the MXNET_FLASH_MIN_SEQ env
+    default) — the flash-vs-einsum dispatch boundary is testable and
+    driver-controllable per model."""
     data = sym.Variable("data")
     label = sym.Variable("softmax_label")
     pos = sym.Variable("pos_embed", shape=(seq_len, hidden))
@@ -53,7 +57,8 @@ def get_symbol(vocab_size=1000, seq_len=32, num_layers=2, hidden=64,
                         name="tok_embed")
     x = sym.broadcast_add(tok, sym.expand_dims(pos, axis=0))
     for i in range(num_layers):
-        x = _block(x, hidden, heads, seq_len, i)
+        x = _block(x, hidden, heads, seq_len, i,
+                   flash_min_seq=flash_min_seq)
     x = sym.LayerNorm(x, name="ln_f")
     logits = sym.FullyConnected(x, num_hidden=vocab_size, flatten=False,
                                 name="head")

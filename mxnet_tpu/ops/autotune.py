@@ -1,0 +1,289 @@
+"""Measure-and-cache autotuner for Pallas kernel block sizes.
+
+TVM's measure-driven schedule search (PAPERS.md, arXiv:1802.04799)
+scaled down to the knobs that matter on this codebase: the flash
+attention forward/backward block sizes.  The right (block_q, block_k)
+depends on sequence length, head dim, dtype and chip generation in ways
+no static rule captures (the r4 table showed 1.0x-1.8x swings between
+shapes at FIXED blocks) — so the tuner *measures* candidates on the real
+device, remembers the winner in a persisted JSON cache keyed by
+``(op, shape-sig, dtype, device_kind)``, and every later run — any
+process, any day — gets the tuned blocks for free.
+
+Separation of concerns:
+
+* :func:`flash_blocks` — the READ side.  Called from the kernel wrappers
+  (``ops/pallas_kernels._pick_blocks``) at trace time: cache hit or
+  static default, never measures, never touches the device (safe under
+  jit tracing).
+* :func:`autotune` — the generic WRITE side: candidates + a measure
+  callable -> winner, cached.  Measurement only runs when
+  ``MXNET_TPU_AUTOTUNE=1`` (or ``force=True``); each trial is wrapped in
+  a ``autotune/trial`` telemetry span feeding the ``autotune.trial_
+  seconds`` histogram, so the search itself shows up on the PR-5
+  measurement plane and in the merged trace.
+* :func:`tune_flash` — the flash-specific search driver
+  (``tools/bench_pallas.py --autotune`` runs it on-chip and ships the
+  cache).
+
+Knobs (docs/observability.md):
+
+=====================================  ====================================
+``MXNET_TPU_AUTOTUNE``                 ``1`` enables measuring in
+                                       :func:`autotune`/:func:`tune_flash`
+                                       (default: cache/defaults only)
+``MXNET_TPU_AUTOTUNE_CACHE``           cache file (default
+                                       ``~/.cache/mxnet_tpu/autotune-
+                                       <device_kind>.json``)
+=====================================  ====================================
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, Iterable, Optional, Sequence, Tuple
+
+__all__ = ["flash_blocks", "autotune", "tune_flash", "lookup", "record",
+           "cache_path", "invalidate", "device_kind",
+           "DEFAULT_FLASH_BLOCKS"]
+
+# static fallbacks when the cache has no entry: the hand-picked r4
+# forward blocks, and symmetric 128s for the backward (two operand tiles
+# + two accumulators per cell leave less VMEM headroom than the forward)
+DEFAULT_FLASH_BLOCKS = {"fwd": (128, 512), "bwd": (128, 128)}
+
+_LOCK = threading.RLock()
+_CACHE: Optional[Dict[str, dict]] = None
+_CACHE_FROM: Optional[str] = None
+
+
+def device_kind() -> str:
+    """Sanitized accelerator kind for the cache key/filename — tuned
+    blocks must never leak across chip generations (or from the
+    interpret-mode CPU path onto a real TPU)."""
+    try:
+        import jax
+        kind = jax.devices()[0].device_kind
+    except Exception:
+        kind = "unknown"
+    return "".join(c if c.isalnum() or c in "-_." else "-"
+                   for c in str(kind).lower()) or "unknown"
+
+
+def cache_path() -> str:
+    env = os.environ.get("MXNET_TPU_AUTOTUNE_CACHE")
+    if env:
+        return env
+    return os.path.join(os.path.expanduser("~"), ".cache", "mxnet_tpu",
+                        "autotune-%s.json" % device_kind())
+
+
+def _load() -> Dict[str, dict]:
+    global _CACHE, _CACHE_FROM
+    path = cache_path()
+    with _LOCK:
+        if _CACHE is not None and _CACHE_FROM == path:
+            return _CACHE
+        data: Dict[str, dict] = {}
+        try:
+            with open(path) as f:
+                raw = json.load(f)
+            if isinstance(raw, dict):
+                data = {k: v for k, v in raw.items()
+                        if isinstance(v, dict) and "config" in v}
+        except (OSError, ValueError):
+            pass
+        _CACHE = data
+        _CACHE_FROM = path
+        return data
+
+
+def _save() -> None:
+    path = cache_path()
+    with _LOCK:
+        data = dict(_CACHE or {})
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = "%s.tmp.%d" % (path, os.getpid())
+        with open(tmp, "w") as f:
+            json.dump(data, f, indent=1, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except OSError:
+        pass                       # a read-only home must not break runs
+
+
+def invalidate() -> None:
+    """Drop the in-process cache (tests; after an external cache write)."""
+    global _CACHE, _CACHE_FROM
+    with _LOCK:
+        _CACHE = None
+        _CACHE_FROM = None
+
+
+def _key(op: str, sig: Sequence) -> str:
+    return "%s:%s" % (op, ",".join(str(s) for s in sig))
+
+
+def lookup(op: str, sig: Sequence) -> Optional[dict]:
+    """Cached entry ``{"config", "score_ms", ...}`` or None.  Pure cache
+    read — safe at trace time."""
+    return _load().get(_key(op, sig))
+
+
+def record(op: str, sig: Sequence, config, score_ms: float,
+           trials: int = 0) -> dict:
+    """Persist a winner (atomic rewrite of the whole cache file)."""
+    entry = {"config": list(config), "score_ms": round(float(score_ms), 4),
+             "trials": int(trials), "device_kind": device_kind(),
+             "t": time.time()}
+    with _LOCK:
+        _load()[_key(op, sig)] = entry
+    _save()
+    return entry
+
+
+def measuring_enabled() -> bool:
+    return os.environ.get("MXNET_TPU_AUTOTUNE", "0") == "1"
+
+
+def autotune(op: str, sig: Sequence, candidates: Iterable,
+             measure: Callable[[object], float], default=None,
+             force: bool = False):
+    """Generic search: return the cached winner for ``(op, sig)`` or —
+    when measuring is enabled — time every candidate with ``measure``
+    (seconds per call; smaller is better), cache the winner, and return
+    it.  With measuring disabled and no cache entry, returns
+    ``default`` (or the first candidate).
+
+    A candidate whose measurement RAISES is skipped (an over-budget
+    block config that fails to compile is data, not an error)."""
+    hit = lookup(op, sig)
+    if hit is not None:
+        return tuple(hit["config"]) if isinstance(hit["config"], list) \
+            else hit["config"]
+    cands = list(candidates)
+    fallback = default if default is not None else (
+        cands[0] if cands else None)
+    if not (measuring_enabled() or force) or not cands:
+        return fallback
+    from .. import telemetry as _tel
+    best, best_s = None, None
+    trials = 0
+    for cand in cands:
+        with _tel.span("autotune/trial", cat="autotune",
+                       metric="autotune.trial_seconds", op=op,
+                       config=str(cand)):
+            try:
+                dt = float(measure(cand))
+            except Exception:
+                _tel.count("autotune.failed_trials", op=op)
+                continue
+        trials += 1
+        _tel.count("autotune.trials", op=op)
+        if best_s is None or dt < best_s:
+            best, best_s = cand, dt
+    if best is None:
+        return fallback
+    record(op, sig, best, best_s * 1e3, trials=trials)
+    return best
+
+
+# ---------------------------------------------------------------------------
+# flash attention block sizes
+# ---------------------------------------------------------------------------
+
+def _flash_sig(kind: str, Tq: int, Tk: int, D: int, dtype) -> Tuple:
+    return (kind, int(Tq), int(Tk), int(D), str(dtype))
+
+
+def flash_blocks(kind: str, Tq: int, Tk: int, D: int = 0,
+                 dtype: str = "") -> Tuple[int, int]:
+    """(block_q, block_k) for the flash ``kind`` in {"fwd", "bwd"}:
+    cache hit, else the static default.  Read-only — called from kernel
+    wrappers at trace time."""
+    hit = lookup("flash_%s" % kind, _flash_sig(kind, Tq, Tk, D, dtype))
+    if hit is not None:
+        bq, bk = hit["config"]
+        return int(bq), int(bk)
+    return DEFAULT_FLASH_BLOCKS[kind]
+
+
+def _flash_candidates(kind: str, Tq: int, Tk: int, D: int,
+                      itemsize: int = 2):
+    """Block-size grid, pre-filtered by a VMEM budget: per cell the live
+    set is the q/k/v(/do) tiles + the (bq, bk) score tile + f32
+    accumulators; candidates past ~12 MB can only fail to compile."""
+    budget = 12 * (1 << 20)
+    nacc = 1 if kind == "fwd" else 2
+    ntile = 3 if kind == "fwd" else 4
+    out = []
+    for bq in (128, 256, 512):
+        for bk in (128, 256, 512, 1024):
+            if bq > Tq or bk > Tk:
+                continue
+            # operand tiles ×2: the pallas grid pipeline double-buffers
+            # input blocks (fetch i+1 while computing i)
+            vmem = (2 * ntile * (bq + bk) * D * 4  # operand tiles (f32 up)
+                    + bq * bk * 4                  # score tile
+                    + nacc * max(bq, bk) * D * 4   # accumulators
+                    + 2 * bq * 128 * 4)            # m/l or lse/delta lanes
+            if vmem <= budget:
+                out.append((bq, bk))
+    return out or [DEFAULT_FLASH_BLOCKS[kind]]
+
+
+def tune_flash(q, k, v, causal: bool = True, kinds=("fwd", "bwd"),
+               iters: int = 10, force: bool = False) -> Dict[str, tuple]:
+    """Search flash block sizes for these exact operand shapes on the
+    current device and persist the winners.  Timing uses the bench.py
+    methodology (timed call chain, ONE value fetch — block_until_ready
+    does not drain the dev tunnel).  Returns ``{kind: (bq, bk)}``."""
+    import jax
+    import jax.numpy as jnp
+    from . import pallas_kernels as pk
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    results = {}
+
+    def timed(fn):
+        def run(cand):
+            bq, bk = cand
+            out = None
+            for _ in range(3):
+                out = fn(bq, bk)
+            jax.block_until_ready(out)
+            sync = out[0] if isinstance(out, tuple) else out
+            float(jnp.sum(sync.astype(jnp.float32)))
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = fn(bq, bk)
+            sync = out[0] if isinstance(out, tuple) else out
+            float(jnp.sum(sync.astype(jnp.float32)))
+            return (time.perf_counter() - t0) / iters
+        return run
+
+    if "fwd" in kinds:
+        def fwd(bq, bk):
+            return pk.fused_attention_fwd(q, k, v, causal=causal,
+                                          block_q=bq, block_k=bk)
+        results["fwd"] = autotune(
+            "flash_fwd", _flash_sig("fwd", Tq, Tk, D, q.dtype),
+            _flash_candidates("fwd", Tq, Tk, D),
+            timed(fwd), default=DEFAULT_FLASH_BLOCKS["fwd"], force=force)
+    if "bwd" in kinds:
+        out, lse = pk.fused_attention_fwd(q, k, v, causal=causal)
+        do = jnp.ones_like(out)
+
+        def bwd(bq, bk):
+            return pk.fused_attention_bwd(q, k, v, out, lse, do,
+                                          causal=causal, block_q=bq,
+                                          block_k=bk)
+        results["bwd"] = autotune(
+            "flash_bwd", _flash_sig("bwd", Tq, Tk, D, q.dtype),
+            _flash_candidates("bwd", Tq, Tk, D),
+            timed(bwd), default=DEFAULT_FLASH_BLOCKS["bwd"], force=force)
+    return results

@@ -690,28 +690,47 @@ def _identity_attach_kl_sparse_reg(attrs, x):
 # MXNET_FLASH_MIN_SEQ would be silently ignored — freezing it here makes
 # that explicit.  Per-call control stays available via the op's
 # flash_min_seq attr (which IS part of the jit cache key).
-_FLASH_MIN_SEQ = int(os.environ.get("MXNET_FLASH_MIN_SEQ", "8192"))
+#
+# Default moved 8192 -> 1024 in round 6: the old crossover was measured
+# against the REMATERIALIZING backward (the vjp re-ran the whole einsum
+# forward); with the fused Pallas backward (pallas_kernels.
+# fused_attention_bwd, recompute-free from the saved logsumexp) the
+# flash path stops paying the O(T²) probability/score HBM traffic in
+# BOTH directions, which is exactly the transformer bench's missing MFU
+# (PERF.md r6).  MXNET_FLASH_MIN_SEQ=8192 restores the old dispatch.
+_FLASH_MIN_SEQ = int(os.environ.get("MXNET_FLASH_MIN_SEQ", "1024"))
+
+# Backward implementation above the threshold: the fused Pallas kernels
+# (default), or the pre-r6 rematerializing einsum vjp (fallback knob,
+# e.g. to A/B the kernels on new hardware).  Frozen at import for the
+# same jit-cache reason as the threshold.
+_FLASH_BWD = os.environ.get("MXNET_TPU_FLASH_BWD", "pallas")
 
 @register("_contrib_fused_attention", inputs=("query", "key", "value"),
           params=dict(causal=attr_bool(False), scale=attr_float(0.0),
-                      block_q=attr_int(128), flash_min_seq=attr_int(0)),
+                      block_q=attr_int(0), flash_min_seq=attr_int(0)),
           aliases=("fused_attention",))
 def _contrib_fused_attention(attrs, q, k, v):
     """Attention over (B, T, H, D); dispatches by sequence length.
 
-    Short sequences (T < flash_min_seq, default 8192, env
+    Short sequences (T < flash_min_seq, default 1024, env
     MXNET_FLASH_MIN_SEQ) run the plain einsum formulation end-to-end:
-    XLA fuses it well, residuals fit in HBM, and fwd+bwd share work —
-    measured faster than the Pallas path below ~8k (PERF.md).  Long
-    sequences run the VMEM-resident-score Pallas flash kernel forward
-    (never materializes (T, T) in HBM, extending reach to T=32k+) with
-    a rematerializing einsum backward."""
+    XLA fuses it well and residuals fit in HBM at tiny T.  At and above
+    the threshold both directions run the Pallas flash kernels —
+    K/V-blocked online-softmax forward saving the row logsumexp, and a
+    recompute-free dQ/dK/dV backward from that residual — so HBM never
+    holds a (T, T) tensor in either direction (reach T=32k+ single
+    chip; tools/bench_pallas.py --mode=fwdbwd for the table).
+    ``block_q``: 0 = autotuned (ops/autotune.py cache, then 128);
+    explicit values win.  MXNET_TPU_FLASH_BWD=remat restores the pre-r6
+    rematerializing einsum backward."""
     scale = attrs.scale if attrs.scale > 0 else 1.0 / float(q.shape[-1]) ** 0.5
     causal = attrs.causal
     block_q = attrs.block_q
-    if block_q < 1:
-        raise MXNetError("fused_attention: block_q must be >= 1, got %d"
-                         % block_q)
+    if block_q < 0:
+        raise MXNetError("fused_attention: block_q must be >= 0 "
+                         "(0 = autotuned), got %d" % block_q)
+    block_q = block_q or None          # 0 -> consult the autotune cache
 
     def naive(q, k, v):
         s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
@@ -734,12 +753,20 @@ def _contrib_fused_attention(attrs, q, k, v):
                                block_q=block_q)
 
     def fwd(q, k, v):
-        return attn(q, k, v), (q, k, v)
+        from .pallas_kernels import fused_attention_fwd
+        out, lse = fused_attention_fwd(q, k, v, causal=causal,
+                                       scale=scale, block_q=block_q)
+        return out, (q, k, v, out, lse)
 
     def bwd(res, g):
-        # rematerialize through the einsum formulation; at flash scales
-        # the (T, T) residuals could not have been stored anyway
-        _, vjp = jax.vjp(naive, *res)
+        q, k, v, out, lse = res
+        if _FLASH_BWD == "pallas":
+            from .pallas_kernels import fused_attention_bwd
+            return fused_attention_bwd(q, k, v, out, lse, g,
+                                       causal=causal, scale=scale,
+                                       block_q=block_q)
+        # fallback: rematerialize through the einsum formulation
+        _, vjp = jax.vjp(naive, q, k, v)
         return vjp(g)
 
     attn.defvjp(fwd, bwd)

@@ -33,7 +33,8 @@ _enable_x64 = getattr(jax, "enable_x64", None)
 if _enable_x64 is None:   # pragma: no cover - version-dependent
     from jax.experimental import enable_x64 as _enable_x64
 
-__all__ = ["two_bit_compress", "fused_attention", "pallas_available"]
+__all__ = ["two_bit_compress", "fused_attention", "fused_attention_fwd",
+           "fused_attention_bwd", "pallas_available"]
 
 
 def _interpret(*arrays) -> bool:
@@ -142,21 +143,51 @@ def _two_bit_jit(grad, residual, threshold, interpret):
 
 _NEG_BIG = -1e30      # -inf would make exp(m_prev - m_new) NaN on init
 
+# lse/delta residuals carry a broadcast 128-lane trailing dim — the same
+# layout jax's own TPU flash kernel uses (MIN_BLOCK_SIZE lanes): Mosaic
+# wants the last dim on the 128-lane register file, and the ×128 HBM
+# cost is O(T·128) — noise next to the O(T²) scores the kernel exists to
+# avoid materializing.
+_LSE_LANES = 128
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                  scale, causal, block_q, block_k, nk):
+
+def _pick_blocks(block_q, block_k, Tq, Tk, D, dtype, kind):
+    """Resolve (block_q, block_k): explicit argument wins, then the
+    autotune cache (ops/autotune.py), then the static default — and
+    either way clamp to divisors of the sequence lengths."""
+    if block_q is None or block_k is None:
+        from . import autotune as _autotune
+        tq, tk = _autotune.flash_blocks(kind, Tq, Tk, D, dtype)
+        block_q = block_q or tq
+        block_k = block_k or tk
+    bq = min(block_q, Tq)
+    while Tq % bq:
+        bq //= 2
+    bk = min(block_k, Tk)
+    while Tk % bk:
+        bk //= 2
+    return bq, bk
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale, causal,
+                  block_q, block_k, nk, with_lse):
     """Flash attention cell: one (block_q, D) query block against one
     (block_k, D) K/V block, with the running (max, sum, acc) online-
     softmax state in VMEM scratch.  The k-axis is the innermost grid
     dimension, which TPU executes sequentially — the scratch carries
     across k steps and the output is finalized on the last one."""
+    if with_lse:
+        lse_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        lse_ref = None
+        acc_ref, m_ref, l_ref = rest
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
     @pl.when(ki == 0)
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
-        m_ref[:] = jnp.full_like(m_ref, _NEG_BIG)
+        m_ref[:] = jnp.full_like(m_ref, jnp.float32(_NEG_BIG))
         l_ref[:] = jnp.zeros_like(l_ref)
 
     # causal: skip k blocks entirely above this q block's last row
@@ -175,7 +206,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
                 jnp.int32, s.shape, 0)
             k_idx = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 1)
-            s = jnp.where(q_idx >= k_idx, s, _NEG_BIG)
+            s = jnp.where(q_idx >= k_idx, s, jnp.float32(_NEG_BIG))
         m_prev = m_ref[:, 0:1]                     # (bq, 1)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         corr = jnp.exp(m_prev - m_new)
@@ -190,55 +221,276 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
     @pl.when(ki == nk - 1)
     def _finish():
         o_ref[:] = (acc_ref[:] / l_ref[:, 0:1]).astype(o_ref.dtype)
+        if lse_ref is not None:
+            # logsumexp of the SCALED logits: the backward's whole
+            # softmax state in one (bq,) row vector (lane-broadcast)
+            lse_ref[:] = m_ref[:] + jnp.log(jnp.maximum(l_ref[:], jnp.float32(1e-37)))
+
+
+def _flash_call(qf, kf, vf, dtype, *, scale, causal, bq, bk, with_lse,
+                interpret):
+    BH, Tq, D = qf.shape
+    Tk = kf.shape[1]
+    nk = Tk // bk
+    kern = functools.partial(_flash_kernel, scale=scale, causal=causal,
+                             block_q=bq, block_k=bk, nk=nk,
+                             with_lse=with_lse)
+    out_shape = [jax.ShapeDtypeStruct((BH, Tq, D), dtype)]
+    out_specs = [pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0))]
+    if with_lse:
+        out_shape.append(
+            jax.ShapeDtypeStruct((BH, Tq, _LSE_LANES), jnp.float32))
+        out_specs.append(
+            pl.BlockSpec((None, bq, _LSE_LANES), lambda b, i, j: (b, i, 0)))
+    # this package runs with jax_enable_x64 on (mxnet int64 parity); grid
+    # index maps would then trace their literals as i64, which Mosaic
+    # cannot legalize — trace the kernel in an x64-off scope
+    with _enable_x64(False):
+        res = pl.pallas_call(
+            kern,
+            grid=(BH, Tq // bq, nk),
+            in_specs=[
+                pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((None, bk, D), lambda b, i, j: (b, j, 0)),
+                pl.BlockSpec((None, bk, D), lambda b, i, j: (b, j, 0)),
+            ],
+            out_specs=tuple(out_specs) if with_lse else out_specs[0],
+            out_shape=tuple(out_shape) if with_lse else out_shape[0],
+            scratch_shapes=[
+                pltpu.VMEM((bq, D), jnp.float32),     # acc
+                pltpu.VMEM((bq, 128), jnp.float32),   # running max (lanes
+                pltpu.VMEM((bq, 128), jnp.float32),   # + sum, broadcast)
+            ],
+            interpret=interpret,
+        )(qf, kf, vf)
+    return res if with_lse else (res, None)
 
 
 def fused_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = False, scale=None,
-                    block_q: int = 128, block_k: int = 512) -> jax.Array:
-    """Flash attention: K/V-blocked online softmax.
+                    block_q=None, block_k=None) -> jax.Array:
+    """Flash attention forward: K/V-blocked online softmax.
 
     q/k/v: (B, T, H, D) (the parallel/ring.py layout).  Returns
     (B, T, H, D).  Per grid cell only (block_q + 2*block_k, D) tiles and
     a (block_q, block_k) score tile live in VMEM — HBM traffic is
     O(T*D) and the sequence length is bounded by HBM, not VMEM (the
     round-3 kernel held ALL of K/V in VMEM and topped out near T=8k;
-    this one runs T=32k+ single-chip, tools/bench_pallas.py)."""
+    this one runs T=32k+ single-chip, tools/bench_pallas.py).
+
+    ``block_q``/``block_k`` default to the autotune cache
+    (ops/autotune.py; MXNET_TPU_AUTOTUNE knobs) falling back to 128/512.
+    """
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     if scale is None:
         scale = 1.0 / float(np.sqrt(D))
-    bq = min(block_q, Tq)
-    while Tq % bq:
-        bq //= 2
-    bk = min(block_k, Tk)
-    while Tk % bk:
-        bk //= 2
-    nk = Tk // bk
+    bq, bk = _pick_blocks(block_q, block_k, Tq, Tk, D, q.dtype, "fwd")
     # (B*H, T, D) lanes-last layout for the MXU
     qf = q.transpose(0, 2, 1, 3).reshape(B * H, Tq, D)
     kf = k.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
     vf = v.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
-    kern = functools.partial(_flash_kernel, scale=scale, causal=causal,
-                             block_q=bq, block_k=bk, nk=nk)
-    # this package runs with jax_enable_x64 on (mxnet int64 parity); grid
-    # index maps would then trace their literals as i64, which Mosaic
-    # cannot legalize — trace the kernel in an x64-off scope
+    out, _ = _flash_call(qf, kf, vf, q.dtype, scale=scale, causal=causal,
+                         bq=bq, bk=bk, with_lse=False,
+                         interpret=_interpret(q, k, v))
+    return out.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
+
+
+def fused_attention_fwd(q, k, v, causal=False, scale=None,
+                        block_q=None, block_k=None):
+    """Forward for the custom vjp: returns ``(out, lse)`` where ``lse``
+    is the per-row logsumexp of the scaled logits, shape
+    ``(B*H, Tq, 128)`` f32 (lane-broadcast — see ``_LSE_LANES``).  With
+    this residual the backward never rematerializes the softmax
+    normalizer: one extra O(T) output instead of re-running the O(T²)
+    forward."""
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(D))
+    bq, bk = _pick_blocks(block_q, block_k, Tq, Tk, D, q.dtype, "fwd")
+    qf = q.transpose(0, 2, 1, 3).reshape(B * H, Tq, D)
+    kf = k.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
+    vf = v.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
+    out, lse = _flash_call(qf, kf, vf, q.dtype, scale=scale, causal=causal,
+                           bq=bq, bk=bk, with_lse=True,
+                           interpret=_interpret(q, k, v))
+    return out.reshape(B, H, Tq, D).transpose(0, 2, 1, 3), lse
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
+                         dq_ref, acc_ref, *, scale, causal, block_q,
+                         block_k, nk):
+    """dQ cell: one (bq, D) query block against the sequential k-axis.
+    Recompute-free online-softmax backward: p rebuilds from the saved
+    row logsumexp (one exp per score — never the O(T²) softmax), and
+    ``delta = rowsum(dO·O)`` folds the dV-normalizer term."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    live = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[:].astype(jnp.float32)            # (bq, D)
+        k = k_ref[:].astype(jnp.float32)            # (bk, D)
+        v = v_ref[:].astype(jnp.float32)
+        do = do_ref[:].astype(jnp.float32)          # (bq, D)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale     # (bq, bk)
+        if causal:
+            q_idx = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            k_idx = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            s = jnp.where(q_idx >= k_idx, s, jnp.float32(_NEG_BIG))
+        p = jnp.exp(s - lse_ref[:, 0:1])            # masked rows -> 0
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)             # (bq, bk)
+        ds = p * (dp - dl_ref[:, 0:1]) * scale
+        acc_ref[:] = acc_ref[:] + jnp.dot(
+            ds, k, preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[:] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *, scale,
+                          causal, block_q, block_k, nq):
+    """dK/dV cell: one (bk, D) key/value block against the sequential
+    q-axis, accumulating both grads in VMEM scratch."""
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    # causal: q blocks entirely ABOVE this k block see none of it
+    live = (qi * block_q + block_q - 1 >= ki * block_k) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[:].astype(jnp.float32)            # (bq, D)
+        k = k_ref[:].astype(jnp.float32)            # (bk, D)
+        v = v_ref[:].astype(jnp.float32)
+        do = do_ref[:].astype(jnp.float32)          # (bq, D)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale     # (bq, bk)
+        if causal:
+            q_idx = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            k_idx = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            s = jnp.where(q_idx >= k_idx, s, jnp.float32(_NEG_BIG))
+        p = jnp.exp(s - lse_ref[:, 0:1])            # (bq, bk)
+        # dV += P^T dO
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)             # (bq, bk)
+        ds = p * (dp - dl_ref[:, 0:1]) * scale
+        # dK += dS^T Q
+        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[:] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def fused_attention_bwd(q, k, v, out, lse, do, causal=False, scale=None,
+                        block_q=None, block_k=None):
+    """Flash attention backward: K/V-blocked dQ/dK/dV from the saved
+    logsumexp residual — no forward recomputation, no (T, T) tensor in
+    HBM (the einsum-vjp fallback materializes the full probability
+    matrix AND its gradient: ~2·B·H·T² values of HBM traffic per layer
+    that this kernel never touches).
+
+    q/k/v/out/do: (B, T, H, D); ``lse``: (B*H, Tq, 128) f32 from
+    :func:`fused_attention_fwd`.  Returns (dq, dk, dv) in the input
+    dtypes.  Two pallas calls: dQ accumulates over the sequential
+    k-axis, dK/dV over the sequential q-axis.  Block sizes default to
+    the autotune cache ("bwd" entry) falling back to 128/128."""
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(D))
+    bq, bk = _pick_blocks(block_q, block_k, Tq, Tk, D, q.dtype, "bwd")
+    nq, nk = Tq // bq, Tk // bk
+    qf = q.transpose(0, 2, 1, 3).reshape(B * H, Tq, D)
+    kf = k.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
+    vf = v.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
+    dof = do.transpose(0, 2, 1, 3).reshape(B * H, Tq, D)
+    outf = out.transpose(0, 2, 1, 3).reshape(B * H, Tq, D)
+    # delta = rowsum(dO · O): one cheap fused O(T·D) pass in XLA, then
+    # lane-broadcast like lse so both ride the same (bq, 128) blocks
+    delta = jnp.sum(dof.astype(jnp.float32) * outf.astype(jnp.float32),
+                    axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (B * H, Tq, _LSE_LANES))
+    interpret = _interpret(q, k, v)
     with _enable_x64(False):
-        out = pl.pallas_call(
-            kern,
-            grid=(B * H, Tq // bq, nk),
+        dq = pl.pallas_call(
+            functools.partial(_flash_bwd_dq_kernel, scale=scale,
+                              causal=causal, block_q=bq, block_k=bk,
+                              nk=nk),
+            grid=(B * H, nq, nk),
             in_specs=[
                 pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0)),
                 pl.BlockSpec((None, bk, D), lambda b, i, j: (b, j, 0)),
                 pl.BlockSpec((None, bk, D), lambda b, i, j: (b, j, 0)),
+                pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((None, bq, _LSE_LANES),
+                             lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((None, bq, _LSE_LANES),
+                             lambda b, i, j: (b, i, 0)),
             ],
             out_specs=pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0)),
             out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
-            scratch_shapes=[
-                pltpu.VMEM((bq, D), jnp.float32),     # acc
-                pltpu.VMEM((bq, 128), jnp.float32),   # running max (lanes
-                pltpu.VMEM((bq, 128), jnp.float32),   # + sum, broadcast)
+            scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+            interpret=interpret,
+        )(qf, kf, vf, dof, lse, delta)
+        dk, dv = pl.pallas_call(
+            functools.partial(_flash_bwd_dkv_kernel, scale=scale,
+                              causal=causal, block_q=bq, block_k=bk,
+                              nq=nq),
+            grid=(B * H, nk, nq),
+            in_specs=[
+                pl.BlockSpec((None, bq, D), lambda b, i, j: (b, j, 0)),
+                pl.BlockSpec((None, bk, D), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((None, bk, D), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((None, bq, D), lambda b, i, j: (b, j, 0)),
+                pl.BlockSpec((None, bq, _LSE_LANES),
+                             lambda b, i, j: (b, j, 0)),
+                pl.BlockSpec((None, bq, _LSE_LANES),
+                             lambda b, i, j: (b, j, 0)),
             ],
-            interpret=_interpret(q, k, v),
-        )(qf, kf, vf)
-    return out.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
+            out_specs=(
+                pl.BlockSpec((None, bk, D), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((None, bk, D), lambda b, i, j: (b, i, 0)),
+            ),
+            out_shape=(jax.ShapeDtypeStruct((B * H, Tk, D), k.dtype),
+                       jax.ShapeDtypeStruct((B * H, Tk, D), v.dtype)),
+            scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                            pltpu.VMEM((bk, D), jnp.float32)],
+            interpret=interpret,
+        )(qf, kf, vf, dof, lse, delta)
+
+    def unflat(x, T):
+        return x.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+
+    return unflat(dq, Tq), unflat(dk, Tk), unflat(dv, Tk)

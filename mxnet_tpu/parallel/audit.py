@@ -190,6 +190,7 @@ def audit_report(tag, hlo_text, n_devices, params=None, ring_n=None):
         ov = costmodel.collective_compute_overlap(hlo_text)
         if ov["overlap_pct"] is not None:
             text += " | collective/compute overlap %.1f%% " \
-                "(%d async, %d sync)" % (ov["overlap_pct"],
-                                         ov["async_ops"], ov["sync_ops"])
+                "(%d async, %d sync of which %d pipelined)" % (
+                    ov["overlap_pct"], ov["async_ops"], ov["sync_ops"],
+                    ov.get("pipelined_ops", 0))
     return text, acct

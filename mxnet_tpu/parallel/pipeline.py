@@ -9,8 +9,11 @@ ring step per tick via lax.ppermute.
 
 Model: `stage_fn(stage_id, params, x) -> y` applied on every device under
 shard_map; each device runs its own stage's parameters.  The driver loop
-runs S + M - 1 ticks (S stages, M micro-batches), scanning over a rotating
-buffer.  Backward comes from jax.grad THROUGH the whole schedule — XLA
+runs M + 2(S - 1) ticks (S stages, M micro-batches), scanning over a
+rotating buffer; boundary activations are sent one tick AFTER they are
+computed, so every ppermute has a full tick of independent stage compute
+to hide behind (collective/compute overlap — the send is off the critical
+path).  Backward comes from jax.grad THROUGH the whole schedule — XLA
 differentiates the scan+ppermute program, giving 1F1B-equivalent comms.
 """
 from __future__ import annotations
@@ -47,34 +50,46 @@ def pipeline_apply(stage_fn: Callable, num_stages: int, mesh: Mesh,
         params_local = jax.tree_util.tree_map(lambda p: p[0], params_local)
         stage = jax.lax.axis_index(axis)
         mb_shape = x_all.shape[1:]
-        T = M + S - 1
+        # Overlapped schedule: each boundary activation is SENT one tick
+        # after it is computed, so the ppermute's operand comes from the
+        # carry and its result is consumed only next tick — the hop has
+        # a FULL tick of stage compute that is neither its ancestor nor
+        # its descendant to hide behind (the old compute->send->consume
+        # tick chained every hop on the critical path: the static
+        # overlap instrument read it 0% overlappable).  Stage s runs
+        # micro-batch m at tick m + 2s; the fill/drain grows by S-1
+        # ticks, amortized at M >> S while EVERY hop is hidden.
+        T = M + 2 * (S - 1)
 
         def tick(carry, t):
-            buf, outputs = carry
-            # stage 0 ingests micro-batch t (if in range); others take the
-            # activation passed from the previous stage
+            y_send, buf, outputs = carry
+            # transfer plane first: forward LAST tick's activation
+            # (independent of everything computed this tick)
+            perm = [(j, (j + 1) % S) for j in range(S)]
+            buf_next = jax.lax.ppermute(y_send, axis, perm)
+            # stage 0 ingests micro-batch t (if in range); others take
+            # the activation received at the END of the previous tick
             x_in = jnp.where(t < M, x_all[jnp.minimum(t, M - 1)],
                              jnp.zeros(mb_shape, x_all.dtype))
             inp = jnp.where(stage == 0, x_in, buf)
             y = stage_fn(params_local, inp)
-            # pass activations down the ring: stage s -> s+1
-            perm = [(j, (j + 1) % S) for j in range(S)]
-            buf_next = jax.lax.ppermute(y, axis, perm)
-            # last stage emits micro-batch (t - (S-1)) at tick t
-            emit_idx = t - (S - 1)
+            # last stage computes micro-batch (t - 2(S-1)) at tick t
+            emit_idx = t - 2 * (S - 1)
             is_emit = (stage == S - 1) & (emit_idx >= 0)
             outputs = jnp.where(
                 is_emit,
                 outputs.at[jnp.maximum(emit_idx, 0)].set(y),
                 outputs)
-            return (buf_next, outputs), None
+            return (y, buf_next, outputs), None
 
         # lax.pvary (varying-axis annotation for check_vma) only exists on
         # jax >= 0.6; on older versions zeros are already unvarying-safe
         pvary = getattr(jax.lax, "pvary", lambda x, axes: x)
+        y0 = pvary(jnp.zeros(mb_shape, x_all.dtype), (axis,))
         buf0 = pvary(jnp.zeros(mb_shape, x_all.dtype), (axis,))
         outs0 = pvary(jnp.zeros((M,) + mb_shape, x_all.dtype), (axis,))
-        (_, outputs), _ = jax.lax.scan(tick, (buf0, outs0), jnp.arange(T))
+        (_, _, outputs), _ = jax.lax.scan(tick, (y0, buf0, outs0),
+                                          jnp.arange(T))
         # only the last stage holds real outputs; broadcast them ring-wide
         outputs = jax.lax.psum(
             jnp.where(stage == S - 1, outputs, jnp.zeros_like(outputs)),
@@ -91,10 +106,11 @@ def pipeline_apply(stage_fn: Callable, num_stages: int, mesh: Mesh,
     from .. import telemetry as _tel
     from ..resilience import watchdog as _wd
     from .audit import record_collective
-    # boundary activations hop the ring once per tick: (S+M-1) micro-
-    # batch-sized ppermutes; the final psum moves the (M, mb) outputs
+    # boundary activations hop the ring once per tick: (M + 2(S-1))
+    # micro-batch-sized ppermutes; the final psum moves the (M, mb)
+    # outputs
     act_bytes = int(getattr(x_micro, "nbytes", 0))
-    hop_bytes = (act_bytes // max(M, 1)) * (S + M - 1)
+    hop_bytes = (act_bytes // max(M, 1)) * (M + 2 * (S - 1))
     with _tel.span("collective/pipeline_apply", cat="collective",
                    metric="parallel.collective_seconds",
                    kind="collective-permute,all-reduce",

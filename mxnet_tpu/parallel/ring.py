@@ -57,9 +57,9 @@ def local_ring_attention_fn(axis_name: str, causal: bool, scale: float,
         my_idx = jax.lax.axis_index(axis_name)
         B, Tq, H, D = q.shape
         Tk = k.shape[1]
+        perm = [(j, (j + 1) % num_devices) for j in range(num_devices)]
 
-        def step(carry, i):
-            k_blk, v_blk, o_acc, m_acc, l_acc = carry
+        def block(i, k_blk, v_blk):
             # which global block do we hold? blocks rotate j -> j+1 each
             # step, so at step i device j holds block (j - i) mod n
             blk_idx = (my_idx - i) % num_devices
@@ -70,8 +70,13 @@ def local_ring_attention_fn(axis_name: str, causal: bool, scale: float,
                 mask = mask[None, None]  # (1,1,Tq,Tk)
             else:
                 mask = None
-            o, m, l = _block_attn(q, k_blk, v_blk, mask, scale)
-            # online softmax merge; -inf maxima (fully-masked so far) guarded
+            return _block_attn(q, k_blk, v_blk, mask, scale)
+
+        def merge(acc, blk):
+            # online softmax merge; -inf maxima (fully-masked so far)
+            # guarded
+            o_acc, m_acc, l_acc = acc
+            o, m, l = blk
             new_m = jnp.maximum(m_acc, m)
             new_m_safe = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
             alpha = jnp.where(jnp.isfinite(m_acc),
@@ -81,11 +86,19 @@ def local_ring_attention_fn(axis_name: str, causal: bool, scale: float,
             l_new = l_acc * alpha + l * beta
             o_new = o_acc * alpha[..., None].swapaxes(1, 2) + \
                 o * beta[..., None].swapaxes(1, 2)
-            # rotate k/v to the next device on the ring (overlaps with the
-            # next block's compute under XLA's async collectives)
-            perm = [(j, (j + 1) % num_devices) for j in range(num_devices)]
+            return (o_new, new_m, l_new)
+
+        def step(carry, i):
+            k_blk, v_blk, o_acc, m_acc, l_acc = carry
+            # double-buffered ring step: block i+1's rotation is issued
+            # BEFORE block i's attention, and neither depends on the
+            # other — the ICI hop flies while the MXU works (the static
+            # overlap instrument proves the schedulability; an async
+            # backend realizes it as -start/compute/-done)
             k_next = jax.lax.ppermute(k_blk, axis_name, perm)
             v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+            o_new, new_m, l_new = merge((o_acc, m_acc, l_acc),
+                                        block(i, k_blk, v_blk))
             return (k_next, v_next, o_new, new_m, l_new), None
 
         # derive initial accumulators from q so they carry the same
@@ -94,7 +107,12 @@ def local_ring_attention_fn(axis_name: str, causal: bool, scale: float,
         m0 = jnp.swapaxes(q[..., 0] * 0 - jnp.inf, 1, 2)   # (B,H,Tq)
         l0 = jnp.swapaxes(q[..., 0] * 0, 1, 2)
         (k, v, o, m, l), _ = jax.lax.scan(
-            step, (k, v, o0, m0, l0), jnp.arange(num_devices))
+            step, (k, v, o0, m0, l0), jnp.arange(num_devices - 1))
+        # the LAST block needs no rotation: the old n-step loop's final
+        # ppermute only carried k/v home to be discarded — 1/n of the
+        # ring's wire bytes for nothing (and n=1 paid a pointless
+        # self-permute)
+        o, m, l = merge((o, m, l), block(num_devices - 1, k, v))
         l_t = jnp.swapaxes(l, 1, 2)[..., None]   # (B,Tq,H,1)
         return o / jnp.maximum(l_t, 1e-20)
 

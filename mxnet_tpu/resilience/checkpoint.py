@@ -22,10 +22,13 @@ state), and :func:`save_gluon_trainer`/:func:`restore_gluon_trainer`.
 """
 from __future__ import annotations
 
+import atexit
 import logging
 import os
 import re
-from collections import namedtuple
+import threading
+import weakref
+from collections import deque, namedtuple
 from typing import Optional
 
 import numpy as np
@@ -42,14 +45,55 @@ _SUFFIX = ".mxtck"
 Checkpoint = namedtuple("Checkpoint", ["step", "path", "arrays", "meta",
                                        "blobs"])
 
+# live managers with a writer thread — one atexit hook drains them all so
+# a NORMAL interpreter exit never loses a queued write (a crash still
+# does, by design: the previous checkpoint stays valid, see save())
+_LIVE_MANAGERS: "weakref.WeakSet" = weakref.WeakSet()
+_ATEXIT_ARMED = False
+
+
+def _flush_all_managers():
+    for mgr in list(_LIVE_MANAGERS):
+        try:
+            mgr.wait(timeout=float(os.environ.get(
+                "MXNET_TPU_ASYNC_CKPT_EXIT_FLUSH_S", "120")))
+        except Exception:
+            logging.exception("checkpoint: exit flush failed")
+
 
 class CheckpointManager:
-    """Versioned checkpoints under one directory."""
+    """Versioned checkpoints under one directory.
 
-    def __init__(self, directory: str, prefix: str = "ckpt", keep: int = 3):
+    **Async snapshot-then-write** (round 6, default on): ``save``
+    serializes/snapshots on the caller thread and returns as soon as the
+    payload is handed to a background writer thread, which does the
+    CRC + temp-write + fsync + rename (still atomic per file — a crash
+    mid-write leaves the previous checkpoint untouched, a crash BEFORE
+    the write simply means that snapshot never existed).  The step loop
+    pays only the host snapshot; the disk leaves the critical path
+    (``checkpoint/save`` vs ``checkpoint/write`` spans prove it).  Every
+    read API (``steps``/``restore``/``latest``) barriers on in-flight
+    writes first, so save → restore races cannot observe a half-state,
+    and a writer failure re-raises on the next ``save``/``wait`` —
+    never silently.  ``MXNET_TPU_ASYNC_CKPT=0`` (or
+    ``async_write=False``) restores fully synchronous saves; callers
+    that read checkpoint FILES directly (not through the manager) must
+    call :meth:`wait` first."""
+
+    def __init__(self, directory: str, prefix: str = "ckpt", keep: int = 3,
+                 async_write: Optional[bool] = None):
         self.directory = os.fspath(directory)
         self.prefix = prefix
         self.keep = int(keep)
+        if async_write is None:
+            async_write = os.environ.get("MXNET_TPU_ASYNC_CKPT",
+                                         "1") == "1"
+        self.async_write = bool(async_write)
+        self._queue: deque = deque()
+        self._cv = threading.Condition()
+        self._writer: Optional[threading.Thread] = None
+        self._inflight = 0
+        self._write_error: Optional[BaseException] = None
         os.makedirs(self.directory, exist_ok=True)
         self._pat = re.compile(
             re.escape(prefix) + r"-(\d{10})" + re.escape(_SUFFIX) + r"$")
@@ -63,7 +107,9 @@ class CheckpointManager:
                             "%s-%010d%s" % (self.prefix, int(step), _SUFFIX))
 
     def steps(self):
-        """Steps with an (unquarantined) checkpoint file, ascending."""
+        """Steps with an (unquarantined) checkpoint file, ascending —
+        after draining any in-flight writes."""
+        self.wait()
         out = []
         for name in os.listdir(self.directory):
             m = self._pat.match(name)
@@ -73,20 +119,109 @@ class CheckpointManager:
 
     # -- write -----------------------------------------------------------
     def save(self, step: int, arrays, meta=None, blobs=None) -> str:
+        """Queue (async, default) or write (sync) one checkpoint.
+        Returns the final path; with async writes the file appears when
+        the writer lands it — read it through the manager (which
+        barriers) or after :meth:`wait`."""
         from .. import telemetry
         meta = dict(meta or {})
         meta["step"] = int(step)
         with telemetry.span("checkpoint/save", cat="checkpoint",
                             metric="checkpoint.save_seconds",
                             step=int(step)):
-            path = write_container(self.path_for(step), arrays, meta, blobs)
-            self._retain()
+            self._raise_write_error()
+            if not self.async_write:
+                path = write_container(self.path_for(step), arrays, meta,
+                                       blobs)
+                self._retain()
+            else:
+                path = self.path_for(step)
+                with self._cv:
+                    self._queue.append((int(step), arrays, meta, blobs))
+                    self._inflight += 1
+                    self._ensure_writer()
+                    self._cv.notify_all()
         telemetry.count("checkpoint.saves")
         return path
+
+    def _ensure_writer(self):
+        global _ATEXIT_ARMED
+        if self._writer is not None and self._writer.is_alive():
+            return
+        self._writer = threading.Thread(
+            target=self._writer_loop, name="ckpt-writer", daemon=True)
+        self._writer.start()
+        _LIVE_MANAGERS.add(self)
+        if not _ATEXIT_ARMED:
+            _ATEXIT_ARMED = True
+            atexit.register(_flush_all_managers)
+
+    def _writer_loop(self):
+        from .. import telemetry
+        while True:
+            with self._cv:
+                while not self._queue:
+                    self._cv.wait()
+                step, arrays, meta, blobs = self._queue.popleft()
+            try:
+                with telemetry.span("checkpoint/write", cat="checkpoint",
+                                    metric="checkpoint.write_seconds",
+                                    step=step):
+                    write_container(self.path_for(step), arrays, meta,
+                                    blobs)
+                    self._retain_unsynced()
+                telemetry.count("checkpoint.writes")
+            except BaseException as e:   # surfaced on next save()/wait()
+                logging.exception("checkpoint: background write of step "
+                                  "%d failed", step)
+                with self._cv:
+                    if self._write_error is None:
+                        self._write_error = e
+            finally:
+                with self._cv:
+                    self._inflight -= 1
+                    self._cv.notify_all()
+
+    def _raise_write_error(self):
+        with self._cv:
+            err, self._write_error = self._write_error, None
+        if err is not None:
+            raise MXNetError(
+                "background checkpoint write failed: %s (the previous "
+                "valid checkpoint on disk is untouched)" % err)
+
+    def pending(self) -> int:
+        """Writes queued or in flight on the background writer."""
+        with self._cv:
+            return self._inflight
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until every queued write is durable (or ``timeout``
+        seconds elapse — returns False then).  Re-raises the first
+        writer error."""
+        with self._cv:
+            ok = self._cv.wait_for(lambda: self._inflight == 0,
+                                   timeout=timeout)
+        self._raise_write_error()
+        return ok
 
     def _retain(self):
         steps = self.steps()
         for s in steps[:-self.keep] if self.keep > 0 else []:
+            try:
+                os.unlink(self.path_for(s))
+            except OSError:
+                pass
+
+    def _retain_unsynced(self):
+        """Retention from the writer thread: same policy, but listing the
+        directory directly — steps() would deadlock on the barrier."""
+        out = []
+        for name in os.listdir(self.directory):
+            m = self._pat.match(name)
+            if m:
+                out.append(int(m.group(1)))
+        for s in sorted(out)[:-self.keep] if self.keep > 0 else []:
             try:
                 os.unlink(self.path_for(s))
             except OSError:
@@ -97,8 +232,11 @@ class CheckpointManager:
         """Load ``step`` (exact, no fallback) or — with ``step=None`` —
         the newest snapshot that VALIDATES, quarantining any corrupt
         files found on the way down.  Returns None when nothing valid
-        exists."""
+        exists.  Barriers on in-flight async writes first, so a restore
+        concurrent with a save sees either the completed checkpoint or
+        the previous one — never a partial file."""
         from .. import telemetry
+        self.wait()
         with telemetry.span("checkpoint/restore", cat="checkpoint",
                             metric="checkpoint.restore_seconds"):
             if step is not None:
@@ -247,14 +385,20 @@ def save_trainer(manager, trainer, params, mom, aux, step, extra_meta=None,
                  data_iter=None):
     """Snapshot a ShardedTrainer's full state (params, momentum, aux,
     loss-scale automaton, input shapes, optional iterator position) as
-    one atomic checkpoint."""
+    one atomic checkpoint.  The device→host fetch here plus the
+    manager's enqueue is ALL the step loop pays with async writes —
+    the ``checkpoint/snapshot`` span measures exactly that fetch."""
+    from .. import telemetry
     arrays = {}
-    for n, p in zip(trainer.param_names, params):
-        arrays["param/" + n] = np.asarray(p)
-    for n, m in zip(trainer.param_names, mom):
-        arrays["mom/" + n] = np.asarray(m)
-    for n, a in zip(trainer.prog.aux_names, aux):
-        arrays["aux/" + n] = np.asarray(a)
+    with telemetry.span("checkpoint/snapshot", cat="checkpoint",
+                        metric="checkpoint.snapshot_seconds",
+                        step=int(step)):
+        for n, p in zip(trainer.param_names, params):
+            arrays["param/" + n] = np.asarray(p)
+        for n, m in zip(trainer.param_names, mom):
+            arrays["mom/" + n] = np.asarray(m)
+        for n, a in zip(trainer.prog.aux_names, aux):
+            arrays["aux/" + n] = np.asarray(a)
     meta = dict(extra_meta or {})
     meta["kind"] = "sharded_trainer"
     meta["shapes"] = {k: list(v) for k, v

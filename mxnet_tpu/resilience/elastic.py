@@ -901,6 +901,9 @@ class ElasticCoordinator:
             save_trainer(self.manager, self.trainer, *self._state,
                          step=step, data_iter=self.data_iter,
                          extra_meta={"generation": self.gen})
+            # the process exits via os._exit right after this: drain the
+            # async writer NOW or the resize checkpoint dies in the queue
+            self.manager.wait()
         except Exception:
             logging.exception(
                 "elastic: fresh snapshot at step %d failed — the newest "

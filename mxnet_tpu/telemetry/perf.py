@@ -180,10 +180,11 @@ class AttributionReport:
         ov = d.get("overlap", {})
         if ov.get("overlap_pct") is not None:
             lines.append("collective/compute overlap: %.1f%% of %.2f MB "
-                         "(%d async / %d sync ops)"
+                         "(%d async / %d sync ops, %d pipelined)"
                          % (ov["overlap_pct"],
                             ov["collective_bytes"] / 1e6,
-                            ov["async_ops"], ov["sync_ops"]))
+                            ov["async_ops"], ov["sync_ops"],
+                            ov.get("pipelined_ops", 0)))
         r = d.get("roofline", {})
         if r:
             lines.append(

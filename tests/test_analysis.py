@@ -709,3 +709,70 @@ def test_gc501_trainer_preflight_seeded_and_clean(tmp_path, monkeypatch):
                if p.startswith("preflight-trainer") and p.endswith(".json")]
     clean = Report.load(str(tmp_path / sorted(reports)[-1]))
     assert not [f for f in clean if f.rule == "GC501"]
+
+
+# ---------------------------------------------------------------------------
+# GC304: collectives serialized against compute (round 6)
+# ---------------------------------------------------------------------------
+
+# 2 MB sync all-reduce on the critical path: its only neighbors are its
+# producer (multiply) and consumer (add) — nothing to hide behind
+_GC304_SERIAL_HLO = """
+ENTRY %main (p0: f32[524288]) -> f32[524288] {
+  %p0 = f32[524288]{0} parameter(0)
+  %w = f32[524288]{0} multiply(f32[524288]{0} %p0, f32[524288]{0} %p0)
+  %ar = f32[524288]{0} all-reduce(f32[524288]{0} %w), replica_groups={}
+  ROOT %out = f32[524288]{0} add(f32[524288]{0} %ar, f32[524288]{0} %ar)
+}
+"""
+
+# same payload, but an independent dot exists in the computation — a
+# double-buffered schedule any async backend can hide the transfer in
+_GC304_PIPELINED_HLO = """
+ENTRY %main (p0: f32[524288], q0: f32[128,128]) -> f32[524288] {
+  %p0 = f32[524288]{0} parameter(0)
+  %q0 = f32[128,128]{1,0} parameter(1)
+  %ar = f32[524288]{0} all-reduce(f32[524288]{0} %p0), replica_groups={}
+  %mm = f32[128,128]{1,0} dot(f32[128,128]{1,0} %q0, f32[128,128]{1,0} %q0), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  ROOT %out = f32[524288]{0} add(f32[524288]{0} %ar, f32[524288]{0} %ar)
+}
+"""
+
+
+def test_gc304_seeded_all_sync_serial():
+    rep = graphcheck.check_overlap(_GC304_SERIAL_HLO, target="toy")
+    assert _rules(rep) == ["GC304"]
+    (f,) = list(rep)
+    assert f.severity == "warning"
+    assert f.extra["sync_ops"] == 1 and f.extra["pipelined_ops"] == 0
+
+
+def test_gc304_clean_when_overlap_exists():
+    rep = graphcheck.check_overlap(_GC304_PIPELINED_HLO, target="toy")
+    assert _rules(rep) == []
+
+
+def test_gc304_tiny_payload_not_flagged():
+    # the serial shape again, but 4 KB of payload: hiding a microsecond
+    # transfer buys nothing — below MXNET_TPU_GC304_MIN_MB stays clean
+    small = _GC304_SERIAL_HLO.replace("524288", "1024")
+    assert _rules(graphcheck.check_overlap(small, target="toy")) == []
+    # explicit floor override flags it again
+    rep = graphcheck.check_overlap(small, target="toy", min_bytes=1)
+    assert _rules(rep) == ["GC304"]
+
+
+def test_gc304_clean_on_ring_attention_program():
+    """The double-buffered ring schedule (r6) must never flag: every
+    ppermute has the block's attention dots to hide behind — even with
+    the payload floor removed."""
+    from mxnet_tpu.parallel.ring import local_ring_attention_fn
+    n = 2
+    mesh = _mesh(n, "sp")
+    fn = local_ring_attention_fn("sp", False, 0.25, n)
+    spec = P(None, "sp", None, None)
+    mapped = _smap(fn, mesh, (spec,) * 3, spec)
+    x = jnp.ones((1, 4 * n, 2, 8), jnp.float32)
+    txt = jax.jit(mapped).lower(x, x, x).compile().as_text()
+    rep = graphcheck.check_overlap(txt, target="ring", min_bytes=0)
+    assert _rules(rep) == [], [f.message for f in rep]

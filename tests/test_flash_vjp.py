@@ -1,0 +1,145 @@
+"""Flash vjp parity across the MXNET_FLASH_MIN_SEQ dispatch boundary
+(round-6 satellite): below the threshold the op IS the einsum
+formulation — grads bit-match it; at/above the threshold the Pallas
+flash fwd+bwd pair must agree with the einsum vjp to float tolerance —
+under jit, and under the trainer's in-jit grad_accum scan.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu.models.transformer import get_symbol
+from mxnet_tpu.parallel.mesh import MeshSpec, make_mesh
+from mxnet_tpu.parallel.trainer import ShardedTrainer
+from mxnet_tpu.ops.nn import _contrib_fused_attention
+from mxnet_tpu.ops.registry import get_op
+
+
+def _op_fn(T, flash_min_seq, causal=True):
+    """The registered op body with a pinned dispatch threshold — the
+    exact code path Symbol/Gluon models trace."""
+    op = get_op("_contrib_fused_attention")
+    attrs = op.parse_attrs(dict(causal=causal,
+                                flash_min_seq=flash_min_seq))
+
+    def f(q, k, v):
+        return op.fn(attrs, q, k, v)
+
+    return f
+
+
+def _einsum_ref(q, k, v, causal=True):
+    scale = float(1.0 / np.sqrt(q.shape[-1]))
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        Tq, Tk = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((Tq, Tk), bool))
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _grads(fn, q, k, v, g):
+    def loss(q, k, v):
+        return jnp.sum(fn(q, k, v).astype(jnp.float32) *
+                       g.astype(jnp.float32))
+
+    return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+
+
+def test_vjp_bit_matches_einsum_below_threshold():
+    """T < flash_min_seq: the op runs the einsum formulation end to end;
+    its jitted grads are BIT-identical to the reference einsum vjp."""
+    rs = np.random.RandomState(0)
+    B, T, H, D = 2, 16, 2, 8
+    q, k, v, g = (jnp.asarray(rs.normal(0, 1, (B, T, H, D))
+                              .astype(np.float32)) for _ in range(4))
+    got = _grads(_op_fn(T, flash_min_seq=32), q, k, v, g)
+    want = _grads(lambda a, b, c: _einsum_ref(a, b, c), q, k, v, g)
+    for x, w in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(w))
+
+
+@pytest.mark.parametrize("dtype,rtol,atol",
+                         [(np.float32, 1e-4, 1e-5),
+                          ("bfloat16", 0.1, 0.05)],
+                         ids=["f32", "bf16"])
+def test_vjp_matches_einsum_above_threshold(dtype, rtol, atol):
+    """T >= flash_min_seq: the Pallas flash fwd+bwd under jit agrees
+    with the einsum vjp — f32 to float roundoff, bf16 within bf16
+    tolerance."""
+    rs = np.random.RandomState(1)
+    B, T, H, D = 2, 32, 2, 8
+    mk = lambda: jnp.asarray(rs.normal(0, 1, (B, T, H, D))
+                             .astype(np.float32))
+    q, k, v, g = mk(), mk(), mk(), mk()
+    if dtype == "bfloat16":
+        q, k, v, g = (x.astype(jnp.bfloat16) for x in (q, k, v, g))
+    got = _grads(_op_fn(T, flash_min_seq=T), q, k, v, g)
+    f32 = lambda x: jnp.asarray(np.asarray(x, np.float32))
+    want = _grads(lambda a, b, c: _einsum_ref(a, b, c),
+                  f32(q), f32(k), f32(v), f32(g))
+    for x, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(x, np.float32),
+                                   np.asarray(w), rtol=rtol, atol=atol)
+
+
+def _lm_trainer(flash_min_seq, accum, seed=5):
+    spec = MeshSpec(make_mesh((2,), ("dp",)))
+    net = get_symbol(vocab_size=12, seq_len=16, num_layers=1, hidden=16,
+                     heads=2, flash_min_seq=flash_min_seq)
+    tr = ShardedTrainer(net, spec, lr=0.1, momentum=0.9, wd=0.0,
+                        grad_accum=accum)
+    shapes = {"data": (8, 16), "softmax_label": (8, 16)}
+    p, m, x = tr.init_state(shapes, seed=seed)
+    return tr, p, m, x
+
+
+def _lm_batches(n=2):
+    rs = np.random.RandomState(11)
+    return [{"data": rs.randint(0, 12, (8, 16)).astype(np.float32),
+             "softmax_label": rs.randint(0, 12, (8, 16))
+             .astype(np.float32)}
+            for _ in range(n)]
+
+
+@pytest.mark.parametrize("flash_min_seq", [10000, 1],
+                         ids=["einsum-path", "flash-path"])
+def test_grad_accum_invariant_holds_with_flash_vjp(flash_min_seq):
+    """grad_accum=2 must produce the same update as accum=1 on the same
+    rows THROUGH the attention custom vjp — on both sides of the
+    dispatch boundary (the flash side runs the Pallas backward inside
+    the in-jit lax.scan).  Tolerance is f32-reassociation-tight, not
+    bitwise: unlike the MLP invariant test, the LM's LayerNorm/softmax
+    reductions reassociate between the one-big-batch and the
+    scan-accumulated program."""
+    batches = _lm_batches()
+    outs = {}
+    for accum in (1, 2):
+        tr, p, m, x = _lm_trainer(flash_min_seq, accum)
+        for b in batches:
+            p, m, x, loss = tr.step(p, m, x, b)
+        outs[accum] = (p, float(loss))
+    for a, b in zip(outs[1][0], outs[2][0]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+    assert outs[1][1] == pytest.approx(outs[2][1], rel=1e-4)
+
+
+def test_training_parity_across_dispatch_boundary():
+    """The SAME tiny LM trained with the einsum path vs the flash path
+    lands on matching parameters — the dispatch boundary changes the
+    schedule, not the math."""
+    batches = _lm_batches()
+    final = {}
+    for key, fms in (("einsum", 10000), ("flash", 1)):
+        tr, p, m, x = _lm_trainer(fms, accum=1)
+        for b in batches:
+            p, m, x, _ = tr.step(p, m, x, b)
+        final[key] = p
+    for a, b in zip(final["einsum"], final["flash"]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)

@@ -5,7 +5,10 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from mxnet_tpu.ops.pallas_kernels import fused_attention, two_bit_compress
+from mxnet_tpu.ops.pallas_kernels import (fused_attention,
+                                          fused_attention_bwd,
+                                          fused_attention_fwd,
+                                          two_bit_compress)
 
 
 @pytest.mark.parametrize("use_pallas", [False, True],
@@ -93,8 +96,8 @@ def test_fused_attention_single_block():
 
 def test_fused_attention_op_flash_min_seq_attr():
     """Op-level flash dispatch: flash_min_seq=1 forces the Pallas flash
-    forward + rematerialized einsum backward THROUGH the operator even at
-    tiny T (the env default would route this to the plain einsum path).
+    forward + fused flash backward THROUGH the operator even at tiny T
+    (the env default would route this to the plain einsum path).
     Covers the attr half of the MXNET_FLASH_MIN_SEQ resolution — the env
     half is frozen at import so it cannot silently change post-trace."""
     import mxnet_tpu as mx
@@ -120,3 +123,83 @@ def test_fused_attention_op_flash_min_seq_attr():
         mx.autograd.backward([o])
     g = gq.asnumpy()
     assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+# ---------------------------------------------------------------------------
+# flash backward (round 6): recompute-free dQ/dK/dV from the saved lse
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("blocks", [(16, 16), (16, 8), (8, 16)],
+                         ids=["sym", "multi-k", "multi-q"])
+def test_flash_backward_matches_einsum_vjp(causal, blocks):
+    """The flash dQ/dK/dV kernels against jax.vjp of the einsum
+    formulation, across block shapes that force the online accumulators
+    (multi-k: several score tiles per dQ row; multi-q: several per
+    dK/dV column) and the causal block-skipping."""
+    bq, bk = blocks
+    rs = np.random.RandomState(7)
+    B, T, H, D = 2, 32, 2, 16
+    q = jnp.asarray(rs.normal(0, 1, (B, T, H, D)).astype(np.float32))
+    k = jnp.asarray(rs.normal(0, 1, (B, T, H, D)).astype(np.float32))
+    v = jnp.asarray(rs.normal(0, 1, (B, T, H, D)).astype(np.float32))
+    g = jnp.asarray(rs.normal(0, 1, (B, T, H, D)).astype(np.float32))
+    scale = float(1.0 / np.sqrt(D))
+
+    out, lse = fused_attention_fwd(q, k, v, causal=causal,
+                                   block_q=bq, block_k=bk)
+    np.testing.assert_allclose(
+        np.asarray(out),
+        np.asarray(_naive_attention(q, k, v, causal=causal)),
+        rtol=1e-4, atol=1e-5)
+    dq, dk, dv = fused_attention_bwd(q, k, v, out, lse, g, causal=causal,
+                                     block_q=bq, block_k=bk)
+    _, vjp = jax.vjp(
+        lambda a, b, c: _naive_attention(a, b, c, causal=causal,
+                                         scale=scale), q, k, v)
+    wq, wk, wv = vjp(g)
+    for got, want in ((dq, wq), (dk, wk), (dv, wv)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_flash_fwd_lse_is_row_logsumexp():
+    """The residual really is logsumexp of the scaled (masked) logits —
+    the invariant the backward rebuilds p from."""
+    rs = np.random.RandomState(8)
+    B, T, H, D = 1, 32, 1, 8
+    q = jnp.asarray(rs.normal(0, 1, (B, T, H, D)).astype(np.float32))
+    scale = float(1.0 / np.sqrt(D))
+    _, lse = fused_attention_fwd(q, q, q, causal=True, block_q=16,
+                                 block_k=8)
+    s = np.einsum("bqhd,bkhd->bhqk", np.asarray(q), np.asarray(q)) * scale
+    s = np.where(np.tril(np.ones((T, T), bool)), s, -np.inf)
+    want = np.log(np.exp(s - s.max(-1, keepdims=True)).sum(-1)) \
+        + s.max(-1)                                   # (B,H,T)
+    got = np.asarray(lse)[:, :, 0].reshape(B, H, T)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+    # every lane carries the same broadcast value
+    assert np.all(np.asarray(lse) == np.asarray(lse)[:, :, :1])
+
+
+def test_flash_bwd_bf16_tolerance():
+    rs = np.random.RandomState(9)
+    B, T, H, D = 1, 32, 2, 16
+    mk = lambda: jnp.asarray(
+        rs.normal(0, 1, (B, T, H, D)).astype(np.float32)).astype(
+        jnp.bfloat16)
+    q, k, v, g = mk(), mk(), mk(), mk()
+    out, lse = fused_attention_fwd(q, k, v, causal=True, block_q=16,
+                                   block_k=16)
+    dq, dk, dv = fused_attention_bwd(q, k, v, out, lse, g, causal=True,
+                                     block_q=16, block_k=16)
+    scale = float(1.0 / np.sqrt(D))
+    f32 = lambda x: jnp.asarray(np.asarray(x, np.float32))
+    _, vjp = jax.vjp(
+        lambda a, b, c: _naive_attention(a, b, c, causal=True,
+                                         scale=scale),
+        f32(q), f32(k), f32(v))
+    for got, want in zip((dq, dk, dv), vjp(f32(g))):
+        assert got.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(want), rtol=0.1, atol=0.05)

@@ -153,11 +153,53 @@ ENTRY %main (p0: f32[1024]) -> f32[1024] {
 """
 
 
+PIPELINED_SYNC_HLO = """
+ENTRY %main (p0: f32[1024], q0: f32[64,64]) -> f32[1024] {
+  %p0 = f32[1024]{0} parameter(0)
+  %q0 = f32[64,64]{1,0} parameter(1)
+  %ar = f32[1024]{0} all-reduce(f32[1024]{0} %p0), replica_groups={}
+  %mm = f32[64,64]{1,0} dot(f32[64,64]{1,0} %q0, f32[64,64]{1,0} %q0), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  ROOT %out = f32[1024]{0} add(f32[1024]{0} %ar, f32[1024]{0} %ar)
+}
+"""
+
+
 def test_overlap_sync_is_zero():
+    """A sync collective whose only neighbors are its own producers and
+    consumers (no independent heavy compute) cannot be hidden by any
+    scheduler: 0%."""
     ov = costmodel.collective_compute_overlap(SYNC_HLO)
     assert ov["collective_bytes"] == 4096
     assert ov["overlap_pct"] == 0.0
     assert ov["sync_ops"] == 1 and ov["async_ops"] == 0
+    assert ov["pipelined_ops"] == 0
+
+
+def test_overlap_pipelined_sync_counts():
+    """r6 extension: a sync collective with an independent dot in the
+    same computation is schedulable overlap — backends with async
+    collectives (TPU) hide it; the CPU dryrun proves the schedule."""
+    ov = costmodel.collective_compute_overlap(PIPELINED_SYNC_HLO)
+    assert ov["sync_ops"] == 1 and ov["pipelined_ops"] == 1
+    assert ov["overlapped_bytes"] == 4096
+    assert ov["overlap_pct"] == 100.0
+    assert ov["by_kind"]["all-reduce"]["pipelined"] == 1
+
+
+def test_overlap_pipelined_ignores_ancestor_descendant_compute():
+    """The dot being the collective's producer or consumer must NOT
+    count — that is exactly the serialized GPipe-hop shape."""
+    serial = """
+ENTRY %main (q0: f32[64,64]) -> f32[64,64] {
+  %q0 = f32[64,64]{1,0} parameter(1)
+  %mm = f32[64,64]{1,0} dot(f32[64,64]{1,0} %q0, f32[64,64]{1,0} %q0), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %cp = f32[64,64]{1,0} collective-permute(f32[64,64]{1,0} %mm), source_target_pairs={{0,1},{1,0}}
+  ROOT %mm2 = f32[64,64]{1,0} dot(f32[64,64]{1,0} %cp, f32[64,64]{1,0} %q0), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+}
+"""
+    ov = costmodel.collective_compute_overlap(serial)
+    assert ov["sync_ops"] == 1 and ov["pipelined_ops"] == 0
+    assert ov["overlap_pct"] == 0.0
 
 
 def test_overlap_async_with_compute_between():
@@ -165,6 +207,49 @@ def test_overlap_async_with_compute_between():
     assert ov["async_ops"] == 1
     assert ov["overlapped_bytes"] == 4096
     assert ov["overlap_pct"] == 100.0
+
+
+def test_overlap_ring_and_pipeline_schedules():
+    """The r6 double-buffered parallel schedules measure overlapped on
+    their boundary hops (the acceptance instrument for the dp8 dryrun
+    audit): every ring ppermute is hidden; the pipeline's hop is hidden
+    while its output psum (inherently after the loop) is not."""
+    import mxnet_tpu  # noqa: F401
+    from mxnet_tpu.parallel.mesh import make_mesh
+    from mxnet_tpu.parallel.ring import local_ring_attention_fn
+    try:
+        from jax import shard_map as smap2
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as smap2
+    from jax.sharding import PartitionSpec as PS
+    n = 2
+    mesh = make_mesh((n,), ("sp",))
+    compat = {} if hasattr(jax.lax, "pvary") else {"check_rep": False}
+    fn = local_ring_attention_fn("sp", False, 0.25, n)
+    spec = PS(None, "sp", None, None)
+    mapped = smap2(fn, mesh=mesh, in_specs=(spec,) * 3, out_specs=spec,
+                   **compat)
+    x = jnp.ones((1, 4 * n, 2, 8), jnp.float32)
+    txt = jax.jit(mapped).lower(x, x, x).compile().as_text()
+    ov = costmodel.collective_compute_overlap(txt)
+    assert ov["overlap_pct"] == 100.0
+    assert ov["by_kind"]["collective-permute"]["pipelined"] == 2
+
+    from mxnet_tpu.parallel.pipeline import pipeline_apply
+    pp_mesh = make_mesh((n,), ("pp",))
+    Ws = jnp.ones((n, 8, 8), jnp.float32) * 0.1
+    xm = jnp.ones((4, 2, 8), jnp.float32)
+
+    def run(p, xmi):
+        return pipeline_apply(lambda w, v: jnp.tanh(v @ w), n, pp_mesh,
+                              "pp", p, xmi)
+
+    txt = jax.jit(run).lower(Ws, xm).compile().as_text()
+    ov = costmodel.collective_compute_overlap(txt)
+    cp = ov["by_kind"]["collective-permute"]
+    assert cp["pipelined"] == cp["sync"], \
+        "every boundary hop must be double-buffered"
+    assert ov["overlapped_bytes"] >= cp["bytes"]
 
 
 def test_audit_report_carries_overlap_line():

@@ -85,6 +85,7 @@ def test_checkpoint_file_has_no_pickled_code(tmp_path):
     the whole file fails pickle.loads and the header is plain JSON."""
     mgr = CheckpointManager(str(tmp_path), keep=2)
     mgr.save(1, {"w": np.ones(4, np.float32)}, {"epoch": 0})
+    mgr.wait()   # reading the FILE directly: drain the async writer
     path = mgr.path_for(1)
     raw = open(path, "rb").read()
     with pytest.raises(Exception):
@@ -112,6 +113,7 @@ def test_corrupt_latest_quarantined_and_fallback(tmp_path, mode):
     mgr = CheckpointManager(str(tmp_path), keep=3)
     for s in (1, 2, 3):
         mgr.save(s, {"w": np.full(3, s, np.float32)})
+    mgr.wait()   # chaos corrupts FILES directly: drain the async writer
     assert chaos.corrupt_latest(str(tmp_path), mode=mode) is not None
     ck = mgr.latest()
     assert ck.step == 2, "must fall back to the newest VALID checkpoint"

@@ -76,6 +76,17 @@ def _graphcheck_builtin(report):
     report.extend(graphcheck.check_fn(mapped, blk, blk, blk,
                                       mesh=ring_mesh,
                                       target="parallel.ring_attention"))
+    # GC304 needs compiled HLO (the -start/-done schedule): the ring toy
+    # compiles in well under a second on the CPU mesh.  The 1 MB payload
+    # floor keeps toy shapes from flagging; the rule's real teeth are the
+    # seeded tests + the dryrun audit overlap line.
+    try:
+        txt = jax.jit(mapped).lower(blk, blk, blk).compile().as_text()
+        report.extend(graphcheck.check_overlap(
+            txt, target="parallel.ring_attention"))
+    except Exception as e:      # compile envs vary; tracing already ran
+        print("tpulint: ring overlap check skipped: %r" % e,
+              file=sys.stderr)
 
     # moe dispatch/combine schedule
     ep_mesh = make_mesh((n,), ("ep",))
