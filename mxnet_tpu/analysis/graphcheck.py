@@ -645,6 +645,49 @@ def check_overlap(hlo_text: str, target: str = "",
     return rep
 
 
+def _zero_threshold_bytes() -> int:
+    try:
+        mb = float(os.environ.get("MXNET_TPU_GC305_MIN_MB", "8"))
+    except ValueError:
+        mb = 8.0
+    return int(mb * (1 << 20))
+
+
+def check_zero_update(dp_size: int, update_sharded: bool,
+                      grad_payload_bytes, target: str = "",
+                      min_bytes: Optional[int] = None) -> Report:
+    """GC305: a dp-replicated parameter set paying ≥ threshold MB of
+    pure-replica gradient all-reduce EVERY step while the ZeRO sharded
+    weight update is off.  The reduce-scatter → shard-local update →
+    weight all-gather form moves the same wire bytes but runs the
+    optimizer at 1/dp FLOPs and state bytes per chip with the gather
+    schedulable against other parameters' updates — leaving it off at
+    real payloads is measurable money on the table ("Automatic
+    Cross-Replica Sharding of Weight Update in Data-Parallel Training").
+    Tiny payloads (under ``MXNET_TPU_GC305_MIN_MB``, default 8 MB) are
+    not flagged: toy programs and the fixtures would drown the signal."""
+    rep = Report("graphcheck", target)
+    threshold = _zero_threshold_bytes() if min_bytes is None \
+        else int(min_bytes)
+    payload = int(grad_payload_bytes or 0)
+    if dp_size <= 1 or update_sharded or payload < threshold:
+        return rep
+    rep.add(
+        "GC305", "warning",
+        "%.1f MB of gradients all-reduce fully replicated over dp=%d "
+        "every step while the sharded weight update is off: each chip "
+        "redundantly runs the full optimizer update and holds the full "
+        "optimizer state" % (payload / 1e6, dp_size),
+        location=target,
+        fix_hint="enable the ZeRO update (ShardedTrainer(zero=True) or "
+                 "MXNET_TPU_ZERO=1): grads reduce-scatter into dp "
+                 "shards, the update runs at 1/dp FLOPs/bytes, new "
+                 "weights all-gather back — identical numerics; or "
+                 "raise MXNET_TPU_GC305_MIN_MB",
+        extra={"grad_payload_bytes": payload, "dp_size": int(dp_size)})
+    return rep
+
+
 def check_donation(donated: bool, what: str, target: str = "") -> Report:
     """GC202: the training step's state buffers (params/momenta/guard)
     must be donated or the update holds old+new copies live — 2x peak."""
@@ -688,6 +731,17 @@ def check_trainer(trainer, params, mom, aux, inputs, keys=None,
                                  target=target))
     rep.extend(check_donation(getattr(trainer, "_step_donated", True),
                               "ShardedTrainer jitted step", target=target))
+    # GC305: pure-replica grad all-reduce while the ZeRO update is off
+    grad_payload = 0
+    for n in trainer.param_names:
+        count = 1
+        for d in trainer._param_shapes.get(n, ()):
+            count *= int(d)
+        grad_payload += 4 * count
+    rep.extend(check_zero_update(
+        trainer.spec.dp_size,
+        getattr(trainer, "shard_weight_update", False),
+        grad_payload, target=target))
     # GC501: predicted peak HBM (state + batch; the costmodel's donated
     # vs undonated accounting) against the device capacity, BEFORE any
     # buffer is allocated

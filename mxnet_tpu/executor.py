@@ -147,6 +147,20 @@ def node_attrs(node, train: bool, batch_hint):
     return attrs
 
 
+def _shard_constrain_outputs(out, ann, name):
+    """Activation placement: a ``__shard__`` attr on an *op* node pins
+    the op's outputs to a mesh spec via ``with_sharding_constraint``, so
+    GSPMD anchors its propagation there instead of guessing (the
+    placement-layer analog of the reference's per-node ctx_group).  The
+    annotation grammar and the resolution both live in
+    parallel/placement.py — one grammar for params AND activations.
+    Inert (identity) unless a mesh is active (parallel.mesh
+    .set_current_mesh — ShardedTrainer arms it around its traces), so
+    single-device paths never pay for it."""
+    from .placement import activation_constraint
+    return activation_constraint(out, ann, name)
+
+
 class GraphProgram:
     """A Symbol compiled into a pure function.
 
@@ -225,6 +239,9 @@ class GraphProgram:
                 key_idx += 1
             out = node.op.fn(attrs, *ins)
             out = out if isinstance(out, tuple) else (out,)
+            ann = node.attrs.get("__shard__") if node.attrs else None
+            if ann is not None:
+                out = _shard_constrain_outputs(out, ann, node.name)
             raw[id(node)] = out
             if tap:
                 taps.extend(out[:node.op.num_visible_outputs(attrs)])

@@ -5,10 +5,20 @@ distribution stack (SURVEY.md §2.3, §5.8): ps-lite/NCCL/CUDA-P2P become XLA
 collectives over a jax.sharding.Mesh (ICI intra-slice, DCN across slices).
 
 Modules:
-* mesh.py  — mesh construction + sharding helpers (dp/tp/pp/sp axes)
-* trainer.py — sharded data-parallel train step (the kvstore('tpu') engine)
-* ring.py  — ring-attention sequence parallelism (beyond-reference)
-* pipeline.py — pipeline parallelism via shard_map micro-batching
+* mesh.py  — ONE named-axis mesh (arbitrary dp/tp/pp/sp/ep layouts via
+  MeshSpec.build) + the current-mesh thread-local
+* placement.py — the unified placement rules: ``__shard__`` grammar,
+  tp recipe, ZeRO state sharding, batch specs (NamedSharding everywhere;
+  jit/GSPMD inserts and fuses the collectives)
+* trainer.py — sharded train step (dp/tp via GSPMD + the ZeRO sharded
+  weight update: reduce-scatter → shard-local update → weight all-gather)
+* ring.py / moe.py / pipeline.py — the retained hand-written shard_map
+  kernels (ring attention, MoE dispatch, the GPipe tick schedule: the
+  programs the partitioner cannot derive), embedded in the same mesh so
+  they compose with the GSPMD axes
+* audit.py — collective accounting: per-kind AND per-axis payload bytes
+  from compiled HLO, with fused all-reduce+slice classified as the
+  reduce-scatter it is on the wire
 """
 from __future__ import annotations
 

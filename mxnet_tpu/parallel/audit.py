@@ -102,45 +102,275 @@ def _shape_bytes(type_expr):
     return total
 
 
-def collective_accounting(hlo_text):
+# ---------------------------------------------------------------------------
+# replica-group parsing + mesh-axis attribution
+# ---------------------------------------------------------------------------
+
+_GROUPS_IOTA_RE = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?")
+_GROUPS_BRACE_RE = re.compile(
+    r"replica_groups=\{(\{[\d, ]*\}(?:, *\{[\d, ]*\})*)\}")
+_PAIRS_RE = re.compile(
+    r"source_target_pairs=\{(\{[\d, ]*\}(?:, *\{[\d, ]*\})*)\}")
+_NUM_PARTITIONS_RE = re.compile(r"num_partitions=(\d+)")
+_REF_RE = re.compile(r"%([\w.\-]+)")
+
+
+def parse_replica_groups(attrs_text):
+    """``replica_groups`` from an HLO attr string, both syntaxes: the
+    explicit brace form ``{{0,4},{1,5}}`` and the iota form
+    ``[ngroups,size]<=[dims](T(perm))``.  Returns a list of id tuples or
+    None when the instruction carries no groups."""
+    m = _GROUPS_IOTA_RE.search(attrs_text)
+    if m:
+        import numpy as _np
+        ngroups, gsize = int(m.group(1)), int(m.group(2))
+        dims = [int(d) for d in m.group(3).split(",")]
+        ids = _np.arange(int(_np.prod(dims))).reshape(dims)
+        if m.group(4):
+            ids = ids.transpose([int(p) for p in m.group(4).split(",")])
+        ids = ids.reshape(ngroups, gsize)
+        return [tuple(int(x) for x in row) for row in ids]
+    m = _GROUPS_BRACE_RE.search(attrs_text)
+    if m:
+        return [tuple(int(x) for x in grp.split(",") if x.strip())
+                for grp in re.findall(r"\{([\d, ]*)\}", m.group(1))]
+    return None
+
+
+class AxisLabeler:
+    """Attribute a collective's replica groups to the mesh axis (or axis
+    combination) they span, so the audit can say which bytes are dp
+    traffic vs tp vs ep — the 'per-axis byte accounting' a composed
+    dp×tp×pp program needs to be debuggable at all."""
+
+    def __init__(self, mesh):
+        self.mesh = getattr(mesh, "mesh", mesh)   # MeshSpec or Mesh
+        self._partitions = None
+
+    def _axis_partitions(self):
+        """[(label, frozenset-of-frozensets)] for every non-empty subset
+        of size>1 axes, smallest subsets first (a dp group must label
+        'dp', not 'dp×pp-with-trivial-pp')."""
+        if self._partitions is not None:
+            return self._partitions
+        import itertools
+        import numpy as _np
+        mesh = self.mesh
+        ids = _np.vectorize(lambda d: d.id)(mesh.devices)
+        axes = list(mesh.axis_names)
+        names = [a for a in axes if mesh.shape[a] > 1]
+        parts = []
+        for r in range(1, len(names) + 1):
+            for sub in itertools.combinations(names, r):
+                perm = [i for i, a in enumerate(axes) if a not in sub] + \
+                       [i for i, a in enumerate(axes) if a in sub]
+                gsize = 1
+                for a in sub:
+                    gsize *= mesh.shape[a]
+                arr = ids.transpose(perm).reshape(-1, gsize)
+                key = frozenset(frozenset(int(x) for x in row)
+                                for row in arr)
+                parts.append(("x".join(sub), key))
+        self._partitions = parts
+        return parts
+
+    def _all_axes_label(self):
+        mesh = self.mesh
+        names = [a for a in mesh.axis_names if mesh.shape[a] > 1]
+        return "x".join(names) if names else "self"
+
+    def label_groups(self, groups):
+        if groups is None:
+            return "unmapped"
+        key = frozenset(frozenset(g) for g in groups if len(g) > 1)
+        if not key:
+            return "self"
+        for label, part in self._axis_partitions():
+            if part == key:
+                return label
+        return "unmapped"
+
+    def label_pairs(self, pairs):
+        """A collective-permute's source_target_pairs belong to the
+        smallest axis subset whose device partition keeps every pair
+        within one group (the ring axis)."""
+        if not pairs:
+            return "unmapped"
+        for label, part in self._axis_partitions():
+            if all(any(s in grp and t in grp for grp in part)
+                   for s, t in pairs):
+                return label
+        return "unmapped"
+
+    def label(self, ins):
+        groups = parse_replica_groups(ins.attrs)
+        if groups is not None:
+            if not groups or all(not g for g in groups):
+                # empty groups = every participant
+                return self._all_axes_label()
+            return self.label_groups(groups)
+        m = _PAIRS_RE.search(ins.attrs)
+        if m:
+            pairs = [tuple(int(x) for x in grp.split(","))
+                     for grp in re.findall(r"\{([\d, ]*)\}", m.group(1))
+                     if grp.strip()]
+            return self.label_pairs([p for p in pairs if len(p) == 2])
+        return "unmapped"
+
+
+def _group_size(ins, default):
+    groups = parse_replica_groups(ins.attrs)
+    if groups and groups[0]:
+        return len(groups[0])
+    return default
+
+
+def _fused_reduce_scatters(instrs_by_comp, num_partitions):
+    """The ReduceScatterCreator pattern, detected statically: an
+    ``all-reduce`` whose EVERY consumer takes a partition-id-derived
+    slice of the result (a ``dynamic-slice`` with partition-dependent
+    offsets, or a fusion consuming the full array plus ``partition-id``
+    and producing a 1/group shard).  Semantically that pair IS a
+    reduce-scatter — the TPU/GPU toolchains' ReduceScatterCreator pass
+    rewrites exactly this form into one; XLA:CPU (the dryrun backend)
+    lacks the pass and keeps it spelled out, the same way it never emits
+    async ``-start``/``-done`` pairs.  Classifying it here keeps the
+    audit describing the program's wire semantics rather than one
+    backend's pass list — the precedent set by the costmodel's
+    'pipelined' overlap classification.
+
+    Returns {(computation, name): shard_payload_bytes}."""
+    out = {}
+    for comp, instrs in instrs_by_comp.items():
+        pids = {i.name for i in instrs if i.opcode == "partition-id"}
+        if not pids:
+            continue
+        refs = {i.name: set(_REF_RE.findall(i.operands)) for i in instrs}
+        # scalar offset chains: partition-id flows through multiplies/
+        # bitcasts/lookup-table slices into the dynamic-slice offsets
+        derived = set(pids)
+        changed = True
+        while changed:
+            changed = False
+            for i in instrs:
+                if i.name in derived or i.result_bytes > 64:
+                    continue
+                if refs[i.name] & derived:
+                    derived.add(i.name)
+                    changed = True
+        users = {}
+        for i in instrs:
+            for r in refs[i.name]:
+                users.setdefault(r, []).append(i)
+        for i in instrs:
+            if i.opcode != "all-reduce":
+                continue
+            us = users.get(i.name, [])
+            if not us:
+                continue
+            g = _group_size(i, num_partitions)
+            if g <= 1:
+                continue
+            if all(u.opcode in ("dynamic-slice", "fusion")
+                   and 2 * u.result_bytes <= i.result_bytes
+                   and (refs[u.name] & derived)
+                   for u in us):
+                out[(comp, i.name)] = i.result_bytes // g
+    return out
+
+
+def collective_accounting(hlo_text, mesh=None):
     """Payload bytes + instruction count per collective kind.
 
-    Returns {kind: {"count": int, "bytes": int}} over non-fused,
-    non-async-duplicate instructions ('-start' variants counted once,
-    '-done' skipped).
+    Returns ``{kind: {"count": int, "bytes": int, ...}}`` over non-fused,
+    non-async-duplicate instructions ('-start' variants counted once via
+    their operand shapes, '-done' skipped).  Payload conventions: sync
+    ops report their result bytes, async ``-start`` their operand bytes,
+    reduce-scatter therefore the (1/group) shard.
+
+    Two refinements over raw opcode counting:
+
+    * an all-reduce in the fused all-reduce + partition-slice form (see
+      :func:`_fused_reduce_scatters`) is reported as ``reduce-scatter``
+      with shard payload, plus a ``fused_from_all_reduce`` count so the
+      reclassification is visible;
+    * with ``mesh`` given, every kind carries a ``by_axis`` breakdown
+      mapping the instruction's replica groups (or ppermute pairs) onto
+      the mesh axes — dp vs tp vs ep traffic becomes directly
+      attributable in dryrun output.
     """
+    from ..analysis.costmodel import iter_instructions
+    instrs = list(iter_instructions(hlo_text))
+    by_comp = {}
+    for ins in instrs:
+        by_comp.setdefault(ins.computation, []).append(ins)
+    m = _NUM_PARTITIONS_RE.search(hlo_text)
+    num_partitions = int(m.group(1)) if m else 1
+    fused = _fused_reduce_scatters(by_comp, num_partitions)
+    labeler = AxisLabeler(mesh) if mesh is not None else None
     out = {}
-    for line in hlo_text.splitlines():
-        m = re.match(r"\s*(?:ROOT )?%?[\w.\-]+ = (.+?) ([a-z][\w\-]*)\(",
-                     line)
-        if not m:
-            continue
-        type_expr, op = m.groups()
-        base = op[:-len("-start")] if op.endswith("-start") else op
+    for ins in instrs:
+        op = ins.opcode
+        is_start = op.endswith("-start")
+        base = op[:-len("-start")] if is_start else op
         if base not in _COLLECTIVES or op.endswith("-done"):
             continue
-        slot = out.setdefault(base, {"count": 0, "bytes": 0})
-        slot["count"] += 1
-        if op.endswith("-start"):
-            # async -start result types bundle (operand, result[, scratch])
-            # shapes.  Halving that tuple was only right for symmetric ops
-            # (all-reduce); for all-gather/reduce-scatter operand and
-            # result differ, so sum the OPERAND shapes from the call args
-            # instead — payload is what the collective is fed.
-            call = re.search(re.escape(op) + r"\((.*?)\)", line)
-            if call:
-                payload = _shape_bytes(call.group(1))
-            else:   # malformed line: fall back to the symmetric estimate
-                payload = _shape_bytes(type_expr) // 2
+        key = (ins.computation, ins.name)
+        if key in fused:
+            kind, payload = "reduce-scatter", fused[key]
+        elif is_start:
+            # async -start result types bundle (operand, result[,
+            # scratch]) shapes; the operand shapes from the call args are
+            # what the collective is fed (asymmetric all-gather/
+            # reduce-scatter fix)
+            kind, payload = base, _shape_bytes(ins.operands)
         else:
-            payload = _shape_bytes(type_expr)
+            kind, payload = base, ins.result_bytes
+        slot = out.setdefault(kind, {"count": 0, "bytes": 0})
+        slot["count"] += 1
         slot["bytes"] += payload
+        if key in fused:
+            slot["fused_from_all_reduce"] = \
+                slot.get("fused_from_all_reduce", 0) + 1
+        if labeler is not None:
+            axis = labeler.label(ins)
+            ba = slot.setdefault("by_axis", {}).setdefault(
+                axis, {"count": 0, "bytes": 0})
+            ba["count"] += 1
+            ba["bytes"] += payload
     return out
 
 
 def ring_allreduce_wire_bytes(payload_bytes, n_devices):
     """Per-device bytes on the wire for a ring all-reduce of ``payload``."""
     return 2 * (n_devices - 1) * payload_bytes // max(1, n_devices)
+
+
+def collective_wire_bytes(kind, payload_bytes, n_devices):
+    """Per-device wire bytes for one collective, per the payload
+    conventions of :func:`collective_accounting` (reduce-scatter payload
+    is the 1/n output shard; sync all-gather payload is the gathered
+    result): ring models in all cases."""
+    n = max(1, n_devices)
+    if kind == "all-reduce":
+        return ring_allreduce_wire_bytes(payload_bytes, n)
+    if kind == "reduce-scatter":
+        return (n - 1) * payload_bytes
+    if kind == "all-gather":
+        return (n - 1) * payload_bytes // n
+    return payload_bytes
+
+
+def zero_update_model_bytes(shardable_bytes, residual_bytes, dp):
+    """Analytic per-step collective PAYLOADS of the ZeRO sharded weight
+    update at dp degree ``dp`` (the audit-side model the dryrun holds
+    measurements against): the shardable grads reduce-scatter into 1/dp
+    shards, the updated weights all-gather back whole, and params with
+    no dp-divisible dim keep a plain all-reduce."""
+    return {"reduce-scatter": shardable_bytes // max(1, dp),
+            "all-gather": shardable_bytes,
+            "all-reduce": residual_bytes}
 
 
 def grad_payload_bytes(params, grad_dtype_bytes=4):
@@ -154,7 +384,8 @@ def grad_payload_bytes(params, grad_dtype_bytes=4):
     return total
 
 
-def audit_report(tag, hlo_text, n_devices, params=None, ring_n=None):
+def audit_report(tag, hlo_text, n_devices, params=None, ring_n=None,
+                 mesh=None, zero_model=None):
     """Format (and return) one accounting line comparing HLO collective
     payloads with the analytic ring model.
 
@@ -163,20 +394,44 @@ def audit_report(tag, hlo_text, n_devices, params=None, ring_n=None):
     n_devices.  Pass ``params`` only when the HLO payloads are global
     (pure-dp): with tp the post-SPMD HLO reports per-shard payloads and
     a global-params model would be ~tp x off, so the ratio is skipped.
+    ``mesh`` adds the per-axis byte breakdown (dp/tp/sp/ep/pp traffic
+    attributed from replica groups).  ``zero_model`` — the dict from
+    :func:`zero_update_model_bytes` — swaps the plain grad-payload
+    comparison for the ZeRO reduce-scatter + all-gather model.
     """
     ring_n = ring_n or n_devices
-    acct = collective_accounting(hlo_text)
+    acct = collective_accounting(hlo_text, mesh=mesh)
     parts = []
     for kind in sorted(acct):
         info = acct[kind]
-        wire = ring_allreduce_wire_bytes(info["bytes"], ring_n) \
-            if kind == "all-reduce" else info["bytes"]
-        parts.append("%s: %d ops, %.2f MB payload, %.2f MB/device on wire"
-                     % (kind, info["count"], info["bytes"] / 1e6,
-                        wire / 1e6))
+        wire = collective_wire_bytes(kind, info["bytes"], ring_n)
+        fused = info.get("fused_from_all_reduce")
+        parts.append("%s: %d ops%s, %.2f MB payload, %.2f MB/device on "
+                     "wire" % (kind, info["count"],
+                               " (%d fused ar+slice)" % fused if fused
+                               else "", info["bytes"] / 1e6, wire / 1e6))
     text = "collectives[%s, n=%d, ring=%d] " % (tag, n_devices, ring_n) + \
         ("; ".join(parts) if parts else "none")
-    if params is not None:
+    if mesh is not None:
+        by_axis = {}
+        for kind, info in acct.items():
+            for axis, slot in (info.get("by_axis") or {}).items():
+                by_axis[axis] = by_axis.get(axis, 0) + slot["bytes"]
+        if by_axis:
+            text += " | by-axis " + ", ".join(
+                "%s: %.2f MB" % (a, b / 1e6)
+                for a, b in sorted(by_axis.items()))
+    if zero_model is not None:
+        model = sum(zero_model.values())
+        measured = sum(acct.get(k, {}).get("bytes", 0)
+                       for k in zero_model)
+        text += (" | analytic ZeRO payload RS %.2f + AG %.2f + AR %.2f MB"
+                 " (measured/model = %.2f)"
+                 % (zero_model.get("reduce-scatter", 0) / 1e6,
+                    zero_model.get("all-gather", 0) / 1e6,
+                    zero_model.get("all-reduce", 0) / 1e6,
+                    measured / model if model else float("nan")))
+    elif params is not None:
         model = grad_payload_bytes(params)
         measured = acct.get("all-reduce", {}).get("bytes", 0)
         text += " | analytic grad payload %.2f MB (measured/model = %.2f)" \

@@ -23,9 +23,20 @@ __all__ = ["MeshSpec", "make_mesh", "data_parallel_mesh", "reform_mesh",
            "current_mesh", "set_current_mesh", "shard_batch", "replicate",
            "P", "describe_devices"]
 
+# mesh-axis naming conventions — MeshSpec.build infers axis ROLES from
+# these names, so a 3-axis ("dp","tp","pp") mesh wires itself into the
+# trainer (dp/tp), the pipeline (pp) and the elastic re-layout (dp
+# absorbs world-size changes) with no extra configuration
+_ROLE_AXES = ("dp", "tp", "pp", "sp", "ep")
+
 
 class MeshSpec:
-    """A mesh plus the axis layout used by the sharded trainer.
+    """ONE named-axis mesh plus the axis-role layout every parallel
+    subsystem shares.  Axis dims are arbitrary — ``build`` accepts any
+    ``{name: size}`` layout (``dp×tp×pp``, ``dp×tp×ep``, …) and GSPMD
+    composes them: params/state/activations carry ``NamedSharding``
+    annotations (parallel/placement.py) and an axis a tensor does not
+    name simply replicates over it.
 
     ``generation`` is the elastic-training incarnation counter: every
     coordinated resize (resilience/elastic.py) re-forms the mesh over
@@ -42,9 +53,37 @@ class MeshSpec:
         self.ep_axis = ep_axis
         self.generation = int(generation)
 
+    @classmethod
+    def build(cls, axes, devices=None, generation=0) -> "MeshSpec":
+        """One unified mesh from an ``{axis_name: size}`` mapping (or a
+        ``(name, size)`` sequence — insertion order is the device-major
+        order, outermost first).  Conventionally-named axes (dp/tp/pp/
+        sp/ep) are wired to their roles; other names are carried as
+        plain mesh axes reachable via ``__shard__`` annotations."""
+        items = list(axes.items()) if isinstance(axes, dict) else \
+            [(str(n), int(s)) for n, s in axes]
+        names = [n for n, _ in items]
+        if len(set(names)) != len(names):
+            raise ValueError("duplicate mesh axis names: %r" % (names,))
+        mesh = make_mesh([s for _, s in items], names, devices=devices)
+        roles = {a + "_axis": (a if a in names else None)
+                 for a in _ROLE_AXES}
+        return cls(mesh, generation=generation, **roles)
+
+    def axis_size(self, name) -> int:
+        return int(self.mesh.shape.get(name, 1)) if name else 1
+
     @property
     def dp_size(self):
-        return self.mesh.shape[self.dp_axis] if self.dp_axis else 1
+        return self.axis_size(self.dp_axis)
+
+    @property
+    def model_axes(self):
+        """Active (size > 1) non-dp role axes — what GC201 replication
+        warnings and the per-axis collective audit key on."""
+        return tuple(a for a in (self.tp_axis, self.pp_axis, self.sp_axis,
+                                 self.ep_axis)
+                     if a and self.axis_size(a) > 1)
 
     def batch_sharding(self):
         return NamedSharding(self.mesh, P(self.dp_axis))

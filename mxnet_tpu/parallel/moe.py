@@ -108,7 +108,15 @@ def moe_ffn(x, wg, w1, w2, mesh: Mesh, axis: str = "ep",
             capacity_factor: float = 1.25, activation=jax.nn.relu):
     """Sharded gated expert FFN.  x (tokens, d) is sharded over `axis`;
     experts (w1/w2 leading axis) are sharded over `axis`; wg replicated.
-    Returns (out, aux_loss); out keeps x's sharding."""
+    Returns (out, aux_loss); out keeps x's sharding.
+
+    ``mesh`` may be a Mesh or MeshSpec and may carry other axes (the
+    unified dp×tp×…×ep mesh): the shard_map — retained hand-written
+    because the dispatch/combine all_to_all pair is a schedule the
+    partitioner cannot derive from shardings — is manual only over
+    ``axis`` and so composes with the GSPMD-managed axes."""
+    from .placement import as_mesh
+    mesh = as_mesh(mesh)
     n_dev = mesh.shape[axis]
     E = wg.shape[1]
     T = x.shape[0]
@@ -145,5 +153,5 @@ def moe_ffn(x, wg, w1, w2, mesh: Mesh, axis: str = "ep",
                       bytes=4)
     from ..telemetry import perf as _perf
     _perf.maybe_attribute_fn(sharded, (x, wg, w1, w2), "moe_ffn",
-                             n_devices=n_dev)
+                             n_devices=n_dev, mesh=mesh)
     return out

@@ -40,7 +40,15 @@ def pipeline_apply(stage_fn: Callable, num_stages: int, mesh: Mesh,
     params_stacked: pytree with leading axis == num_stages (stage i's params)
     x_micro: (M, mb, ...) micro-batched input (global).
     Returns (M, mb, ...) outputs after all stages.
+
+    ``mesh`` may be a Mesh or MeshSpec and may carry other axes (the
+    unified dp×tp×pp mesh): the shard_map — retained hand-written
+    because a GPipe tick schedule is inherently MPMD-in-time and no
+    sharding annotation produces one — is manual only over ``axis`` and
+    composes with the GSPMD-managed axes.
     """
+    from .placement import as_mesh
+    mesh = as_mesh(mesh)
     M = x_micro.shape[0]
     S = num_stages
 
@@ -131,7 +139,7 @@ def pipeline_apply(stage_fn: Callable, num_stages: int, mesh: Mesh,
                       bytes=act_bytes)
     from ..telemetry import perf as _perf
     _perf.maybe_attribute_fn(mapped, (params_sharded, x_rep),
-                             "pipeline_apply", n_devices=S)
+                             "pipeline_apply", n_devices=S, mesh=mesh)
     return out
 
 
@@ -140,9 +148,10 @@ class PipelineRunner:
     layers) with stacked parameters, trainable end to end."""
 
     def __init__(self, stage_fn, num_stages, mesh, axis="pp"):
+        from .placement import as_mesh
         self.stage_fn = stage_fn
         self.num_stages = num_stages
-        self.mesh = mesh
+        self.mesh = as_mesh(mesh)
         self.axis = axis
 
     def forward(self, params_stacked, x_micro):

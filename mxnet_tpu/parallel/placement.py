@@ -1,0 +1,168 @@
+"""Unified GSPMD placement over ONE named-axis mesh.
+
+This module is the single source of truth for how tensors are placed on
+the mesh (the TensorFlow-system-paper "placement layer", PAPERS.md): the
+``__shard__`` annotation grammar, the default tensor-parallel recipe, the
+ZeRO state-sharding rule, and the batch-input specs all live here, so
+every axis — dp/tp/pp/sp/ep or any user-named axis — resolves through
+the same code path and therefore composes.  Consumers:
+
+* ``parallel.trainer.ShardedTrainer`` — params, optimizer state,
+  activations and batch inputs (jit/GSPMD inserts the collectives);
+* ``executor.GraphProgram`` — ``__shard__`` on *op* nodes becomes a
+  ``with_sharding_constraint`` on the op's outputs (activation
+  annotations), via :mod:`mxnet_tpu.placement`;
+* ``parallel.ring`` / ``parallel.moe`` / ``parallel.pipeline`` — the
+  retained ``shard_map`` kernels (ring attention, MoE dispatch, the
+  GPipe tick schedule: the three programs the partitioner cannot
+  produce) embed in the SAME mesh, so their manual axis coexists with
+  the GSPMD-managed ones.
+
+The ``__shard__`` grammar (Symbol attr, per tensor): a comma list of
+mesh-axis names or ``*`` per tensor dim, e.g. ``"tp,*"`` shards dim 0
+over ``tp``; trailing dims default to ``*``.  Unknown axis names raise;
+a named dim that does not divide by its axis extent silently downgrades
+to replicated (the annotation is a layout hint, not a shape contract).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["as_mesh", "resolve_spec", "param_sharding", "state_sharding",
+           "zero_shard_dim", "batch_sharding", "replicated", "constrain",
+           "constrain_outputs"]
+
+
+def as_mesh(mesh_or_spec) -> Mesh:
+    """Accept a jax Mesh or a :class:`~mxnet_tpu.parallel.mesh.MeshSpec`
+    everywhere a mesh is needed — kernels and helpers embed in whichever
+    the caller holds."""
+    return getattr(mesh_or_spec, "mesh", mesh_or_spec)
+
+
+def resolve_spec(ann: str, shape, mesh: Mesh, name: str = "") -> P:
+    """``__shard__`` annotation → PartitionSpec over ``mesh``.
+
+    Raises on arity overflow or unknown axis names (annotation bugs must
+    be loud — the graphcheck philosophy); downgrades non-divisible named
+    dims to replicated."""
+    dims = [None if d.strip() in ("*", "None", "") else d.strip()
+            for d in str(ann).split(",")]
+    if len(dims) > len(shape):
+        raise ValueError(
+            "__shard__=%r on %s names %d dims but the tensor has %d"
+            % (ann, name or "<tensor>", len(dims), len(shape)))
+    unknown = [d for d in dims if d is not None and d not in mesh.axis_names]
+    if unknown:
+        raise ValueError(
+            "__shard__=%r on %s names mesh axes %s not in mesh %s"
+            % (ann, name or "<tensor>", unknown, tuple(mesh.axis_names)))
+    dims += [None] * (len(shape) - len(dims))
+    dims = [d if (d is not None and shape[i] % mesh.shape[d] == 0)
+            else None for i, d in enumerate(dims)]
+    return P(*dims)
+
+
+def param_sharding(name: str, shape, mesh: Mesh,
+                   tp_axis: Optional[str] = None,
+                   ann: Optional[str] = None) -> NamedSharding:
+    """Placement for one parameter.
+
+    Explicit ``__shard__`` wins and may name ANY mesh axis.  Otherwise,
+    when a tensor-parallel axis is active, the default recipe (SURVEY
+    §2.3) shards the output channels of FC/Convolution weights and the
+    vocab dim of embeddings over ``tp_axis``; XLA propagates activation
+    shardings and inserts the collectives.  Everything else replicates
+    (over every axis — unused axes mean replication, which is how a
+    pp/ep axis coexists with dp/tp parameters)."""
+    if ann is not None:
+        return NamedSharding(mesh, resolve_spec(ann, shape, mesh, name))
+    if tp_axis is None or mesh.shape.get(tp_axis, 1) <= 1:
+        return NamedSharding(mesh, P())
+    size = mesh.shape[tp_axis]
+    if name.endswith("_weight") and len(shape) in (2, 4) \
+            and shape[0] % size == 0 and shape[0] >= size:
+        # FC (out, in) / Conv (out, in, kh, kw) / Embedding (vocab, dim):
+        # shard dim 0 (output channels / vocab rows) over tp
+        return NamedSharding(
+            mesh, P(*([tp_axis] + [None] * (len(shape) - 1))))
+    return NamedSharding(mesh, P())
+
+
+def zero_shard_dim(shape, taken, size: int) -> Optional[int]:
+    """The dim the ZeRO state shard rides on: the LARGEST free dim that
+    divides by the dp extent.  Largest — not first — because an exact
+    division of the biggest dim keeps per-shard minor dims fat: sharding
+    a conv kernel's tiny kh/kw (the old first-fit choice on
+    (out, in, kh, kw) state) leaves shards that strand memory in the
+    (8, 128) tile padding and serialize the reduce-scatter on a
+    few-element dim.  Ties break to the earliest dim (deterministic
+    layouts across processes)."""
+    best = None
+    for i, d in enumerate(shape):
+        if taken[i] is not None:
+            continue
+        if d % size == 0 and d >= size:
+            if best is None or d > shape[best]:
+                best = i
+    return best
+
+
+def state_sharding(base: NamedSharding, shape, mesh: Mesh,
+                   dp_axis: Optional[str]) -> NamedSharding:
+    """Placement for optimizer state (and the ZeRO grad/update view of
+    its parameter): the parameter's own sharding plus the dp axis over
+    :func:`zero_shard_dim`, so per-chip optimizer bytes — and, with the
+    sharded weight update, per-chip update FLOPs — scale as 1/dp."""
+    size = mesh.shape.get(dp_axis, 1) if dp_axis else 1
+    if size <= 1:
+        return base
+    dims = list(base.spec) + [None] * (len(shape) - len(base.spec))
+    i = zero_shard_dim(shape, dims, size)
+    if i is not None:
+        dims[i] = dp_axis
+    return NamedSharding(mesh, P(*dims))
+
+
+def batch_sharding(mesh: Mesh, dp_axis: Optional[str],
+                   accum: int = 1) -> NamedSharding:
+    """Input sharding for one batch tensor: dp over dim 0, or — with
+    gradient accumulation — dp over dim 1 under the unsharded micro dim
+    the in-jit scan walks."""
+    if accum > 1:
+        return NamedSharding(mesh, P(None, dp_axis))
+    return NamedSharding(mesh, P(dp_axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def constrain(x, sharding: Optional[NamedSharding]):
+    """``with_sharding_constraint`` that tolerates a None sharding (the
+    no-annotation case) so call sites stay branch-free."""
+    if sharding is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, sharding)
+
+
+def constrain_outputs(outs: Tuple, ann: str, mesh: Mesh, name: str = ""):
+    """Activation annotation: apply a ``__shard__`` constraint to every
+    op output it fits (inexact dtype, enough dims for the annotation).
+    Outputs the grammar cannot describe pass through untouched — one op
+    may emit both the annotated activation and bookkeeping scalars."""
+    n_dims = len([d for d in str(ann).split(",")])
+    fixed = []
+    for o in outs:
+        shape = getattr(o, "shape", None)
+        if shape is not None and len(shape) >= n_dims:
+            try:
+                o = constrain(o, NamedSharding(
+                    mesh, resolve_spec(ann, shape, mesh, name)))
+            except ValueError:
+                raise
+        fixed.append(o)
+    return tuple(fixed)

@@ -124,7 +124,15 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
     """Multi-device attention over sequence-sharded q/k/v.
 
     q/k/v: (B, T, H, D); T must divide by mesh.shape[axis].
-    """
+
+    ``mesh`` may be a Mesh or MeshSpec and may carry OTHER axes beyond
+    ``axis`` (the unified dp×tp×…×sp mesh): the shard_map is manual only
+    over the names its specs mention, so this kernel — retained
+    hand-written because the blockwise online-softmax ring schedule
+    beats anything the partitioner derives — embeds in the same mesh as
+    the GSPMD-managed axes and composes with them."""
+    from .placement import as_mesh
+    mesh = as_mesh(mesh)
     n = mesh.shape[axis]
     if scale is None:
         scale = float(1.0 / np.sqrt(q.shape[-1]))
@@ -156,7 +164,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
                       bytes=kv_bytes)
     from ..telemetry import perf as _perf
     _perf.maybe_attribute_fn(mapped, (q, k, v), "ring_attention",
-                             n_devices=n)
+                             n_devices=n, mesh=mesh)
     return out
 
 

@@ -25,9 +25,29 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..executor import GraphProgram
+from . import placement as _placement
 from .mesh import MeshSpec
 
-__all__ = ["ShardedTrainer", "sgd_step_fn"]
+__all__ = ["ShardedTrainer", "sgd_step_fn", "zero_enabled"]
+
+
+def zero_enabled(shard_optimizer_state: bool, zero=None) -> bool:
+    """Resolve the ZeRO sharded-weight-update knob.
+
+    Precedence: explicit ``zero=`` ctor arg > ``MXNET_TPU_ZERO`` env
+    ("1"/"0") > follow ``shard_optimizer_state`` — if you asked for
+    dp-sharded optimizer state you get the sharded update too, because
+    it is strictly better (identical numerics, 1/dp update FLOPs, the
+    grad all-reduce becomes reduce-scatter + overlapped weight
+    all-gather).  ``MXNET_TPU_ZERO=0`` reverts to storage-only sharding
+    for A/B runs."""
+    if zero is not None:
+        return bool(zero)
+    import os
+    v = os.environ.get("MXNET_TPU_ZERO")
+    if v is not None:
+        return v.strip().lower() not in ("0", "off", "false", "")
+    return bool(shard_optimizer_state)
 
 
 def _tree_sgd(params, grads, mom, lr, momentum, wd, rescale):
@@ -49,7 +69,7 @@ class ShardedTrainer:
                  wd=0.0001, loss_scale=1.0, param_dtype=None,
                  shard_optimizer_state=False, dynamic_loss_scale=False,
                  loss_scale_growth_interval=2000, nonfinite_budget=None,
-                 guard_nonfinite=True, grad_accum=1):
+                 guard_nonfinite=True, grad_accum=1, zero=None):
         self.symbol = symbol
         self.spec = spec
         self.prog = GraphProgram(symbol)
@@ -84,18 +104,26 @@ class ShardedTrainer:
         if tp is None and "tp" in spec.mesh.axis_names:
             tp = "tp"
         self.tp_axis = tp if (tp and spec.mesh.shape.get(tp, 1) > 1) else None
-        self._shard_attrs = {}
-        for node in self.prog.nodes:
-            if node.is_var and "__shard__" in node.attrs:
-                self._shard_attrs[node.name] = str(node.attrs["__shard__"])
+        from ..placement import shard_annotations
+        self._shard_attrs, self._act_shard_attrs = shard_annotations(
+            self.prog.nodes)
         self._param_shapes = None   # filled by init_state; step shardings
-        # ZeRO-style sharded optimizer state (the BIGARRAY/server-side-
+        # ZeRO-style sharded weight update (the BIGARRAY/server-side-
         # optimizer analog, kvstore_dist.h:156 + kvstore_dist_server.h:187,
-        # SURVEY §5.8): momentum shards over 'dp'; under GSPMD the weight
-        # update becomes reduce-scatter grad slice → update owned shard →
-        # all-gather new weights (cf. "Automatic Cross-Replica Sharding of
-        # Weight Update in Data-Parallel Training").
-        self.shard_optimizer_state = bool(shard_optimizer_state)
+        # SURVEY §5.8; "Automatic Cross-Replica Sharding of Weight Update
+        # in Data-Parallel Training", arXiv 2004.13336): momentum shards
+        # over 'dp' AND the update math operates on the shards — grads
+        # are constrained to the state shardings, so GSPMD reduces each
+        # replica's partial straight into the owned shard (reduce-scatter
+        # on the wire; XLA:CPU spells it as an all-reduce whose only
+        # consumers are partition-sliced — the form the TPU
+        # ReduceScatterCreator pass folds), the optimizer update runs at
+        # 1/dp FLOPs/bytes per chip, and the new weights all-gather back
+        # to their parameter sharding, schedulable against the other
+        # parameters' updates.
+        self.zero = zero_enabled(shard_optimizer_state, zero)
+        self.shard_optimizer_state = bool(shard_optimizer_state) or self.zero
+        self.shard_weight_update = self.zero and spec.dp_size > 1
         # -- resilience (resilience/guards.py): the non-finite detector and
         # the loss-scale automaton live INSIDE the jitted step; the host
         # only tracks the consecutive-bad-step budget and chaos hooks.
@@ -122,67 +150,26 @@ class ShardedTrainer:
         # so the telemetry histograms carry real measurements.
         self._attribution_done = False
 
-    # -- tensor-parallel sharding rules -----------------------------------
+    # -- placement (parallel/placement.py is the single rule source) ------
     def param_sharding(self, name: str, shape) -> NamedSharding:
-        """PartitionSpec for one parameter.
-
-        Explicit ``__shard__`` Symbol attr wins (value: comma list of mesh
-        axis names / '*' per tensor dim, e.g. ``"tp,*"`` shards dim 0 over
-        tp — the ctx_group-style per-layer annotation pattern).  Otherwise,
-        when a tp axis is active, the default recipe (SURVEY §2.3: tensor
-        parallelism via GSPMD sharding annotations) shards the output
-        channels of FC/Convolution weights and the vocab dim of embeddings;
-        XLA propagates activation shardings and inserts the collectives.
-        """
-        mesh = self.spec.mesh
-        if self.tp_axis is None:
-            return self.spec.replicated()
-        tp = self.tp_axis
-        size = mesh.shape[tp]
-        ann = self._shard_attrs.get(name)
-        if ann is not None:
-            dims = [None if d.strip() in ("*", "None", "") else d.strip()
-                    for d in ann.split(",")]
-            if len(dims) > len(shape):
-                raise ValueError(
-                    "__shard__=%r on %s names %d dims but the tensor has "
-                    "%d" % (ann, name, len(dims), len(shape)))
-            unknown = [d for d in dims
-                       if d is not None and d not in mesh.axis_names]
-            if unknown:
-                raise ValueError(
-                    "__shard__=%r on %s names mesh axes %s not in mesh %s"
-                    % (ann, name, unknown, tuple(mesh.axis_names)))
-            dims += [None] * (len(shape) - len(dims))
-            dims = [d if (d is not None and shape[i] % mesh.shape[d] == 0)
-                    else None for i, d in enumerate(dims)]
-            return NamedSharding(mesh, P(*dims))
-        if name.endswith("_weight") and len(shape) in (2, 4) \
-                and shape[0] % size == 0 and shape[0] >= size:
-            # FC (out, in) / Conv (out, in, kh, kw) / Embedding (vocab, dim):
-            # shard dim 0 (output channels / vocab rows) over tp
-            return NamedSharding(mesh, P(*([tp] + [None] * (len(shape) - 1))))
-        return self.spec.replicated()
+        """Placement for one parameter: explicit ``__shard__`` Symbol attr
+        wins (any mesh axis; the ctx_group-style per-layer annotation
+        pattern), else the default tp recipe, else replicated — see
+        :func:`~mxnet_tpu.parallel.placement.param_sharding`."""
+        return _placement.param_sharding(name, shape, self.spec.mesh,
+                                         tp_axis=self.tp_axis,
+                                         ann=self._shard_attrs.get(name))
 
     def mom_sharding(self, name: str, shape) -> NamedSharding:
-        """Sharding for one optimizer-state tensor: the param's sharding,
-        plus — with shard_optimizer_state — the first free divisible dim
-        sharded over 'dp' so per-chip state memory scales down with the
-        data-parallel degree."""
+        """Sharding for one optimizer-state tensor (and, with the ZeRO
+        update, the grad/update view of its parameter): the param's
+        sharding plus the dp axis over the largest free divisible dim
+        (:func:`~mxnet_tpu.parallel.placement.state_sharding`)."""
         base = self.param_sharding(name, shape)
         if not self.shard_optimizer_state:
             return base
-        mesh = self.spec.mesh
-        dp = self.spec.dp_axis
-        size = mesh.shape.get(dp, 1)
-        if size <= 1:
-            return base
-        dims = list(base.spec) + [None] * (len(shape) - len(base.spec))
-        for i, d in enumerate(shape):
-            if dims[i] is None and d % size == 0 and d >= size:
-                dims[i] = dp
-                break
-        return NamedSharding(mesh, P(*dims))
+        return _placement.state_sharding(base, shape, self.spec.mesh,
+                                         self.spec.dp_axis)
 
     def _param_shardings(self):
         if self._param_shapes is None:
@@ -199,10 +186,18 @@ class ShardedTrainer:
         return tuple(self.mom_sharding(n, self._param_shapes.get(n, ()))
                      for n in self.param_names)
 
+    def _arm_mesh(self):
+        """Publish this trainer's mesh as the thread's current mesh:
+        activation ``__shard__`` constraints (executor hook) resolve
+        against it at trace time, and watchdog post-mortems report it."""
+        from .mesh import set_current_mesh
+        set_current_mesh(self.spec)
+
     # -- state ------------------------------------------------------------
     def init_state(self, shapes: Dict[str, tuple], initializer=None,
                    seed=0):
         """Initialise (params, mom, aux) replicated on the mesh."""
+        self._arm_mesh()
         from ..executor import _resolve_structs
         from ..initializer import Xavier, InitDesc
         from ..ndarray.ndarray import NDArray
@@ -294,6 +289,26 @@ class ShardedTrainer:
 
         accum = self.grad_accum
         num_rng = prog.num_rng
+        # ZeRO sharded weight update: constraining every gradient to its
+        # optimizer-state sharding makes GSPMD reduce each replica's
+        # partial straight into the owned dp shard (reduce-scatter on the
+        # wire) and run the whole update chain below — momentum, weight
+        # decay, the non-finite select — at shard shapes (1/dp FLOPs and
+        # bytes per chip); the final constraint back to the parameter
+        # sharding is the weight all-gather, one per parameter, each
+        # independent of every other parameter's update so the scheduler
+        # can overlap it (the PR-9 static instrument classifies them
+        # pipelined).  Params with no dp-divisible free dim keep their
+        # plain all-reduce — GC305 polices whether those bytes matter.
+        zero = self.shard_weight_update
+        zspecs = self._mom_shardings() if zero else None
+        pspecs = self._param_shardings() if zero else None
+
+        def shard_grads(grads):
+            if not zero:
+                return grads
+            return tuple(_placement.constrain(g, s)
+                         for g, s in zip(grads, zspecs))
 
         def step_fn(params, mom, aux, inputs, keys, guard):
             scale, good = guard
@@ -301,6 +316,7 @@ class ShardedTrainer:
                 (_, (loss, (outs, new_aux))), grads = jax.value_and_grad(
                     scaled_loss_fn, argnums=0, has_aux=True)(
                         params, inputs, aux, keys, scale)
+                grads = shard_grads(grads)
             else:
                 # gradient accumulation: inputs carry a leading micro
                 # dim (accum, micro_bs, ...); scan folds the micro
@@ -319,11 +335,16 @@ class ShardedTrainer:
                     (_, (loss_i, (_outs, aux_n))), g = jax.value_and_grad(
                         scaled_loss_fn, argnums=0, has_aux=True)(
                             params, micro_inputs, aux_c, keys_i, scale)
+                    # with ZeRO each micro's partial reduces straight
+                    # into the dp shard, so the f32 accumulator itself
+                    # lives sharded (1/dp accumulator HBM) and only ONE
+                    # weight all-gather pays for all `accum` reductions
                     grads_c = tuple(gc + gi.astype(jnp.float32)
-                                    for gc, gi in zip(grads_c, g))
+                                    for gc, gi in zip(grads_c,
+                                                      shard_grads(g)))
                     return (grads_c, aux_n, loss_c + loss_i, i + 1), None
-                init = (tuple(jnp.zeros(p.shape, jnp.float32)
-                              for p in params),
+                init = (shard_grads(tuple(jnp.zeros(p.shape, jnp.float32)
+                                          for p in params)),
                         aux, jnp.float32(0.0), jnp.int32(0))
                 (grads, new_aux, loss, _), _ = jax.lax.scan(
                     micro_step, init, inputs)
@@ -332,6 +353,11 @@ class ShardedTrainer:
             ok = _guards.all_finite(loss, grads)
             new_params = tuple(jnp.where(ok, np_, p)
                                for np_, p in zip(new_params, params))
+            if zero:
+                # the weight all-gather: shard-updated params return to
+                # their parameter sharding (replicated over dp)
+                new_params = tuple(_placement.constrain(np_, s)
+                                   for np_, s in zip(new_params, pspecs))
             new_mom = tuple(jnp.where(ok, nm, m)
                             for nm, m in zip(new_mom, mom))
             new_aux = tuple(jnp.where(ok, na, a)
@@ -358,6 +384,7 @@ class ShardedTrainer:
         return self.spec.batch_sharding()
 
     def _build_step(self, donate=True):
+        self._arm_mesh()
         step_fn = self._make_step_fn()
         rep = self.spec.replicated()
         bat = self._batch_in_sharding()
@@ -399,6 +426,7 @@ class ShardedTrainer:
             from jax.experimental.layout import (
                 DeviceLocalLayout as Layout, Layout as Format)
 
+        self._arm_mesh()
         step_fn = self._make_step_fn()
         rep = self.spec.replicated()
         bat = self._batch_in_sharding()
@@ -437,7 +465,8 @@ class ShardedTrainer:
             compiled,
             "ShardedTrainer.auto_layout(%s)" % (self.symbol.name
                                                 or "symbol"),
-            n_devices=self.spec.mesh.size, ring_n=self.spec.dp_size)
+            n_devices=self.spec.mesh.size, ring_n=self.spec.dp_size,
+            mesh=self.spec.mesh)
         fmts = getattr(compiled, "input_formats",
                        None) or compiled.input_layouts
         p_fmt, m_fmt, a_fmt = fmts[0][:3]
@@ -518,6 +547,7 @@ class ShardedTrainer:
         from ..resilience import watchdog as _watchdog
         from ..telemetry import memory as _memory
         from .audit import record_collective
+        self._arm_mesh()
         remat = backward_mirror_policy()
         if self._step is None or remat != self._built_remat:
             self._built_remat = remat
@@ -583,8 +613,24 @@ class ShardedTrainer:
                 if self.guard_nonfinite:
                     self._note_step_result(bool(ok), loss)
         _tel.count("train.steps")
-        record_collective("psum", "ShardedTrainer.step dp grad all-reduce",
-                          step=self._step_count, bytes=self._grad_bytes())
+        if self.shard_weight_update:
+            shardable, residual = self._zero_split_bytes()
+            record_collective(
+                "reduce-scatter", "ShardedTrainer.step ZeRO grad "
+                "reduce-scatter", step=self._step_count, bytes=shardable)
+            record_collective(
+                "all-gather", "ShardedTrainer.step ZeRO weight all-gather",
+                step=self._step_count, bytes=shardable)
+            if residual:
+                record_collective(
+                    "psum", "ShardedTrainer.step residual grad all-reduce "
+                    "(no dp-divisible dim)", step=self._step_count,
+                    bytes=residual)
+        else:
+            record_collective("psum",
+                              "ShardedTrainer.step dp grad all-reduce",
+                              step=self._step_count,
+                              bytes=self._grad_bytes())
         _watchdog.heartbeat(self._step_count)
         _tel.window_tick()
         if _memory.enabled():
@@ -628,7 +674,28 @@ class ShardedTrainer:
         _perf.maybe_attribute(
             compiled,
             "ShardedTrainer.step(%s)" % (self.symbol.name or "symbol"),
-            n_devices=self.spec.mesh.size, ring_n=self.spec.dp_size)
+            n_devices=self.spec.mesh.size, ring_n=self.spec.dp_size,
+            mesh=self.spec.mesh)
+
+    def _zero_split_bytes(self):
+        """Split the f32 grad payload into (dp-shardable, residual)
+        bytes under the ZeRO update: shardable params reduce-scatter +
+        all-gather, the rest (no dp-divisible free dim) keep a plain
+        all-reduce.  Feeds the collective telemetry records and the
+        audit's analytic model."""
+        shapes = self._param_shapes or {}
+        dp = self.spec.dp_size
+        shardable = residual = 0
+        for n in self.param_names:
+            shape = shapes.get(n, ())
+            nbytes = 4 * int(np.prod(shape)) if shape else 4
+            base = self.param_sharding(n, shape)
+            dims = list(base.spec) + [None] * (len(shape) - len(base.spec))
+            if _placement.zero_shard_dim(shape, dims, dp) is not None:
+                shardable += nbytes
+            else:
+                residual += nbytes
+        return shardable, residual
 
     def _grad_bytes(self):
         """Analytic dp all-reduce payload (f32 grads), cached — feeds the

@@ -1,18 +1,28 @@
-"""ctx_group model parallelism — device placement by graph segmentation.
+"""The placement layer: graph annotations → device/mesh placement.
 
-Reference: src/executor/graph_executor.cc:313-436 (AssignContext →
-nnvm PlaceDevice pass → `_CrossDeviceCopy` insertion) and the
-``group2ctx`` argument of Symbol.bind (python/mxnet/symbol.py).
+Two placement regimes share this façade (the TensorFlow system paper's
+placement-layer split, PAPERS.md):
 
-TPU-native stance: one XLA program is SPMD — it cannot pin individual
-ops to different devices (that is MPMD).  So the `ctx_group` attribute
-is honoured the way the reference's executor honours it structurally:
-the graph is *partitioned* at group boundaries into segments, each
-segment is jitted and committed to its group's device, and boundary
-values are `jax.device_put` across devices — the exact analog of the
-reference inserting `_CrossDeviceCopy` nodes between subgraphs.
-Backward chains per-segment `jax.vjp` in reverse order, transferring
-cotangents across the same boundaries.
+* **SPMD (the default)** — ``__shard__`` attrs on variables and ops
+  resolve to ``NamedSharding`` over the ONE named-axis mesh
+  (parallel/mesh.py); jit/GSPMD inserts and fuses the collectives.  The
+  grammar and rules live in :mod:`mxnet_tpu.parallel.placement` and are
+  re-exported here (``resolve_spec``/``param_sharding``/
+  ``state_sharding``); :func:`shard_annotations` collects a graph's
+  annotations and :func:`activation_constraint` is the executor's hook
+  that turns an op-level ``__shard__`` into a
+  ``with_sharding_constraint`` on its outputs.
+
+* **MPMD (ctx_group)** — the reference's model parallelism by graph
+  segmentation (src/executor/graph_executor.cc:313-436: AssignContext →
+  nnvm PlaceDevice pass → ``_CrossDeviceCopy`` insertion; the
+  ``group2ctx`` argument of Symbol.bind).  One XLA program is SPMD — it
+  cannot pin individual ops to different devices — so ``ctx_group`` is
+  honoured structurally: the graph is *partitioned* at group boundaries
+  into segments, each segment jitted and committed to its group's
+  device, boundary values ``jax.device_put`` across devices (the
+  ``_CrossDeviceCopy`` analog).  Backward chains per-segment ``jax.vjp``
+  in reverse order, transferring cotangents across the same boundaries.
 """
 from __future__ import annotations
 
@@ -23,9 +33,39 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["SegmentedProgram", "group_devices"]
+from .parallel.placement import (as_mesh, param_sharding, resolve_spec,
+                                 state_sharding)
+
+__all__ = ["SegmentedProgram", "group_devices", "shard_annotations",
+           "activation_constraint", "resolve_spec", "param_sharding",
+           "state_sharding", "as_mesh"]
 
 _GROUP_KEYS = ("ctx_group", "__ctx_group__")
+
+
+def shard_annotations(nodes) -> Tuple[Dict[str, str], Dict[str, str]]:
+    """Collect ``__shard__`` annotations from a node list (e.g.
+    ``GraphProgram.nodes``): ``(variables, ops)`` name→annotation maps —
+    variables place parameters, ops place activations."""
+    var_anns, op_anns = {}, {}
+    for node in nodes:
+        ann = node.attrs.get("__shard__") if node.attrs else None
+        if ann is None:
+            continue
+        (var_anns if node.is_var else op_anns)[node.name] = str(ann)
+    return var_anns, op_anns
+
+
+def activation_constraint(out, ann, name: str = ""):
+    """Executor hook: pin an op's outputs to the current mesh per its
+    ``__shard__`` annotation.  Identity when no mesh is active (the
+    single-device paths), so the hook costs nothing there."""
+    from .parallel import placement as _pl
+    from .parallel.mesh import current_mesh
+    spec = current_mesh()
+    if spec is None:
+        return out
+    return _pl.constrain_outputs(out, ann, spec.mesh, name)
 
 
 def _node_group(node) -> Optional[str]:

@@ -175,8 +175,16 @@ class AttributionReport:
         coll = a.get("collectives") or {}
         for kind in sorted(coll):
             info = coll[kind]
-            lines.append("collective %-20s %3d ops  %.2f MB payload"
-                         % (kind, info["count"], info["bytes"] / 1e6))
+            fused = info.get("fused_from_all_reduce")
+            lines.append("collective %-20s %3d ops  %.2f MB payload%s"
+                         % (kind, info["count"], info["bytes"] / 1e6,
+                            "  (%d fused ar+slice)" % fused if fused
+                            else ""))
+        by_axis = a.get("collectives_by_axis") or {}
+        if by_axis:
+            lines.append("collective bytes by axis: " + ", ".join(
+                "%s %.2f MB" % (ax, b / 1e6)
+                for ax, b in sorted(by_axis.items())))
         ov = d.get("overlap", {})
         if ov.get("overlap_pct") is not None:
             lines.append("collective/compute overlap: %.1f%% of %.2f MB "
@@ -277,6 +285,7 @@ def attribute_compiled(compiled, name: str, n_devices: int = 1,
                        host_s: Optional[float] = None,
                        device_s: Optional[float] = None,
                        hlo_text: Optional[str] = None,
+                       mesh=None,
                        extra: Optional[Dict] = None) -> AttributionReport:
     """Build the attribution report for one compiled program.
 
@@ -284,8 +293,10 @@ def attribute_compiled(compiled, name: str, n_devices: int = 1,
     the telemetry ``train.step_seconds`` histogram is consulted (armed
     runs), else the report is static-only.  ``ring_n`` is the all-reduce
     replica-group extent (the dp degree on dp×tp meshes) for the wire
-    model.  ``hlo_text`` skips the ``as_text()`` call when the caller
-    already has the dump."""
+    model.  ``mesh`` (a Mesh or MeshSpec) adds the per-axis collective
+    byte breakdown to the report's collective section — replica traffic
+    becomes directly attributable to dp/tp/sp/ep/pp.  ``hlo_text`` skips
+    the ``as_text()`` call when the caller already has the dump."""
     from ..analysis import costmodel
     from ..parallel import audit
 
@@ -318,13 +329,17 @@ def attribute_compiled(compiled, name: str, n_devices: int = 1,
     if measured_mem:
         memory_section["measured"] = measured_mem
     _memory.note_program(name, breakdown=mem_compiled or None)
-    acct = audit.collective_accounting(hlo_text)
+    acct = audit.collective_accounting(
+        hlo_text, mesh=getattr(mesh, "mesh", mesh))
     wire = 0
     for kind, info in acct.items():
-        if kind == "all-reduce":
-            wire += audit.ring_allreduce_wire_bytes(info["bytes"], ring_n)
-        else:
-            wire += info["bytes"]
+        wire += audit.collective_wire_bytes(kind, info["bytes"], ring_n)
+    # per-axis payload rollup (dp vs tp vs ep ... traffic) when the mesh
+    # is known — the report-level face of the audit's by_axis accounting
+    by_axis: Dict[str, int] = {}
+    for info in acct.values():
+        for axis, slot in (info.get("by_axis") or {}).items():
+            by_axis[axis] = by_axis.get(axis, 0) + int(slot["bytes"])
     overlap = costmodel.collective_compute_overlap(hlo_text)
 
     cost = _cost_analysis(compiled)
@@ -387,6 +402,7 @@ def attribute_compiled(compiled, name: str, n_devices: int = 1,
             "bytes_by_dtype": dtype_split,
             "top_contributors": costmodel.top_contributors(per_class),
             "collectives": acct,
+            "collectives_by_axis": by_axis,
             "collective_wire_bytes": int(wire),
         },
         "hlo_cost": hlo_cost,
@@ -547,6 +563,12 @@ def phases_block(report: AttributionReport,
         or (mem.get("predicted") or {}).get("peak_bytes")
     if peak:
         out["peak_hbm_bytes"] = int(peak)
+    wire = d.get("analytic", {}).get("collective_wire_bytes")
+    if wire is not None:
+        # per-device wire bytes per step: recorded in the ledger extras
+        # (ungated, like peak_hbm_bytes) so wire-traffic trends are
+        # tracked without an improvement ever reading as a regression
+        out["collective_bytes_per_step"] = int(wire)
     if report_path:
         out["report"] = report_path
     return out

@@ -762,6 +762,53 @@ def test_gc304_tiny_payload_not_flagged():
     assert _rules(rep) == ["GC304"]
 
 
+# ---------------------------------------------------------------------------
+# GC305: pure-replica grad all-reduce while the ZeRO update is off
+# ---------------------------------------------------------------------------
+
+def test_gc305_seeded_replicated_update_at_payload():
+    rep = graphcheck.check_zero_update(
+        dp_size=8, update_sharded=False,
+        grad_payload_bytes=45 << 20, target="toy")
+    assert _rules(rep) == ["GC305"]
+    (f,) = list(rep)
+    assert f.severity == "warning"
+    assert f.extra["dp_size"] == 8
+    assert "MXNET_TPU_ZERO" in f.fix_hint
+
+
+def test_gc305_clean_cases():
+    # sharded update on -> clean at any payload
+    rep = graphcheck.check_zero_update(8, True, 45 << 20, target="toy")
+    assert _rules(rep) == []
+    # dp=1: nothing is replicated, clean
+    assert _rules(graphcheck.check_zero_update(1, False, 45 << 20)) == []
+    # tiny payload under the default 8 MB floor: clean
+    assert _rules(graphcheck.check_zero_update(8, False, 1 << 20)) == []
+    # explicit floor override flags it again
+    rep = graphcheck.check_zero_update(8, False, 1 << 20, min_bytes=1)
+    assert _rules(rep) == ["GC305"]
+
+
+def test_gc305_wired_into_check_trainer(monkeypatch):
+    """check_trainer (the MXNET_TPU_PREFLIGHT=1 path) carries the rule:
+    a dp trainer over a real payload warns unless the sharded update is
+    on."""
+    monkeypatch.setenv("MXNET_TPU_GC305_MIN_MB", "0.001")
+    trainer, (params, mom, aux) = _toy_trainer()
+    inputs = {"data": jax.ShapeDtypeStruct((8, 32), jnp.float32),
+              "softmax_label": jax.ShapeDtypeStruct((8,), jnp.float32)}
+    rep, _ = graphcheck.check_trainer(trainer, params, mom, aux, inputs)
+    assert "GC305" in _rules(rep)
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+    trainer2 = ShardedTrainer(trainer.symbol, trainer.spec, lr=0.1,
+                              zero=True)
+    p2, m2, a2 = trainer2.init_state(
+        {"data": (8, 32), "softmax_label": (8,)})
+    rep2, _ = graphcheck.check_trainer(trainer2, p2, m2, a2, inputs)
+    assert "GC305" not in _rules(rep2)
+
+
 def test_gc304_clean_on_ring_attention_program():
     """The double-buffered ring schedule (r6) must never flag: every
     ppermute has the block's attention dots to hide behind — even with

@@ -56,6 +56,100 @@ def test_ring_wire_model():
     assert ring_allreduce_wire_bytes(1000, 1) == 0
 
 
+_FUSED_RS_HLO = """
+ENTRY %main (p0: f32[64,32]) -> f32[8,32] {
+  %p0 = f32[64,32]{1,0} parameter(0)
+  %all-reduce = f32[64,32]{1,0} all-reduce(f32[64,32]{1,0} %p0), replica_groups=[1,8]<=[8], use_global_device_ids=true, to_apply=%add.clone
+  %partition-id = u32[] partition-id()
+  %convert = s32[] convert(u32[] %partition-id)
+  %multiply = s32[] multiply(s32[] %convert, s32[] %c8)
+  ROOT %dynamic-slice = f32[8,32]{1,0} dynamic-slice(f32[64,32]{1,0} %all-reduce, s32[] %multiply, s32[] %c0), dynamic_slice_sizes={8,32}
+}
+"""
+
+# same shape but the slice offset is a constant — NOT partition-derived,
+# so the all-reduce really is a replica all-reduce and must stay one
+_PLAIN_AR_HLO = """
+ENTRY %main (p0: f32[64,32]) -> f32[8,32] {
+  %p0 = f32[64,32]{1,0} parameter(0)
+  %all-reduce = f32[64,32]{1,0} all-reduce(f32[64,32]{1,0} %p0), replica_groups=[1,8]<=[8], use_global_device_ids=true, to_apply=%add.clone
+  ROOT %dynamic-slice = f32[8,32]{1,0} dynamic-slice(f32[64,32]{1,0} %all-reduce, s32[] %c8, s32[] %c0), dynamic_slice_sizes={8,32}
+}
+"""
+
+
+def test_fused_allreduce_slice_classified_reduce_scatter():
+    """The ReduceScatterCreator pattern — an all-reduce whose every
+    consumer takes a partition-id-derived slice — is accounted as the
+    reduce-scatter it is on the wire (shard payload), with the
+    reclassification visible via fused_from_all_reduce."""
+    acct = collective_accounting(_FUSED_RS_HLO)
+    assert "all-reduce" not in acct
+    rs = acct["reduce-scatter"]
+    assert rs["count"] == 1 and rs["fused_from_all_reduce"] == 1
+    assert rs["bytes"] == 64 * 32 * 4 // 8      # the 1/8 shard
+
+
+def test_constant_slice_of_allreduce_stays_allreduce():
+    acct = collective_accounting(_PLAIN_AR_HLO)
+    assert "reduce-scatter" not in acct
+    assert acct["all-reduce"]["bytes"] == 64 * 32 * 4
+
+
+def test_replica_groups_parsing_both_syntaxes():
+    from mxnet_tpu.parallel.audit import parse_replica_groups
+    assert parse_replica_groups("replica_groups={{0,4},{1,5}}, x=y") == \
+        [(0, 4), (1, 5)]
+    assert parse_replica_groups("replica_groups=[1,8]<=[8]") == \
+        [tuple(range(8))]
+    # iota with reshape+transpose: [4,2]<=[2,4]T(1,0) pairs stride-4 ids
+    assert parse_replica_groups("replica_groups=[4,2]<=[2,4]T(1,0)") == \
+        [(0, 4), (1, 5), (2, 6), (3, 7)]
+    assert parse_replica_groups("channel_id=1") is None
+
+
+def test_by_axis_attribution_on_dp_tp_mesh():
+    """Replica groups map back to the mesh axes they span: dp groups
+    label 'dp', tp groups 'tp', whole-mesh 'dpxtp', ppermute rings via
+    their source-target pairs."""
+    _need_devices(4)
+    from mxnet_tpu.parallel.audit import AxisLabeler
+    mesh = MeshSpec(make_mesh((2, 2), ("dp", "tp")))  # ids [[0,1],[2,3]]
+    lab = AxisLabeler(mesh)
+    assert lab.label_groups([(0, 2), (1, 3)]) == "dp"
+    assert lab.label_groups([(0, 1), (2, 3)]) == "tp"
+    assert lab.label_groups([(0, 1, 2, 3)]) == "dpxtp"
+    assert lab.label_groups([(0, 3)]) == "unmapped"
+    assert lab.label_groups([(0,), (1,)]) == "self"
+    assert lab.label_pairs([(0, 2), (2, 0)]) == "dp"
+    assert lab.label_pairs([(0, 1), (1, 0), (2, 3), (3, 2)]) == "tp"
+    # accounting end: synthetic module over this mesh
+    hlo = "\n".join([
+        "ENTRY %main (p0: f32[16]) -> f32[16] {",
+        "  %ar1 = f32[16]{0} all-reduce(f32[16]{0} %p0), "
+        "replica_groups={{0,2},{1,3}}",
+        "  ROOT %ar2 = f32[16]{0} all-reduce(f32[16]{0} %ar1), "
+        "replica_groups={{0,1},{2,3}}",
+        "}"])
+    acct = collective_accounting(hlo, mesh=mesh)
+    assert acct["all-reduce"]["by_axis"]["dp"]["bytes"] == 64
+    assert acct["all-reduce"]["by_axis"]["tp"]["bytes"] == 64
+
+
+def test_collective_wire_models():
+    from mxnet_tpu.parallel.audit import (collective_wire_bytes,
+                                          zero_update_model_bytes)
+    assert collective_wire_bytes("all-reduce", 1000, 8) == 2 * 7 * 1000 // 8
+    # reduce-scatter payload is the output shard: (n-1) hops of it
+    assert collective_wire_bytes("reduce-scatter", 125, 8) == 7 * 125
+    # all-gather payload is the gathered result: (n-1)/n of it on wire
+    assert collective_wire_bytes("all-gather", 1000, 8) == 7 * 1000 // 8
+    assert collective_wire_bytes("collective-permute", 42, 8) == 42
+    m = zero_update_model_bytes(8000, 30, 8)
+    assert m == {"reduce-scatter": 1000, "all-gather": 8000,
+                 "all-reduce": 30}
+
+
 def test_async_start_counts_operand_shapes_only():
     """-start accounting (audit.py): all-gather/reduce-scatter are
     asymmetric — halving the (operand, result) tuple overstated the

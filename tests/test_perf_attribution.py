@@ -495,6 +495,61 @@ def test_benchwatch_append_and_extract(tmp_path):
     assert bw.main(["check", "--ledger", ledger]) == 0
 
 
+def test_benchwatch_collective_extras_ungated(tmp_path):
+    """phases.collective_bytes_per_step rides the ledger's extra block
+    (ungated, like peak_hbm_bytes): a wire-bytes IMPROVEMENT — the ZeRO
+    78->39 MB-shaped drop — must never read as a regression."""
+    bw = _load_tool("benchwatch")
+    doc = {"metric": "m", "value": 100.0,
+           "phases": {"peak_hbm_bytes": 1000,
+                      "collective_bytes_per_step": 78_000_000},
+           "transformer": {"metric": "t", "value": 5.0,
+                           "phases": {"collective_bytes_per_step": 50}}}
+    extra = bw.extract_extra(doc)
+    assert extra["collective_bytes_per_step"] == 78_000_000
+    assert extra["peak_hbm_bytes"] == 1000
+    assert extra["transformer_collective_bytes_per_step"] == 50
+    ledger = str(tmp_path / "l.jsonl")
+    wires = (78_000_000, 78_100_000, 78_050_000, 39_000_000)
+    for v, wire in zip((100.0, 100.5, 99.8, 100.2), wires):
+        bw.append_entry(ledger, {"m": v},
+                        extra={"collective_bytes_per_step": wire})
+    entries = bw.read_ledger(ledger)
+    ok, results = bw.check_ledger(entries)
+    assert ok, results
+    # the wire series is recorded (visible to `show`/trend tooling) but
+    # never enters the gated metric set
+    assert "collective_bytes_per_step" not in results
+    assert entries[-1]["extra"]["collective_bytes_per_step"] == 39_000_000
+
+
+def test_phases_block_and_report_carry_collective_bytes():
+    """bench phases block exposes the per-step wire bytes; multi-device
+    programs attribute them per mesh axis in the report."""
+    c = jax.jit(lambda a, b: a @ b).lower(
+        jnp.ones((32, 32), jnp.float32),
+        jnp.ones((32, 32), jnp.float32)).compile()
+    rep = perf.attribute_compiled(c, "bench.toy", measured_step_s=0.001)
+    block = perf.phases_block(rep)
+    assert block["collective_bytes_per_step"] == 0   # single-chip toy
+
+    if len(jax.devices()) >= 4:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from mxnet_tpu.parallel.mesh import MeshSpec, make_mesh
+        spec = MeshSpec(make_mesh((4,), ("dp",)))
+        bat = NamedSharding(spec.mesh, P("dp"))
+        rep_s = spec.replicated()
+        cd = jax.jit(lambda x: jnp.sum(x, axis=0),
+                     in_shardings=bat, out_shardings=rep_s).lower(
+            jnp.ones((8, 128), jnp.float32)).compile()
+        r = perf.attribute_compiled(cd, "dp.toy", n_devices=4,
+                                    mesh=spec.mesh)
+        d = r.to_dict()["analytic"]
+        assert d["collectives_by_axis"].get("dp", 0) > 0
+        assert perf.phases_block(r)["collective_bytes_per_step"] > 0
+        assert "collective bytes by axis" in r.pretty()
+
+
 def test_benchwatch_cli_regression_exit_code(tmp_path):
     bw = _load_tool("benchwatch")
     ledger = str(tmp_path / "ledger.jsonl")

@@ -1,0 +1,228 @@
+"""Unified GSPMD placement: ONE named-axis mesh with arbitrary axis dims
+(MeshSpec.build), the shared ``__shard__`` grammar for params AND
+activations (parallel/placement.py + the mxnet_tpu.placement façade),
+3-axis composition through ShardedTrainer, the retained shard_map
+kernels embedded in the same mesh, and the elastic reform of a
+multi-axis mesh."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import mxnet_tpu as mx
+from mxnet_tpu.parallel import placement
+from mxnet_tpu.parallel.mesh import MeshSpec, make_mesh, reform_mesh
+from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+
+def _need_devices(n):
+    if len(jax.devices()) < n:
+        pytest.skip("needs %d devices" % n)
+
+
+# ---------------------------------------------------------------------------
+# MeshSpec.build: arbitrary named-axis layouts with role inference
+# ---------------------------------------------------------------------------
+
+def test_meshspec_build_roles_and_sizes():
+    _need_devices(8)
+    spec = MeshSpec.build({"dp": 2, "tp": 2, "pp": 2})
+    assert tuple(spec.mesh.axis_names) == ("dp", "tp", "pp")
+    assert (spec.dp_axis, spec.tp_axis, spec.pp_axis) == ("dp", "tp", "pp")
+    assert spec.ep_axis is None and spec.sp_axis is None
+    assert spec.axis_size("dp") == 2 and spec.axis_size("missing") == 1
+    assert spec.dp_size == 2
+    assert spec.model_axes == ("tp", "pp")
+    # trivial axes keep the name present but drop out of model_axes
+    spec1 = MeshSpec.build({"dp": 8, "tp": 1})
+    assert spec1.model_axes == () and spec1.dp_size == 8
+    # custom axis names ride along, reachable via __shard__
+    spec_c = MeshSpec.build([("dp", 2), ("banks", 4)])
+    assert spec_c.mesh.shape["banks"] == 4 and spec_c.tp_axis is None
+    with pytest.raises(ValueError):
+        MeshSpec.build([("dp", 2), ("dp", 2)])
+
+
+def test_reform_mesh_keeps_non_dp_axes_of_unified_mesh():
+    _need_devices(8)
+    spec = MeshSpec.build({"dp": 2, "tp": 2, "ep": 2}, generation=3)
+    out = reform_mesh(spec)
+    assert out.generation == 4
+    assert dict(out.mesh.shape) == {"dp": 2, "tp": 2, "ep": 2}
+    assert (out.tp_axis, out.ep_axis) == ("tp", "ep")
+
+
+# ---------------------------------------------------------------------------
+# the __shard__ grammar (one resolver for params and activations)
+# ---------------------------------------------------------------------------
+
+def test_resolve_spec_grammar():
+    _need_devices(4)
+    mesh = make_mesh((2, 2), ("dp", "tp"))
+    assert placement.resolve_spec("tp,*", (8, 6), mesh) == P("tp", None)
+    # trailing dims default to replicated
+    assert placement.resolve_spec("tp", (8, 6, 4), mesh) == \
+        P("tp", None, None)
+    # non-divisible named dim downgrades to replicated, silently
+    assert placement.resolve_spec("tp,dp", (7, 6), mesh) == P(None, "dp")
+    with pytest.raises(ValueError):
+        placement.resolve_spec("tp,dp,tp", (8, 6), mesh)     # arity
+    with pytest.raises(ValueError):
+        placement.resolve_spec("nope", (8, 6), mesh)         # unknown axis
+
+
+def test_param_sharding_any_axis_annotation():
+    """__shard__ may name ANY mesh axis — not just tp — which is what
+    lets one annotated model run on every layout of the unified mesh."""
+    _need_devices(8)
+    spec = MeshSpec.build({"dp": 2, "tp": 2, "ep": 2})
+    s = placement.param_sharding("w", (8, 6), spec.mesh, tp_axis="tp",
+                                 ann="ep,*")
+    assert tuple(s.spec) == ("ep", None)
+    # no annotation + no tp: replicated over every axis
+    s = placement.param_sharding("w", (8, 6), spec.mesh, tp_axis=None)
+    assert tuple(s.spec) == ()
+
+
+def test_activation_shard_constraint_applies_in_step():
+    """An op-level __shard__ becomes a with_sharding_constraint on the
+    op's outputs inside the trainer's traced step (the executor hook,
+    armed by the trainer's current mesh) — and leaves numerics alone."""
+    _need_devices(4)
+
+    def net(annotate):
+        data = mx.sym.Variable("data")
+        attr = {"__shard__": "dp"} if annotate else None
+        h = mx.sym.FullyConnected(data, name="fc1", num_hidden=16,
+                                  attr=attr)
+        h = mx.sym.Activation(h, act_type="relu")
+        h = mx.sym.FullyConnected(h, name="fc2", num_hidden=8)
+        return mx.sym.SoftmaxOutput(h, name="softmax")
+
+    spec = MeshSpec(make_mesh((4,), ("dp",)))
+    rs = np.random.RandomState(0)
+    feed = {"data": rs.rand(8, 12).astype(np.float32),
+            "softmax_label": rs.randint(0, 8, 8).astype(np.float32)}
+    outs = []
+    for annotate in (True, False):
+        tr = ShardedTrainer(net(annotate), spec, lr=0.1)
+        assert bool(tr._act_shard_attrs) == annotate
+        params, mom, aux = tr.init_state(
+            {"data": (8, 12), "softmax_label": (8,)}, seed=1)
+        params, mom, aux, loss = tr.step(params, mom, aux, feed)
+        outs.append([np.asarray(p) for p in params])
+    for a, b in zip(*outs):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+    # the constraint really traced in: the jaxpr carries a
+    # sharding_constraint over the annotated activation
+    tr = ShardedTrainer(net(True), spec, lr=0.1)
+    params, mom, aux = tr.init_state(
+        {"data": (8, 12), "softmax_label": (8,)}, seed=1)
+    tr._arm_mesh()
+    sds = {n: jax.ShapeDtypeStruct(np.asarray(v).shape, jnp.float32)
+           for n, v in feed.items()}
+    jaxpr = jax.make_jaxpr(tr._make_step_fn())(
+        params, mom, aux, sds, tr._keys(), tr._guard_arrays())
+    assert "sharding_constraint" in str(jaxpr)
+
+
+def test_activation_constraint_inert_without_mesh():
+    """The executor hook is identity when no mesh is active — the
+    single-device Module/Executor paths never pay for annotations."""
+    from mxnet_tpu.parallel.mesh import set_current_mesh
+    from mxnet_tpu.placement import activation_constraint
+    set_current_mesh(None)
+    x = (jnp.ones((4, 4)), jnp.float32(1.0))
+    out = activation_constraint(x, "dp", "toy")
+    assert out is x
+
+
+def test_shard_annotations_facade_splits_vars_and_ops():
+    from mxnet_tpu.executor import GraphProgram
+    from mxnet_tpu.placement import shard_annotations
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("w", attr={"__shard__": "tp"})
+    h = mx.sym.FullyConnected(data, weight=w, name="fc", num_hidden=8,
+                              attr={"__shard__": "dp"})
+    net = mx.sym.SoftmaxOutput(h, name="softmax")
+    var_anns, op_anns = shard_annotations(GraphProgram(net).nodes)
+    assert var_anns == {"w": "tp"}
+    assert op_anns == {"fc": "dp"}
+
+
+# ---------------------------------------------------------------------------
+# 3-axis composition through ShardedTrainer + embedded kernels
+# ---------------------------------------------------------------------------
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, name="fc1", num_hidden=32)
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, name="fc2", num_hidden=8)
+    return mx.sym.SoftmaxOutput(h, name="softmax")
+
+
+def _train(spec, steps=2, seed=4):
+    tr = ShardedTrainer(_mlp(), spec, lr=0.1, momentum=0.9, wd=1e-4,
+                        zero=True)
+    params, mom, aux = tr.init_state(
+        {"data": (8, 12), "softmax_label": (8,)}, seed=seed)
+    rs = np.random.RandomState(1)
+    for _ in range(steps):
+        feed = {"data": rs.rand(8, 12).astype(np.float32),
+                "softmax_label": rs.randint(0, 8, 8).astype(np.float32)}
+        params, mom, aux, loss = tr.step(params, mom, aux, feed)
+    return tr, [np.asarray(p) for p in params]
+
+
+def test_three_axis_trainer_matches_single_axis():
+    """dp2 x tp2 x pp2 through ShardedTrainer (ZeRO on) == dp8, the
+    8-device composition the hand-rolled paths could never express."""
+    _need_devices(8)
+    tr8, p8 = _train(MeshSpec.build({"dp": 8}))
+    tr3, p3 = _train(MeshSpec.build({"dp": 2, "tp": 2, "pp": 2}))
+    assert tr3.tp_axis == "tp" and tr3.shard_weight_update
+    for n, a, b in zip(tr3.param_names, p3, p8):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5, err_msg=n)
+
+
+def test_shard_map_kernels_embed_in_unified_mesh():
+    """ring attention / MoE dispatch / the GPipe schedule run on a mesh
+    that ALSO carries dp and tp axes — manual only over their own axis,
+    composing with the GSPMD-managed ones."""
+    _need_devices(8)
+    from mxnet_tpu.parallel.moe import moe_ffn, moe_ffn_dense
+    from mxnet_tpu.parallel.pipeline import pipeline_apply
+    from mxnet_tpu.parallel.ring import reference_attention, ring_attention
+    rs = np.random.RandomState(0)
+
+    spec = MeshSpec.build({"dp": 2, "tp": 2, "sp": 2})
+    qkv = [jnp.asarray(rs.rand(2, 8, 2, 4).astype(np.float32))
+           for _ in range(3)]
+    out = ring_attention(*qkv, spec, axis="sp", causal=True)
+    ref = reference_attention(*qkv, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-6)
+
+    spec = MeshSpec.build({"dp": 2, "tp": 2, "ep": 2})
+    E, d, hid = 2, 8, 16
+    x = jnp.asarray(rs.rand(8, d).astype(np.float32))
+    wg = jnp.asarray(rs.rand(d, E).astype(np.float32))
+    w1 = jnp.asarray(rs.rand(E, d, hid).astype(np.float32) * 0.1)
+    w2 = jnp.asarray(rs.rand(E, hid, d).astype(np.float32) * 0.1)
+    out, aux = moe_ffn(x, wg, w1, w2, spec, capacity_factor=4.0)
+    ref, ref_aux = moe_ffn_dense(x, wg, w1, w2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-6)
+
+    spec = MeshSpec.build({"dp": 2, "tp": 2, "pp": 2})
+    Ws = jnp.asarray(rs.rand(2, 6, 6).astype(np.float32) * 0.2)
+    xm = jnp.asarray(rs.rand(3, 2, 6).astype(np.float32))
+    out = pipeline_apply(lambda W, x_: jnp.tanh(x_ @ W), 2, spec, "pp",
+                         Ws, xm)
+    ref = xm
+    for i in range(2):
+        ref = jnp.tanh(ref @ Ws[i])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-6)

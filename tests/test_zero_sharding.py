@@ -1,15 +1,21 @@
-"""ZeRO-style sharded optimizer state (reference analog: BIGARRAY sharding
+"""ZeRO-style sharded weight update (reference analog: BIGARRAY sharding
 across servers kvstore_dist.h:156 + server-side optimizer
-kvstore_dist_server.h:187; SURVEY §5.8 maps both to reduce-scatter +
-sharded update + all-gather under GSPMD).
+kvstore_dist_server.h:187; SURVEY §5.8 and "Automatic Cross-Replica
+Sharding of Weight Update in Data-Parallel Training" map both to
+reduce-scatter + shard-local update + weight all-gather under GSPMD).
 
-shard_optimizer_state=True must (a) place momentum dp-sharded so per-chip
-optimizer memory drops by the dp degree, and (b) produce bit-comparable
-training numerics to the replicated path.
+shard_optimizer_state=True (which now implies the sharded UPDATE unless
+MXNET_TPU_ZERO=0) must (a) place momentum dp-sharded so per-chip
+optimizer memory drops by the dp degree, (b) run the update math on the
+shards — the replica grad all-reduce becomes reduce-scatter + weight
+all-gather in the compiled HLO, and (c) produce bit-comparable training
+numerics to the replicated path, grad accumulation included.
 """
+import jax
 import numpy as np
 
 import mxnet_tpu as mx
+from mxnet_tpu.parallel import audit
 from mxnet_tpu.parallel.mesh import MeshSpec, make_mesh
 from mxnet_tpu.parallel.trainer import ShardedTrainer
 
@@ -22,10 +28,11 @@ def _mlp():
     return mx.sym.SoftmaxOutput(h, name="softmax")
 
 
-def _run(zero, steps=4, seed=5):
+def _run(zero, steps=4, seed=5, grad_accum=1, **kw):
     spec = MeshSpec(make_mesh((8,), ("dp",)))
     trainer = ShardedTrainer(_mlp(), spec, lr=0.1, momentum=0.9, wd=1e-4,
-                             shard_optimizer_state=zero)
+                             shard_optimizer_state=zero,
+                             grad_accum=grad_accum, **kw)
     shapes = {"data": (16, 12), "softmax_label": (16,)}
     params, mom, aux = trainer.init_state(shapes, seed=seed)
     rs = np.random.RandomState(2)
@@ -80,3 +87,101 @@ def test_zero_composes_with_tp():
     assert m.addressable_shards[0].data.shape == (16, 6)
     p = dict(zip(trainer.param_names, params))["fc1_weight"]
     assert p.addressable_shards[0].data.shape == (16, 12)
+
+
+def test_zero_grad_accum_parity():
+    """ZeRO under gradient accumulation: the per-micro reduce-scatter +
+    sharded f32 accumulator still match the replicated path bit-for-bit
+    (up to fp roundoff) — the elastic-resize combination."""
+    _, p_z, m_z, _ = _run(zero=True, grad_accum=2)
+    tr, p_r, m_r, _ = _run(zero=False, grad_accum=2)
+    for n, a, b in zip(tr.param_names, p_z, p_r):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6, err_msg=n)
+
+
+def test_zero_hlo_reduce_scatter_replaces_grad_allreduce():
+    """The wire contract: with the sharded update ON, the compiled step
+    carries reduce-scatter (the fused all-reduce+partition-slice form
+    XLA:CPU spells out) + weight all-gather, and the surviving plain
+    all-reduce payload is noise (the non-finite verdict), NOT the grad
+    payload.  The audited bytes reconcile with the analytic ZeRO model."""
+    tr, params, mom, _ = _run(zero=True, steps=1)
+    feed = {"data": jax.device_put(np.zeros((16, 12), np.float32),
+                                   tr.spec.batch_sharding()),
+            "softmax_label": jax.device_put(np.zeros((16,), np.float32),
+                                            tr.spec.batch_sharding())}
+    jitted = tr._build_step(donate=False)
+    txt = jitted.lower(params, mom, (), feed, tr._keys(),
+                       tr._guard_arrays()).compile().as_text()
+    acct = audit.collective_accounting(txt, mesh=tr.spec.mesh)
+    shardable, residual = tr._zero_split_bytes()
+    model = audit.zero_update_model_bytes(shardable, residual, 8)
+    assert acct["reduce-scatter"]["count"] >= 4          # one per param
+    assert acct["reduce-scatter"]["fused_from_all_reduce"] >= 4
+    # payloads match the model exactly on this bn-free MLP
+    assert acct["reduce-scatter"]["bytes"] == model["reduce-scatter"]
+    assert acct["all-gather"]["bytes"] == model["all-gather"]
+    # the only plain all-reduces left are scalar-ish (verdict, loss)
+    assert acct.get("all-reduce", {}).get("bytes", 0) < 0.01 * shardable
+    # per-axis attribution: every byte is dp traffic on a pure-dp mesh
+    assert set(acct["reduce-scatter"]["by_axis"]) == {"dp"}
+
+    # the replicated control still all-reduces the full grad payload
+    tr_r, p_r, m_r, _ = _run(zero=False, steps=1)
+    txt_r = tr_r._build_step(donate=False).lower(
+        p_r, m_r, (), feed, tr_r._keys(),
+        tr_r._guard_arrays()).compile().as_text()
+    acct_r = audit.collective_accounting(txt_r)
+    assert "reduce-scatter" not in acct_r
+    full = audit.grad_payload_bytes(p_r)
+    assert abs(acct_r["all-reduce"]["bytes"] - full) / full < 0.10
+
+
+def test_mom_sharding_picks_largest_divisible_dim():
+    """Conv-shaped optimizer state (out, in, kh, kw): the dp shard must
+    ride the LARGEST free divisible dim — the old first-fit could pick a
+    tiny out-channel (or kernel) dim and strand per-shard memory in tile
+    padding."""
+    spec = MeshSpec(make_mesh((4, 2), ("dp", "tp")))
+    trainer = ShardedTrainer(_mlp(), spec, shard_optimizer_state=True)
+    # free dims after tp takes dim0: (64, 4, 4) — first-fit would grab
+    # nothing before 64 here, so ALSO check the pure first-fit trap:
+    # dim0 (8) divides dp=4 but dim1 (64) is the right choice
+    def spec_of(s):
+        dims = tuple(s.spec) + (None,) * (4 - len(s.spec))
+        return dims
+
+    s = trainer.mom_sharding("conv_weight", (8, 64, 4, 4))
+    assert spec_of(s) == ("tp", "dp", None, None), spec_of(s)
+    spec_dp = MeshSpec(make_mesh((4,), ("dp",)))
+    tr_dp = ShardedTrainer(_mlp(), spec_dp, shard_optimizer_state=True)
+    s = tr_dp.mom_sharding("conv_weight", (8, 64, 4, 4))
+    assert spec_of(s) == (None, "dp", None, None), spec_of(s)
+    # ties break to the earliest dim; no divisible dim -> unsharded
+    s = tr_dp.mom_sharding("conv_weight", (8, 8, 3, 3))
+    assert spec_of(s) == ("dp", None, None, None), spec_of(s)
+    s = tr_dp.mom_sharding("odd", (7, 5, 3, 3))
+    assert spec_of(s) == (None, None, None, None), spec_of(s)
+
+
+def test_zero_env_knob(monkeypatch):
+    """MXNET_TPU_ZERO=0 reverts shard_optimizer_state to storage-only
+    sharding; =1 arms the full update without any ctor flag; the ctor
+    arg wins over the env."""
+    spec = MeshSpec(make_mesh((8,), ("dp",)))
+    monkeypatch.setenv("MXNET_TPU_ZERO", "0")
+    tr = ShardedTrainer(_mlp(), spec, shard_optimizer_state=True)
+    assert tr.shard_optimizer_state and not tr.shard_weight_update
+    monkeypatch.setenv("MXNET_TPU_ZERO", "1")
+    tr = ShardedTrainer(_mlp(), spec)
+    assert tr.shard_optimizer_state and tr.shard_weight_update
+    tr = ShardedTrainer(_mlp(), spec, zero=False)
+    assert not tr.shard_weight_update
+    monkeypatch.delenv("MXNET_TPU_ZERO")
+    tr = ShardedTrainer(_mlp(), spec, shard_optimizer_state=True)
+    assert tr.zero and tr.shard_weight_update    # follows the state flag
+    # dp=1: storage/update sharding degrade to no-ops, never an error
+    tr1 = ShardedTrainer(_mlp(), MeshSpec(make_mesh((1,), ("dp",))),
+                         zero=True)
+    assert not tr1.shard_weight_update

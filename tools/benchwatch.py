@@ -82,20 +82,22 @@ def extract_metrics(doc):
 
 
 def extract_extra(doc):
-    """Recorded-but-not-gated fields from a bench document — today the
-    memory plane's peak HBM per benchmark (phases.peak_hbm_bytes).
-    These land in the ledger entry's ``extra`` block, NOT ``metrics``:
-    the gate treats every metric as higher-is-better, and a peak-HBM
-    *improvement* (a drop) must never read as a regression."""
+    """Recorded-but-not-gated fields from a bench document — the memory
+    plane's peak HBM and the collective plane's per-step wire bytes
+    (phases.peak_hbm_bytes / phases.collective_bytes_per_step).  These
+    land in the ledger entry's ``extra`` block, NOT ``metrics``: the
+    gate treats every metric as higher-is-better, and a peak-HBM or
+    wire-bytes *improvement* (a drop) must never read as a regression."""
     if not isinstance(doc, dict):
         return {}
     if "parsed" in doc and isinstance(doc["parsed"], dict):
         doc = doc["parsed"]
     out = {}
     phases = doc.get("phases")
-    if isinstance(phases, dict) and isinstance(
-            phases.get("peak_hbm_bytes"), (int, float)):
-        out["peak_hbm_bytes"] = int(phases["peak_hbm_bytes"])
+    if isinstance(phases, dict):
+        for field in ("peak_hbm_bytes", "collective_bytes_per_step"):
+            if isinstance(phases.get(field), (int, float)):
+                out[field] = int(phases[field])
     sub = doc.get("transformer")
     if isinstance(sub, dict):
         for k, v in extract_extra(sub).items():
