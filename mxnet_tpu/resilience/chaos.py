@@ -33,6 +33,17 @@ recovery machinery is *proven* by tests instead of trusted:
 * ``bad_swap``     — the hot model-swap canary run produces non-finite
   outputs, so swap validation must reject the incoming model and keep
   serving the previous one (serving/runtime.py swap/rollback drill).
+* ``replica_crash`` — the serving replica SIGKILLs ITSELF mid-batch
+  (inside the armed dispatch region, after requests were admitted and
+  popped) — the kill-one-replica fleet drill: the router must eject the
+  replica, complete its in-flight requests elsewhere via hedging/retry
+  with zero late OKs, and re-admit the supervisor's relaunch.
+* ``hedge_lag``    — the serving executor sleeps on EVERY firing
+  (``seconds`` param or ``MXNET_TPU_CHAOS_HEDGE_LAG_SECONDS``, default
+  0.3; arm with a large count, e.g. ``hedge_lagx100000``): one replica
+  turned into a persistent straggler past its own published p95, so the
+  fleet router's hedging path — not a timeout or a crash — is what keeps
+  tail latency bounded.
 * ``oom``          — request an impossibly large device allocation
   INSIDE the watchdog-armed step region, so the REAL allocator raises
   ``RESOURCE_EXHAUSTED`` through the real dispatch path and the memory
@@ -56,6 +67,7 @@ from typing import List, Optional
 __all__ = ["SimulatedPreemption", "inject", "fire", "maybe_preempt",
            "maybe_preempt_notice", "maybe_io_error", "maybe_hang",
            "maybe_slow_exec", "maybe_exec_error", "maybe_oom",
+           "maybe_replica_crash", "maybe_hedge_lag",
            "corrupt_latest", "active", "reset"]
 
 
@@ -220,6 +232,38 @@ def maybe_exec_error(step: Optional[int] = None):
     if fire("exec_error", step) is not None:
         raise RuntimeError(
             "chaos: injected executor failure at batch %s" % step)
+
+
+def maybe_replica_crash(step: Optional[int] = None):
+    """SIGKILL the calling process if a ``replica_crash`` fault fires now
+    — the dead-replica fleet drill.  The kill lands INSIDE the armed
+    dispatch region, mid-batch, so in-flight requests are orphaned the
+    way a real host loss orphans them: no exception propagates, no
+    destructor runs, the socket just dies.  Recovery must come entirely
+    from the OTHER side (router eviction + hedging + supervisor
+    relaunch), which is exactly what the drill proves."""
+    if fire("replica_crash", step) is not None:
+        import signal
+        print("chaos: replica SIGKILLing itself at batch %s" % step,
+              flush=True)
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def maybe_hedge_lag(step: Optional[int] = None):
+    """Sleep inside the serving executor call if a ``hedge_lag`` fault
+    fires now — the persistent-straggler fleet drill.  Unlike
+    ``slow_exec`` (a transient blip absorbed by deadline margins), this
+    is meant to be armed with a large count so ONE replica's every batch
+    runs past its published p95 and the router's digest-informed hedging
+    is what bounds the fleet's tail, not luck."""
+    params = fire("hedge_lag", step)
+    if params is None:
+        return
+    import time
+    seconds = float(params.get(
+        "seconds",
+        os.environ.get("MXNET_TPU_CHAOS_HEDGE_LAG_SECONDS", "0.3")))
+    time.sleep(seconds)
 
 
 def maybe_oom(step: Optional[int] = None):

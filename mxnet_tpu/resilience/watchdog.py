@@ -68,9 +68,10 @@ import traceback
 from contextlib import contextmanager
 from typing import Dict, Optional
 
-__all__ = ["Watchdog", "HeartbeatLane", "watch", "heartbeat", "lane",
-           "enabled", "configure", "reset", "set_default_report_dir",
-           "default_report_dir", "write_postmortem", "DEFAULT_EXIT_CODE"]
+__all__ = ["Watchdog", "HeartbeatLane", "FileKVClient", "watch",
+           "heartbeat", "lane", "enabled", "configure", "reset",
+           "set_default_report_dir", "default_report_dir",
+           "write_postmortem", "DEFAULT_EXIT_CODE"]
 
 DEFAULT_STEP_TIMEOUT = 300.0
 DEFAULT_EXIT_CODE = 43
@@ -88,6 +89,73 @@ def _env_float(name, default):
 # heartbeat lane over the jax coordination-service KV store
 # ---------------------------------------------------------------------------
 
+class FileKVClient:
+    """Coordination-KV client backed by a directory of files — the same
+    ``key_value_set`` / ``key_value_dir_get`` / ``key_value_delete``
+    surface as the jax coordination-service client, so a
+    :class:`HeartbeatLane` (and everything layered on it: digests, fleet
+    views, staleness eviction) runs unchanged over processes that share
+    only a filesystem.
+
+    The serving fleet uses this as its membership substrate: replica
+    processes are NOT a jax.distributed gang (they come and go under the
+    supervisor, and rank 0 of a gang must never be a single point of
+    failure for serving), and a file per key survives any member being
+    SIGKILLed mid-write because every set is write-tmp-then-rename.
+    """
+
+    def __init__(self, root: str):
+        self.root = os.fspath(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        from urllib.parse import quote
+        return os.path.join(self.root, quote(str(key), safe=""))
+
+    def key_value_set(self, key, value, allow_overwrite=True):
+        path = self._path(key)
+        if not allow_overwrite and os.path.exists(path):
+            raise ValueError("key %r exists and allow_overwrite=False"
+                             % key)
+        tmp = "%s.tmp.%d" % (path, os.getpid())
+        with open(tmp, "w") as f:
+            f.write(str(value))
+        os.replace(tmp, path)
+
+    def key_value_get(self, key):
+        try:
+            with open(self._path(key)) as f:
+                return f.read()
+        except OSError:
+            raise KeyError(key)
+
+    def key_value_dir_get(self, prefix):
+        from urllib.parse import quote, unquote
+        q = quote(str(prefix), safe="")
+        out = []
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return out
+        for name in sorted(names):
+            if name.endswith(".tmp.%d" % os.getpid()) or ".tmp." in name:
+                continue
+            if not name.startswith(q):
+                continue
+            try:
+                with open(os.path.join(self.root, name)) as f:
+                    out.append((unquote(name), f.read()))
+            except OSError:
+                continue        # deleted between listdir and open
+        return out
+
+    def key_value_delete(self, key):
+        try:
+            os.unlink(self._path(key))
+        except OSError:
+            pass
+
+
 class HeartbeatLane:
     """Per-rank ``rank -> (step, timestamp)`` over the coordination KV.
 
@@ -100,8 +168,9 @@ class HeartbeatLane:
     PREFIX = "mxt_hb"
     MD_PREFIX = "mxt_md"     # per-rank telemetry digest (one key, JSON)
 
-    def __init__(self, client=None):
+    def __init__(self, client=None, rank=None):
         self._explicit_client = client
+        self._explicit_rank = rank      # serving replicas: not a jax rank
         self._last_beat = 0.0
         self._interval = _env_float("MXNET_TPU_HEARTBEAT_INTERVAL", 0.5)
         self._lock = threading.Lock()
@@ -116,6 +185,8 @@ class HeartbeatLane:
             return None
 
     def _rank(self):
+        if self._explicit_rank is not None:
+            return self._explicit_rank
         try:
             import jax
             return jax.process_index()
@@ -145,10 +216,12 @@ class HeartbeatLane:
         except Exception:
             return 0
 
-    def beat(self, step: int, force: bool = False):
+    def beat(self, step: int, force: bool = False, digest=None):
         """Publish this rank's progress.  Throttled (default 0.5 s) so a
         fast step loop does not hammer the coordinator; cheap no-op when
-        jax.distributed is not initialized."""
+        jax.distributed is not initialized.  ``digest`` overrides the
+        piggybacked telemetry digest (serving replicas publish a
+        serve-shaped one; None keeps the training ``rank_digest``)."""
         client = self._client()
         if client is None:
             return False
@@ -166,15 +239,30 @@ class HeartbeatLane:
         # throttle, one overwritten key per rank) so rank 0 can build a
         # fleet view with NO extra collectives or polling threads
         try:
-            from .. import telemetry
-            if telemetry.is_armed():
+            if digest is None:
+                from .. import telemetry
+                if telemetry.is_armed():
+                    digest = telemetry.rank_digest(step=step)
+            if digest is not None:
                 self._kv_set(client,
                              "%s/%d" % (self.MD_PREFIX, self._rank()),
-                             json.dumps(telemetry.rank_digest(step=step),
-                                        default=repr))
+                             json.dumps(digest, default=repr))
         except Exception:
             pass     # the digest is best-effort; the beat already landed
         return True
+
+    def evict(self, rank: int):
+        """Delete a rank's lane keys (membership eviction — the elastic
+        commit path does this for dead training ranks; the fleet router
+        does it for ejected-and-not-returning serving replicas)."""
+        client = self._client()
+        if client is None:
+            return
+        for prefix in (self.PREFIX, self.MD_PREFIX):
+            try:
+                client.key_value_delete("%s/%d" % (prefix, int(rank)))
+            except Exception:
+                pass
 
     def peers(self) -> Dict[int, Dict[str, float]]:
         """``{rank: {"step": int, "time": float, "gen": int}}`` for every

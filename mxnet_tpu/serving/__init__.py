@@ -20,6 +20,20 @@ the runtime, not the graph):
   to watchdog-armed dispatch, retry/backoff, hot model-swap with canary
   validation + rollback, and live stats (tools/servebench.py).
 
+The fleet tier replicates that runtime across processes:
+
+* ``wire``      — pickle-free socket framing (JSON header + raw array
+  payload) between router and replicas.
+* ``replica``   — one runtime behind a loopback socket + heartbeat
+  digests on the fleet's file-backed coordination-KV lane.
+* ``router``    — :class:`FleetRouter`: membership/health (canaried
+  join, staleness/breaker/link eviction, automatic re-admission),
+  per-tenant quotas + priority classes (:class:`TenantPolicy`),
+  least-loaded/rendezvous dispatch, digest-informed request hedging,
+  rolling fleet swap with fleet-wide rollback.
+* ``fleet``     — :class:`ServingFleet`: N supervised replica
+  processes (exit-44 relaunch convention) + a router, one object.
+
 Quick start::
 
     from mxnet_tpu.serving import ServingRuntime
@@ -36,15 +50,20 @@ The C ABI reaches the same runtime through ``MXPredCreateFromServed`` +
 from .admission import AdmissionQueue
 from .batcher import collect_batch, normalize_inputs, pack, unpack
 from .breaker import BROKEN, DEGRADED, HEALTH_NAMES, SERVING, CircuitBreaker
-from .errors import (CircuitOpen, DeadlineExceeded, ExecFailed, Overloaded,
+from .errors import (Cancelled, CircuitOpen, DeadlineExceeded, ExecFailed,
+                     Overloaded, QuotaExceeded, ReplicaUnavailable,
                      ServingError, SwapFailed, TopologyMismatch)
 from .request import Request
 from .runtime import ServingRuntime
+from .router import FleetRouter, TenantPolicy
+from .fleet import ServingFleet
 
 __all__ = [
     "ServingRuntime", "Request", "AdmissionQueue", "CircuitBreaker",
     "SERVING", "DEGRADED", "BROKEN", "HEALTH_NAMES",
     "ServingError", "Overloaded", "DeadlineExceeded", "CircuitOpen",
-    "ExecFailed", "SwapFailed", "TopologyMismatch",
+    "ExecFailed", "SwapFailed", "TopologyMismatch", "QuotaExceeded",
+    "ReplicaUnavailable", "Cancelled",
+    "ServingFleet", "FleetRouter", "TenantPolicy",
     "normalize_inputs", "collect_batch", "pack", "unpack",
 ]

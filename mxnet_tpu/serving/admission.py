@@ -78,6 +78,11 @@ class AdmissionQueue:
             while True:
                 while self._items:
                     req = self._items.pop(0)
+                    if req.done:
+                        # completed while queued (a fleet hedge raced it
+                        # and won, or the router cancelled the dispatch):
+                        # drop silently — its outcome is already settled
+                        continue
                     if not req.expired():
                         req.t_popped = time.monotonic()
                         return req

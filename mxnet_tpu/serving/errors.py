@@ -14,7 +14,8 @@ from ..base import MXNetError
 from ..deploy import TopologyMismatch
 
 __all__ = ["ServingError", "Overloaded", "DeadlineExceeded", "CircuitOpen",
-           "ExecFailed", "SwapFailed", "TopologyMismatch"]
+           "ExecFailed", "SwapFailed", "TopologyMismatch", "QuotaExceeded",
+           "ReplicaUnavailable", "Cancelled"]
 
 
 class ServingError(MXNetError):
@@ -52,3 +53,22 @@ class ExecFailed(ServingError):
 class SwapFailed(ServingError):
     """A hot model-swap was rejected (load failure, schema mismatch, or
     canary validation) — the previous model is still serving."""
+
+
+class QuotaExceeded(Overloaded):
+    """The fleet router shed this request at its TENANT's quota (token
+    bucket or in-flight cap) — the tenant is flooding, and only its own
+    traffic pays.  Subclasses :class:`Overloaded`: callers that already
+    treat overload as "retry later" need no new handling."""
+
+
+class ReplicaUnavailable(ServingError):
+    """The replica holding this request died or its link broke before a
+    result came back.  Internal to the router's retry/hedge machinery —
+    callers only see it when every re-dispatch avenue is exhausted."""
+
+
+class Cancelled(ServingError):
+    """The router cancelled this dispatch (a hedge raced it and won, or
+    the fleet is shutting down).  Never delivered to fleet callers: the
+    winning copy's result, or a typed error, always arrives first."""

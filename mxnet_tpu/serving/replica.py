@@ -1,0 +1,355 @@
+"""Replica process: one :class:`ServingRuntime` behind a socket.
+
+A fleet replica is today's single-process serving runtime (runtime.py —
+admission queue, deadline batching, breaker, watchdog-armed dispatch,
+canary swap) wrapped in two thin layers:
+
+* a **request server** speaking the pickle-free :mod:`wire` framing on a
+  loopback TCP port — ``submit`` / ``cancel`` / ``stats`` / ``swap`` /
+  ``rollback`` / ``shutdown``/``restart`` ops from the fleet router;
+* a **heartbeat publisher** writing this replica's
+  :func:`telemetry.replica_digest` (QPS, queue depth, breaker state,
+  latency p95, live/peak mem, listen port, input schema) onto the
+  fleet's file-backed coordination-KV lane (fleet.py ``fleet_lane`` —
+  the PR-5 heartbeat/digest machinery over a :class:`FileKVClient`)
+  every ``MXNET_TPU_FLEET_BEAT_INTERVAL`` seconds.  Staleness of that
+  digest is how the router notices this process died.
+
+Run as a process (the fleet supervisor builds exactly this command)::
+
+    python -m mxnet_tpu.serving.replica --replica-id 0 \
+        --fleet-dir /path/to/fleet --artifact model.mxt
+
+``--synthetic B,F,LAT`` serves a device-free synthetic program instead
+(tools/servebench.py fleet mode, tests).  Exit codes follow the elastic
+launcher's convention (tools/launch.py): 0 = clean shutdown, 44
+(``RESIZE_EXIT_CODE``) = deliberate restart request — the supervisor
+relaunches a 44 immediately and treats anything else as a crash.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import sys
+import threading
+import time
+from typing import Dict
+
+import numpy as np
+
+from .. import telemetry
+from .errors import Cancelled, ServingError, SwapFailed
+from .runtime import ServingRuntime
+from . import wire
+
+__all__ = ["SyntheticProgram", "ReplicaServer", "RESTART_EXIT_CODE",
+           "main"]
+
+# the elastic launcher's coordinated-restart code, reused verbatim so a
+# fleet operator sees ONE restart convention across training and serving
+RESTART_EXIT_CODE = int(os.environ.get("MXNET_TPU_ELASTIC_EXIT_CODE", "44"))
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ[name])
+    except (KeyError, ValueError):
+        return default
+
+
+class SyntheticProgram:
+    """Program-like stand-in for fleet tests/benches: fixed batch shape,
+    configurable per-batch latency, ``data * scale`` math (so a swap to a
+    different ``scale`` is observable from outputs, and ``scale=nan``
+    makes the swap canary fail the non-finite check)."""
+
+    def __init__(self, batch=8, features=16, latency=0.0, scale=1.0):
+        self.input_names = ["data"]
+        self.input_shapes = {"data": (int(batch), int(features))}
+        self.input_dtypes = {"data": np.dtype(np.float32)}
+        self.output_shapes = [(int(batch), int(features))]
+        self.latency = float(latency)
+        self.scale = float(scale)
+
+    def forward(self, data):
+        if self.latency:
+            time.sleep(self.latency)
+        return [data * np.float32(self.scale)]
+
+    @classmethod
+    def from_spec(cls, spec: Dict):
+        return cls(batch=spec.get("batch", 8),
+                   features=spec.get("features", 16),
+                   latency=spec.get("latency", 0.0),
+                   scale=spec.get("scale", 1.0))
+
+
+def _errmsg(e: BaseException) -> str:
+    """The error's bare message (ServingError.__str__ prepends the type
+    name for the C ABI; on the wire the type travels separately)."""
+    args = getattr(e, "args", None)
+    return str(args[0]) if args else ""
+
+
+def _schema_of(prog) -> Dict:
+    """The input schema the router needs to normalize caller inputs —
+    published in the digest so dispatch never needs a schema round trip."""
+    return {
+        "input_names": list(prog.input_names),
+        "input_shapes": {n: list(prog.input_shapes[n])
+                         for n in prog.input_names},
+        "input_dtypes": {n: np.dtype(prog.input_dtypes[n]).str
+                         for n in prog.input_names},
+    }
+
+
+class ReplicaServer:
+    """Serve one :class:`ServingRuntime` over the wire protocol + publish
+    heartbeat digests (see module docstring).  ``port=0`` binds an
+    ephemeral port — the chosen one travels in the digest."""
+
+    def __init__(self, runtime: ServingRuntime, replica_id: int,
+                 fleet_dir: str, port: int = 0, beat_interval=None,
+                 model_tag=None):
+        from .fleet import fleet_lane
+        self._rt = runtime
+        self._id = int(replica_id)
+        self._model_tag = model_tag
+        self._lane = fleet_lane(fleet_dir, rank=self._id)
+        self._beat_interval = (beat_interval if beat_interval is not None
+                               else _env_float(
+                                   "MXNET_TPU_FLEET_BEAT_INTERVAL", 0.2))
+        self._stop = threading.Event()
+        self.exit_code = 0
+        self._qps_prev = (time.monotonic(), 0)
+
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", int(port)))
+        self._sock.listen(16)
+        self.port = self._sock.getsockname()[1]
+
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="mxt-replica-accept", daemon=True)
+        self._beat_thread = threading.Thread(
+            target=self._beat_loop, name="mxt-replica-beat", daemon=True)
+        self._accept_thread.start()
+        self._beat_thread.start()
+
+    # -- heartbeat ---------------------------------------------------------
+    def _digest(self) -> dict:
+        now = time.monotonic()
+        done = self._rt.stats()["counters"].get("completed", 0)
+        t0, d0 = self._qps_prev
+        qps = (done - d0) / max(now - t0, 1e-6)
+        self._qps_prev = (now, done)
+        return telemetry.replica_digest(
+            self._rt, self._id, port=self.port, qps=qps,
+            model=self._model_tag, schema=_schema_of(self._rt._program))
+
+    def _beat_loop(self):
+        while not self._stop.is_set():
+            try:
+                batches = self._rt.stats()["counters"].get("batches", 0)
+                self._lane.beat(batches, force=True, digest=self._digest())
+            except Exception:
+                pass            # the next beat retries; staleness is the signal
+            self._stop.wait(self._beat_interval)
+
+    # -- request serving ---------------------------------------------------
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return          # socket closed during shutdown
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             name="mxt-replica-conn", daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket):
+        send_lock = threading.Lock()
+        pending: Dict[int, object] = {}     # call id -> serving Request
+        pending_lock = threading.Lock()
+        deliver_stop = threading.Event()
+
+        def reply(header, arrays=None):
+            with send_lock:
+                wire.send_msg(conn, header, arrays)
+
+        def deliver_loop():
+            # one poller per connection: ship results as their one-shot
+            # futures settle, preserving the runtime's deadline semantics
+            # (a late _deliver already became DeadlineExceeded inside
+            # Request — nothing here can turn it back into an OK)
+            while not deliver_stop.is_set():
+                done = []
+                with pending_lock:
+                    for call_id, req in list(pending.items()):
+                        if req.done:
+                            done.append((call_id, req))
+                            del pending[call_id]
+                for call_id, req in done:
+                    try:
+                        self._send_outcome(reply, call_id, req)
+                    except OSError:
+                        deliver_stop.set()
+                        return
+                deliver_stop.wait(0.002)
+
+        deliverer = threading.Thread(target=deliver_loop,
+                                     name="mxt-replica-deliver", daemon=True)
+        deliverer.start()
+        try:
+            while not self._stop.is_set():
+                try:
+                    header, arrays = wire.recv_msg(conn)
+                except (ConnectionError, OSError, ValueError):
+                    return
+                try:
+                    self._handle(header, arrays, reply, pending,
+                                 pending_lock)
+                except OSError:
+                    return
+                except Exception as e:      # never kill the connection loop
+                    cid = header.get("id")
+                    if cid is not None:
+                        try:
+                            reply({"id": cid, "ok": False,
+                                   "error": type(e).__name__,
+                                   "msg": str(e)})
+                        except OSError:
+                            return
+        finally:
+            deliver_stop.set()
+            with pending_lock:
+                orphans = list(pending.values())
+                pending.clear()
+            for req in orphans:
+                req._fail(Cancelled("router connection closed"))
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _send_outcome(self, reply, call_id, req):
+        err = req._error
+        if err is None:
+            outs = {"out%d" % i: np.asarray(o)
+                    for i, o in enumerate(req._outputs)}
+            reply({"id": call_id, "ok": True, "n_outputs": len(outs)},
+                  outs)
+        else:
+            reply({"id": call_id, "ok": False,
+                   "error": type(err).__name__,
+                   "msg": _errmsg(err)})
+
+    def _handle(self, header, arrays, reply, pending, pending_lock):
+        op = header.get("op")
+        call_id = header.get("id")
+        if op == "submit":
+            deadline = header.get("deadline")
+            try:
+                req = self._rt.submit(
+                    arrays, priority=int(header.get("priority", 0)),
+                    deadline=deadline)
+            except ServingError as e:
+                reply({"id": call_id, "ok": False,
+                       "error": type(e).__name__,
+                       "msg": _errmsg(e)})
+                return
+            with pending_lock:
+                pending[call_id] = req
+        elif op == "cancel":
+            with pending_lock:
+                req = pending.pop(header.get("target"), None)
+            if req is not None:
+                req._fail(Cancelled("cancelled by router (hedge won "
+                                    "elsewhere)"))
+                telemetry.count("serve.fleet.cancelled")
+            # no reply: cancel is fire-and-forget
+        elif op == "stats":
+            reply({"id": call_id, "ok": True, "stats": self._rt.stats(),
+                   "replica": self._id})
+        elif op == "swap":
+            try:
+                if header.get("synthetic") is not None:
+                    new = SyntheticProgram.from_spec(header["synthetic"])
+                else:
+                    new = header.get("artifact")
+                    if not new:
+                        raise SwapFailed("swap op carries neither "
+                                         "'artifact' nor 'synthetic'")
+                self._rt.swap(new)
+                self._model_tag = header.get("tag", self._model_tag)
+                reply({"id": call_id, "ok": True})
+            except ServingError as e:
+                reply({"id": call_id, "ok": False,
+                       "error": type(e).__name__,
+                       "msg": _errmsg(e)})
+        elif op == "rollback":
+            try:
+                self._rt.rollback()
+                reply({"id": call_id, "ok": True})
+            except ServingError as e:
+                reply({"id": call_id, "ok": False,
+                       "error": type(e).__name__,
+                       "msg": _errmsg(e)})
+        elif op == "ping":
+            reply({"id": call_id, "ok": True, "replica": self._id})
+        elif op in ("shutdown", "restart"):
+            self.exit_code = (RESTART_EXIT_CODE if op == "restart" else 0)
+            reply({"id": call_id, "ok": True})
+            self._stop.set()
+        else:
+            reply({"id": call_id, "ok": False, "error": "ServingError",
+                   "msg": "unknown op %r" % op})
+
+    # -- lifecycle ---------------------------------------------------------
+    def wait(self):
+        """Block until a shutdown/restart op arrives; returns exit code."""
+        while not self._stop.wait(0.2):
+            pass
+        return self.exit_code
+
+    def close(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._rt.close()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--replica-id", type=int, required=True)
+    ap.add_argument("--fleet-dir", required=True)
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--artifact", default=None)
+    ap.add_argument("--synthetic", default=None,
+                    help="B,F,LATENCY[,SCALE]: serve a synthetic program "
+                         "instead of an artifact (benches/tests)")
+    ap.add_argument("--model-tag", default=None)
+    args = ap.parse_args(argv)
+    if args.synthetic:
+        parts = [float(x) for x in args.synthetic.split(",")]
+        prog = SyntheticProgram(int(parts[0]), int(parts[1]),
+                                *(parts[2:] or []))
+    elif args.artifact:
+        prog = args.artifact
+    else:
+        ap.error("need --artifact or --synthetic")
+    rt = ServingRuntime(prog, name="replica%d" % args.replica_id)
+    srv = ReplicaServer(rt, args.replica_id, args.fleet_dir,
+                        port=args.port, model_tag=args.model_tag)
+    print("replica %d serving on 127.0.0.1:%d (pid %d)"
+          % (args.replica_id, srv.port, os.getpid()), flush=True)
+    code = srv.wait()
+    srv.close()
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

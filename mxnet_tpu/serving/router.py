@@ -1,0 +1,1016 @@
+"""Fleet router: admission, dispatch, hedging, and membership over N
+replica processes.
+
+The router owns everything that must NOT live inside a replica for the
+fleet to survive that replica:
+
+* **membership & health** — replicas are discovered from the heartbeat
+  digests they publish on the fleet's coordination-KV lane (fleet.py
+  ``fleet_lane``; the PR-5 digest machinery over a file-backed KV).  A
+  replica is ejected on digest staleness (the process died or wedged —
+  SIGKILL leaves no other evidence), on a ``BROKEN`` breaker state, or
+  on a dead socket; ejection fails its in-flight dispatches with
+  :class:`ReplicaUnavailable`, which re-dispatches them elsewhere while
+  their deadlines still allow.  A fresh heartbeat from an ejected id
+  (the supervisor's relaunch) re-admits it — but only after a **canary**
+  request round-trips, so a half-up replica never takes live traffic.
+
+* **admission** — per-tenant quotas (token bucket + in-flight cap) and
+  priority classes resolved HERE, before a request ever reaches a
+  replica's AdmissionQueue: a flooding tenant sheds its own traffic with
+  :class:`QuotaExceeded` and nobody else's.  The tenant's priority class
+  rides to the replica, so in-queue eviction order under overload stays
+  exactly the PR-4 semantics (lowest priority, then oldest, pays).
+
+* **dispatch** — least-loaded (router in-flight + digest queue depth),
+  with rendezvous-hash affinity for ``sticky`` tenants (cache-warm
+  routing that degrades to least-loaded the moment the preferred
+  replica is unavailable).
+
+* **tail tolerance** — hedging: when a dispatched request's age passes
+  the serving replica's digest-informed p95 (× ``hedge_factor``), the
+  router re-dispatches to the next-best replica; first success delivers
+  and the loser is cancelled.  Deadline semantics are preserved end to
+  end: delivery funnels through :meth:`Request._deliver`, which turns
+  any post-deadline result into ``DeadlineExceeded`` — a killed or
+  wedged replica can never yield a late OK.
+
+* **rolling swap** — :meth:`swap_fleet` drains one replica at a time,
+  runs the in-replica canary swap (runtime.py), and on ANY canary
+  failure rolls every already-swapped replica back — the old model keeps
+  serving throughout, and zero live requests are spent on a bad model.
+
+Knobs (all ``MXNET_TPU_FLEET_*``, documented in docs/deploy.md;
+constructor arguments win):
+
+=====================================  ==================================
+``MXNET_TPU_FLEET_STALE_AFTER``        digest age that ejects, s (1.5)
+``MXNET_TPU_FLEET_SCAN_INTERVAL``      membership scan period, s (0.1)
+``MXNET_TPU_FLEET_HEDGE_FACTOR``       hedge at p95 × this (1.5)
+``MXNET_TPU_FLEET_HEDGE_MIN``          hedge-delay floor, s (0.05)
+``MXNET_TPU_FLEET_HEDGE_MAX``          hedged copies per request (1)
+``MXNET_TPU_FLEET_RETRY_MAX``          distinct replicas tried (3)
+``MXNET_TPU_FLEET_CANARY_TIMEOUT``     canary round-trip budget, s (5)
+``MXNET_TPU_FLEET_DRAIN_TIMEOUT``      swap drain budget, s (30)
+``MXNET_TPU_FLEET_QPS``                default tenant rate (unlimited)
+``MXNET_TPU_FLEET_BURST``              default token-bucket burst (2×rate)
+``MXNET_TPU_FLEET_MAX_INFLIGHT``       default tenant in-flight cap (none)
+=====================================  ==================================
+"""
+from __future__ import annotations
+
+import collections
+import hashlib
+import heapq
+import json
+import os
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import telemetry
+from . import batcher, wire
+from .errors import (Cancelled, CircuitOpen, DeadlineExceeded, ExecFailed,
+                     Overloaded, QuotaExceeded, ReplicaUnavailable,
+                     ServingError, SwapFailed)
+from .request import Request
+
+__all__ = ["TenantPolicy", "FleetRouter", "FleetRequest",
+           "JOINING", "READY", "DRAINING", "EJECTED"]
+
+JOINING, READY, DRAINING, EJECTED = "JOINING", "READY", "DRAINING", "EJECTED"
+
+# wire error name -> exception class, for re-raising replica-side sheds
+# with their original type on the router side of the socket
+_ERROR_TYPES = {c.__name__: c for c in
+                (ServingError, Overloaded, DeadlineExceeded, CircuitOpen,
+                 ExecFailed, SwapFailed, QuotaExceeded, ReplicaUnavailable,
+                 Cancelled)}
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ[name])
+    except (KeyError, ValueError):
+        return default
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ[name])
+    except (KeyError, ValueError):
+        return default
+
+
+def _env_opt_float(name):
+    try:
+        return float(os.environ[name])
+    except (KeyError, ValueError):
+        return None
+
+
+class TenantPolicy:
+    """One tenant's admission contract at the router: a token-bucket
+    rate (``rate`` req/s, burst ``burst``), an in-flight cap, a priority
+    class (rides to the replica queues), and stickiness (rendezvous-hash
+    affinity).  ``rate=None`` = unlimited."""
+
+    def __init__(self, rate=None, burst=None, max_inflight=None,
+                 priority=0, sticky=False):
+        self.rate = None if rate is None else float(rate)
+        self.burst = float(burst if burst is not None
+                           else (2 * self.rate if self.rate else 1.0))
+        self.max_inflight = (None if max_inflight is None
+                             else int(max_inflight))
+        self.priority = int(priority)
+        self.sticky = bool(sticky)
+        self._tokens = self.burst
+        self._refilled = time.monotonic()
+        self._lock = threading.Lock()
+
+    @classmethod
+    def default(cls):
+        return cls(rate=_env_opt_float("MXNET_TPU_FLEET_QPS"),
+                   burst=_env_opt_float("MXNET_TPU_FLEET_BURST"),
+                   max_inflight=_env_opt_float("MXNET_TPU_FLEET_MAX_INFLIGHT"))
+
+    def try_acquire(self, now: Optional[float] = None) -> bool:
+        """Take one token; False = over rate (shed this request)."""
+        if self.rate is None:
+            return True
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._tokens = min(self.burst,
+                               self._tokens
+                               + max(0.0, now - self._refilled)
+                               * self.rate)
+            self._refilled = now
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
+
+class FleetRequest(Request):
+    """A router-side request: the PR-4 one-shot future (same deadline
+    enforcement in ``_deliver``) plus the fleet bookkeeping — which
+    replicas hold copies, how many hedges fired, who won."""
+
+    __slots__ = ("tenant", "dispatches", "tried", "first_rid", "hedges",
+                 "hedge_rids", "_finalized", "won_by")
+
+    def __init__(self, inputs, rows, tenant="default", priority=0,
+                 deadline=None, seq=-1):
+        super().__init__(inputs, rows, priority=priority,
+                         deadline=deadline, seq=seq)
+        self.tenant = tenant
+        self.dispatches: Dict[int, int] = {}      # rid -> call id in flight
+        self.tried: set = set()                   # every rid ever tried
+        self.first_rid: Optional[int] = None
+        self.hedges = 0
+        self.hedge_rids: set = set()
+        self.won_by: Optional[int] = None
+        self._finalized = False
+
+
+class _ReplicaLink:
+    """Router side of one replica's socket: persistent connection, a
+    reader thread, and an ``id -> callback`` pending table.  Any
+    transport or framing error fails every pending call with
+    :class:`ReplicaUnavailable` and reports the link down — the router
+    ejects and the affected requests re-dispatch elsewhere."""
+
+    def __init__(self, rid: int, port: int, on_down, connect_timeout=2.0):
+        self.rid = rid
+        self.port = port
+        self._on_down = on_down
+        self._send_lock = threading.Lock()
+        self._pending: Dict[int, object] = {}
+        self._pending_lock = threading.Lock()
+        self._down = False
+        self._sock = socket.create_connection(("127.0.0.1", port),
+                                              timeout=connect_timeout)
+        self._sock.settimeout(None)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._reader = threading.Thread(target=self._read_loop,
+                                        name="mxt-router-link-%d" % rid,
+                                        daemon=True)
+        self._reader.start()
+
+    def call_async(self, call_id: int, header: dict, arrays, cb):
+        header = dict(header, id=call_id)   # the frame id IS the call id
+        if cb is not None:
+            with self._pending_lock:
+                if self._down:
+                    raise ReplicaUnavailable("replica %d link is down"
+                                             % self.rid)
+                self._pending[call_id] = cb
+        try:
+            with self._send_lock:
+                wire.send_msg(self._sock, header, arrays)
+        except (OSError, ConnectionError) as e:
+            with self._pending_lock:
+                self._pending.pop(call_id, None)
+            self._fail_link(e)
+            raise ReplicaUnavailable("replica %d send failed: %s"
+                                     % (self.rid, e))
+
+    def call_sync(self, call_id: int, header: dict, arrays=None,
+                  timeout: Optional[float] = None):
+        """Round-trip a control op; returns the reply header.  Raises the
+        reply's typed error, or :class:`ReplicaUnavailable`."""
+        box = {}
+        done = threading.Event()
+
+        def cb(hdr, arrs, exc):
+            box["hdr"], box["exc"] = hdr, exc
+            done.set()
+
+        self.call_async(call_id, header, arrays, cb)
+        if not done.wait(timeout):
+            with self._pending_lock:
+                self._pending.pop(call_id, None)
+            raise ReplicaUnavailable(
+                "replica %d did not answer %r within %.1fs"
+                % (self.rid, header.get("op"), timeout or 0))
+        if box.get("exc") is not None:
+            raise box["exc"]
+        hdr = box["hdr"]
+        if not hdr.get("ok"):
+            cls = _ERROR_TYPES.get(hdr.get("error"), ServingError)
+            raise cls(hdr.get("msg") or hdr.get("error") or "replica error")
+        return hdr
+
+    def _read_loop(self):
+        try:
+            while True:
+                header, arrays = wire.recv_msg(self._sock)
+                call_id = header.get("id")
+                with self._pending_lock:
+                    cb = self._pending.pop(call_id, None)
+                if cb is not None:
+                    try:
+                        cb(header, arrays, None)
+                    except Exception:
+                        pass    # a callback bug must not kill the link
+        except (OSError, ConnectionError, ValueError) as e:
+            self._fail_link(e)
+
+    def _fail_link(self, cause):
+        with self._pending_lock:
+            if self._down:
+                return
+            self._down = True
+            pending, self._pending = self._pending, {}
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        err = ReplicaUnavailable("replica %d link lost: %r"
+                                 % (self.rid, cause))
+        for cb in pending.values():
+            try:
+                cb(None, None, err)
+            except Exception:
+                pass
+        if self._on_down is not None:
+            try:
+                self._on_down(self.rid, cause)
+            except Exception:
+                pass
+
+    @property
+    def down(self):
+        return self._down
+
+    def close(self):
+        self._fail_link("router closed the link")
+
+
+class _Replica:
+    __slots__ = ("rid", "state", "digest", "beat_time", "link", "inflight",
+                 "last_canary", "eject_time", "eject_cause", "incarnation",
+                 "dispatch_count")
+
+    def __init__(self, rid):
+        self.rid = rid
+        self.state = JOINING
+        self.digest: dict = {}
+        self.beat_time = 0.0
+        self.link: Optional[_ReplicaLink] = None
+        self.inflight = 0
+        self.last_canary = 0.0
+        self.eject_time = 0.0
+        self.eject_cause = None
+        self.incarnation: Tuple = ()      # (pid, port) of the digest
+        self.dispatch_count = 0
+
+
+class FleetRouter:
+    """Replicated-serving front door (see module docstring).
+
+    ``quotas`` maps tenant name -> :class:`TenantPolicy` (or a kwargs
+    dict); unknown tenants get ``default_policy`` (env-derived when
+    None).  The router is fully client-side: any process that can read
+    the fleet dir and reach loopback can run one.
+    """
+
+    def __init__(self, fleet_dir: str, quotas=None, default_policy=None,
+                 stale_after=None, scan_interval=None, hedge_factor=None,
+                 hedge_min=None, hedge_max=None, retry_max=None,
+                 canary_timeout=None, drain_timeout=None,
+                 default_deadline=None, name="fleet"):
+        from .fleet import fleet_lane, events_path
+        self._fleet_dir = os.fspath(fleet_dir)
+        self._lane = fleet_lane(fleet_dir)
+        self._events_path = events_path(fleet_dir)
+        self._name = name
+        self._stale_after = (stale_after if stale_after is not None else
+                             _env_float("MXNET_TPU_FLEET_STALE_AFTER", 1.5))
+        self._scan_interval = (
+            scan_interval if scan_interval is not None
+            else _env_float("MXNET_TPU_FLEET_SCAN_INTERVAL", 0.1))
+        self._hedge_factor = (
+            hedge_factor if hedge_factor is not None
+            else _env_float("MXNET_TPU_FLEET_HEDGE_FACTOR", 1.5))
+        self._hedge_min = (hedge_min if hedge_min is not None
+                           else _env_float("MXNET_TPU_FLEET_HEDGE_MIN",
+                                           0.05))
+        self._hedge_max = (hedge_max if hedge_max is not None
+                           else _env_int("MXNET_TPU_FLEET_HEDGE_MAX", 1))
+        self._retry_max = (retry_max if retry_max is not None
+                           else _env_int("MXNET_TPU_FLEET_RETRY_MAX", 3))
+        self._canary_timeout = (
+            canary_timeout if canary_timeout is not None
+            else _env_float("MXNET_TPU_FLEET_CANARY_TIMEOUT", 5.0))
+        self._drain_timeout = (
+            drain_timeout if drain_timeout is not None
+            else _env_float("MXNET_TPU_FLEET_DRAIN_TIMEOUT", 30.0))
+        dl = (default_deadline if default_deadline is not None
+              else _env_float("MXNET_TPU_SERVE_DEFAULT_DEADLINE", 30.0))
+        self._default_deadline = dl if dl and dl > 0 else None
+
+        self._policies: Dict[str, TenantPolicy] = {}
+        for tenant, pol in (quotas or {}).items():
+            if isinstance(pol, dict):
+                pol = TenantPolicy(**pol)
+            self._policies[tenant] = pol
+        self._default_policy = default_policy or TenantPolicy.default()
+
+        self._lock = threading.RLock()
+        self._replicas: Dict[int, _Replica] = {}
+        self._tenant_inflight = collections.Counter()
+        self._counters = collections.Counter()
+        self._schema = None
+        self._seq = 0
+        self._swap_lock = threading.Lock()
+        self._events_lock = threading.Lock()
+        self._stop = threading.Event()
+
+        # timer heap drives hedges and deadline expiries
+        self._timers: List[Tuple[float, int, str, object]] = []
+        self._timer_cond = threading.Condition()
+        self._timer_seq = 0
+
+        self._scan_thread = threading.Thread(
+            target=self._scan_loop, name="mxt-router-scan", daemon=True)
+        self._timer_thread = threading.Thread(
+            target=self._timer_loop, name="mxt-router-timer", daemon=True)
+        self._scan_thread.start()
+        self._timer_thread.start()
+
+    # ------------------------------------------------------------------
+    # events + counters
+    # ------------------------------------------------------------------
+    def _event(self, event: str, **fields):
+        """One line into fleet-events.jsonl (tools/postmortem.py --fleet
+        renders the timeline) + a labeled telemetry counter."""
+        rec = {"t": time.time(), "event": event}
+        rec.update(fields)
+        try:
+            with self._events_lock, open(self._events_path, "a") as f:
+                f.write(json.dumps(rec, default=repr) + "\n")
+        except OSError:
+            pass
+        telemetry.count("fleet.events", event=event)
+        self._counters["event:" + event] += 1
+
+    # ------------------------------------------------------------------
+    # membership
+    # ------------------------------------------------------------------
+    def _scan_loop(self):
+        while not self._stop.is_set():
+            try:
+                self._scan_once()
+            except Exception:
+                pass            # membership must survive any single scan
+            self._stop.wait(self._scan_interval)
+
+    def _scan_once(self):
+        beats = self._lane.peers()
+        digests = self._lane.digests()
+        now = time.time()
+        for rid, digest in digests.items():
+            if digest.get("kind") != "serving":
+                continue
+            beat = beats.get(rid)
+            age = now - (beat["time"] if beat else digest.get("t", 0))
+            fresh = age <= self._stale_after
+            incarnation = (digest.get("pid"), digest.get("port"))
+            with self._lock:
+                r = self._replicas.get(rid)
+                if r is None:
+                    if not fresh:
+                        continue
+                    r = _Replica(rid)
+                    r.incarnation = incarnation
+                    self._replicas[rid] = r
+                    kind = "join"
+                else:
+                    r.digest = digest
+                    r.beat_time = now - age
+                    if self._schema is None and digest.get("schema"):
+                        self._schema = digest["schema"]
+                    if fresh and incarnation != r.incarnation:
+                        # same id, new process (supervisor relaunch) —
+                        # always canary the new incarnation immediately,
+                        # whatever state the old one died in
+                        if r.state != EJECTED:
+                            self._eject_locked(r, "relaunched")
+                        kind = "readmit"
+                    elif r.state == EJECTED:
+                        if fresh and now - r.last_canary > 1.0:
+                            kind = "readmit"
+                        else:
+                            continue
+                    elif r.state == JOINING and fresh:
+                        # canary in progress; retry if it evaporated
+                        # (link refused, reply lost) rather than wedging
+                        # in JOINING forever
+                        if (now - r.last_canary
+                                > max(1.0, self._canary_timeout)):
+                            kind = "join"
+                        else:
+                            continue
+                    elif not fresh:
+                        self._eject_locked(r, "stale",
+                                           detail="digest age %.2fs" % age)
+                        continue
+                    elif (r.state == READY
+                          and digest.get("health") == "BROKEN"):
+                        self._eject_locked(r, "broken",
+                                           detail="breaker open")
+                        continue
+                    else:
+                        continue
+                r.digest = digest
+                r.beat_time = now - age
+                r.incarnation = incarnation
+                r.state = JOINING
+                r.last_canary = now
+                if self._schema is None and digest.get("schema"):
+                    self._schema = digest["schema"]
+            self._canary(rid, digest, kind)
+
+    def _connect(self, rid: int, digest: dict) -> Optional[_ReplicaLink]:
+        port = digest.get("port")
+        if not port:
+            return None
+        try:
+            return _ReplicaLink(rid, int(port), self._on_link_down)
+        except OSError:
+            return None
+
+    def _canary(self, rid: int, digest: dict, kind: str):
+        """Round-trip a real request before taking live traffic."""
+        with self._lock:
+            r = self._replicas.get(rid)
+            if r is None or r.state != JOINING:
+                return
+            if r.link is None or r.link.down or r.link.port != digest.get(
+                    "port"):
+                if r.link is not None:
+                    r.link.close()
+                    r.link = None
+                link = self._connect(rid, digest)
+                if link is None:
+                    return      # next scan retries
+                r.link = link
+            link = r.link
+            schema = digest.get("schema") or self._schema
+        if not schema:
+            return
+        feed = {n: np.zeros([1] + list(schema["input_shapes"][n][1:]),
+                            np.dtype(schema["input_dtypes"][n]))
+                for n in schema["input_names"]}
+        call_id = self._next_id()
+
+        def cb(hdr, arrays, exc):
+            ok = exc is None and hdr is not None and hdr.get("ok")
+            with self._lock:
+                r = self._replicas.get(rid)
+                if r is None or r.state != JOINING:
+                    return
+                if ok:
+                    r.state = READY
+                else:
+                    self._eject_locked(
+                        r, "canary",
+                        detail=repr(exc) if exc is not None
+                        else hdr.get("error"))
+                    return
+            self._event(kind, replica=rid, port=digest.get("port"),
+                        pid=digest.get("pid"))
+            telemetry.count("fleet.joins", kind=kind)
+
+        try:
+            link.call_async(call_id, {
+                "op": "submit", "id": call_id, "priority": 1 << 20,
+                "deadline": self._canary_timeout, "canary": True}, feed, cb)
+            self._counters["canaries"] += 1
+        except ReplicaUnavailable:
+            pass                # link died instantly; scan will retry
+
+    def _on_link_down(self, rid: int, cause):
+        with self._lock:
+            r = self._replicas.get(rid)
+            if r is None or r.state == EJECTED:
+                return
+            self._eject_locked(r, "link", detail=repr(cause))
+
+    def _eject_locked(self, r: _Replica, cause: str, detail=None):
+        """Caller holds the lock.  In-flight dispatches on the dead link
+        fail via the link teardown, re-dispatching elsewhere."""
+        if r.state == EJECTED:
+            return
+        r.state = EJECTED
+        r.eject_time = time.time()
+        r.eject_cause = cause
+        link, r.link = r.link, None
+        self._counters["evictions"] += 1
+        telemetry.count("fleet.evictions", cause=cause)
+        # the event/link teardown must not run under the lock: link
+        # close fires pending callbacks that re-enter the router
+        threading.Thread(
+            target=self._finish_eject, args=(r.rid, cause, detail, link),
+            name="mxt-router-eject", daemon=True).start()
+
+    def _finish_eject(self, rid, cause, detail, link):
+        self._event("evict", replica=rid, cause=cause, detail=detail)
+        if link is not None:
+            link.close()
+
+    # ------------------------------------------------------------------
+    # admission + dispatch
+    # ------------------------------------------------------------------
+    def _policy(self, tenant: str) -> TenantPolicy:
+        pol = self._policies.get(tenant)
+        return pol if pol is not None else self._default_policy
+
+    def _next_id(self) -> int:
+        with self._lock:
+            self._seq += 1
+            return self._seq
+
+    def submit(self, inputs: Optional[Dict] = None, *, tenant="default",
+               priority: Optional[int] = None,
+               deadline: Optional[float] = None,
+               **kw_inputs) -> FleetRequest:
+        """Admit one request into the fleet; returns its future.  Raises
+        :class:`QuotaExceeded` at the tenant's quota,
+        :class:`ReplicaUnavailable` when no READY replica exists, and the
+        replica-side typed errors through ``result()``."""
+        if self._stop.is_set():
+            raise ServingError("router is closed")
+        policy = self._policy(tenant)
+        if not policy.try_acquire():
+            telemetry.count("fleet.shed", cause="quota", tenant=tenant)
+            self._counters["quota_shed"] += 1
+            raise QuotaExceeded(
+                "tenant %r is over its %.1f req/s quota" %
+                (tenant, policy.rate))
+        with self._lock:
+            if (policy.max_inflight is not None and
+                    self._tenant_inflight[tenant] >= policy.max_inflight):
+                telemetry.count("fleet.shed", cause="inflight",
+                                tenant=tenant)
+                self._counters["quota_shed"] += 1
+                raise QuotaExceeded(
+                    "tenant %r has %d requests in flight (cap %d)"
+                    % (tenant, self._tenant_inflight[tenant],
+                       policy.max_inflight))
+            schema = self._schema
+        if schema is None:
+            raise ReplicaUnavailable(
+                "no replica has published a schema yet — fleet empty?")
+        feed = dict(inputs or {})
+        feed.update(kw_inputs)
+        shapes = {n: tuple(schema["input_shapes"][n])
+                  for n in schema["input_names"]}
+        dtypes = {n: np.dtype(schema["input_dtypes"][n])
+                  for n in schema["input_names"]}
+        max_rows = int(next(iter(shapes.values()))[0])
+        arrays, rows = batcher.normalize_inputs(
+            feed, schema["input_names"], shapes, dtypes, max_rows)
+        rel = self._default_deadline if deadline is None else deadline
+        abs_deadline = (time.monotonic() + rel
+                        if rel is not None and rel > 0 else None)
+        req = FleetRequest(
+            arrays, rows, tenant=tenant,
+            priority=policy.priority if priority is None else int(priority),
+            deadline=abs_deadline, seq=self._next_id())
+        with self._lock:
+            self._tenant_inflight[tenant] += 1
+        self._counters["submitted"] += 1
+        try:
+            rid = self._dispatch(req)
+        except ServingError:
+            self._finish(req)
+            raise
+        if req.deadline is not None:
+            self._schedule(req.deadline, "expire", req)
+        self._schedule(time.monotonic() + self._hedge_delay(rid),
+                       "hedge", req)
+        return req
+
+    def predict(self, inputs: Optional[Dict] = None, *, tenant="default",
+                priority: Optional[int] = None,
+                deadline: Optional[float] = None,
+                **kw_inputs) -> List[np.ndarray]:
+        """Synchronous submit + wait (typed errors on shed/failure)."""
+        req = self.submit(inputs, tenant=tenant, priority=priority,
+                          deadline=deadline, **kw_inputs)
+        wait = None if req.deadline is None else req.remaining() + 5.0
+        return req.result(timeout=wait)
+
+    def _load_of(self, r: _Replica) -> float:
+        return r.inflight + (r.digest.get("queue_depth") or 0)
+
+    def _pick(self, req: FleetRequest) -> Optional[_Replica]:
+        """Least-loaded READY replica not yet tried; sticky tenants get
+        rendezvous-hash affinity while their preferred replica is
+        available.  Caller holds the lock."""
+        ready = [r for r in self._replicas.values()
+                 if r.state == READY and r.rid not in req.tried
+                 and r.link is not None and not r.link.down]
+        if not ready:
+            return None
+        policy = self._policy(req.tenant)
+        if policy.sticky:
+            def weight(r):
+                h = hashlib.blake2b(("%s|%s" % (req.tenant, r.rid))
+                                    .encode(), digest_size=8).digest()
+                return int.from_bytes(h, "big")
+            return max(ready, key=weight)
+        # least-loaded; dispatch count breaks ties so an idle fleet
+        # round-robins instead of pinning everything on one replica
+        return min(ready, key=lambda r: (self._load_of(r),
+                                         r.dispatch_count))
+
+    def _dispatch(self, req: FleetRequest) -> int:
+        """Send one copy of ``req`` to the best untried replica; returns
+        its rid or raises :class:`ReplicaUnavailable`/:class:`Overloaded`."""
+        with self._lock:
+            r = self._pick(req)
+            if r is None:
+                if req.tried:
+                    raise ReplicaUnavailable(
+                        "no further READY replica (tried %s)"
+                        % sorted(req.tried))
+                raise ReplicaUnavailable("no READY replica in the fleet")
+            call_id = self._seq = self._seq + 1
+            r.inflight += 1
+            r.dispatch_count += 1
+            req.dispatches[r.rid] = call_id
+            req.tried.add(r.rid)
+            if req.first_rid is None:
+                req.first_rid = r.rid
+            link = r.link
+            rid = r.rid
+        header = {"op": "submit", "id": call_id, "priority": req.priority,
+                  "deadline": req.remaining(), "tenant": req.tenant}
+        try:
+            link.call_async(
+                call_id, header, req.inputs,
+                lambda hdr, arrays, exc, _rid=rid, _cid=call_id:
+                self._on_reply(req, _rid, _cid, hdr, arrays, exc))
+        except ReplicaUnavailable:
+            with self._lock:
+                rr = self._replicas.get(rid)
+                if rr is not None and rr.inflight > 0:
+                    rr.inflight -= 1
+                req.dispatches.pop(rid, None)
+            raise
+        telemetry.count("fleet.dispatch", replica=str(rid))
+        self._counters["dispatched"] += 1
+        return rid
+
+    def _on_reply(self, req: FleetRequest, rid: int, call_id: int,
+                  hdr, arrays, exc):
+        with self._lock:
+            r = self._replicas.get(rid)
+            if r is not None and req.dispatches.get(rid) == call_id:
+                req.dispatches.pop(rid, None)
+                if r.inflight > 0:
+                    r.inflight -= 1
+            elif r is not None and r.inflight > 0:
+                r.inflight -= 1
+        if req.done or req._finalized:
+            return
+        if exc is None and hdr is not None and hdr.get("ok"):
+            outs = [arrays["out%d" % i]
+                    for i in range(int(hdr.get("n_outputs", 0)))]
+            self._complete_ok(req, outs, rid)
+            return
+        if exc is None:
+            name = hdr.get("error") if hdr is not None else "ServingError"
+            if name == "Cancelled":
+                return          # our own cancel echoing back
+            err = _ERROR_TYPES.get(name, ServingError)(
+                hdr.get("msg") or name if hdr is not None else name)
+        else:
+            err = exc
+        # replica-side shed or death: try the next replica while the
+        # deadline allows — THIS is how a killed replica's in-flight
+        # requests complete instead of timing out
+        retryable = isinstance(err, (ReplicaUnavailable, Overloaded,
+                                     CircuitOpen, ExecFailed))
+        if (retryable and not req.expired()
+                and len(req.tried) < self._retry_max):
+            try:
+                self._dispatch(req)
+                telemetry.count("fleet.redispatch",
+                                cause=type(err).__name__)
+                self._counters["redispatched"] += 1
+                return
+            except ServingError:
+                pass
+        if req.dispatches:
+            return              # another copy is still in flight; let it run
+        self._complete_err(req, err)
+
+    # ------------------------------------------------------------------
+    # completion
+    # ------------------------------------------------------------------
+    def _complete_ok(self, req: FleetRequest, outs, rid: int):
+        with self._lock:
+            if req._finalized:
+                return
+            req._finalized = True
+            req.won_by = rid
+        delivered = req._deliver(outs)      # late -> DeadlineExceeded inside
+        if delivered:
+            telemetry.count("fleet.requests", outcome="ok")
+            self._counters["ok"] += 1
+            if rid in req.hedge_rids:
+                telemetry.count("fleet.hedge", event="won")
+                self._counters["hedge_won"] += 1
+        else:
+            telemetry.count("fleet.requests", outcome="late")
+            self._counters["late"] += 1
+        self._finish(req, winner=rid)
+
+    def _complete_err(self, req: FleetRequest, err: BaseException):
+        with self._lock:
+            if req._finalized:
+                return
+            req._finalized = True
+        req._fail(err)
+        telemetry.count("fleet.requests", outcome="error",
+                        error=type(err).__name__)
+        self._counters["err:" + type(err).__name__] += 1
+        self._finish(req)
+
+    def _finish(self, req: FleetRequest, winner: Optional[int] = None):
+        """Decrement tenant in-flight; cancel losing copies."""
+        with self._lock:
+            if self._tenant_inflight[req.tenant] > 0:
+                self._tenant_inflight[req.tenant] -= 1
+            losers = [(rid, cid) for rid, cid in req.dispatches.items()
+                      if rid != winner]
+            links = {rid: self._replicas[rid].link
+                     for rid, _ in losers
+                     if rid in self._replicas
+                     and self._replicas[rid].link is not None}
+        for rid, cid in losers:
+            link = links.get(rid)
+            if link is None or link.down:
+                continue
+            try:
+                link.call_async(self._next_id(),
+                                {"op": "cancel", "id": None,
+                                 "target": cid}, None, None)
+            except ReplicaUnavailable:
+                pass
+
+    # ------------------------------------------------------------------
+    # timers: hedging + deadline expiry
+    # ------------------------------------------------------------------
+    def _schedule(self, when: float, kind: str, payload):
+        with self._timer_cond:
+            self._timer_seq += 1
+            heapq.heappush(self._timers,
+                           (when, self._timer_seq, kind, payload))
+            self._timer_cond.notify()
+
+    def _timer_loop(self):
+        while not self._stop.is_set():
+            with self._timer_cond:
+                now = time.monotonic()
+                while self._timers and self._timers[0][0] <= now:
+                    _, _, kind, payload = heapq.heappop(self._timers)
+                    try:
+                        if kind == "hedge":
+                            self._fire_hedge(payload)
+                        elif kind == "expire":
+                            self._fire_expiry(payload)
+                    except Exception:
+                        pass
+                wait = (self._timers[0][0] - now if self._timers else 0.5)
+                self._timer_cond.wait(min(max(wait, 0.001), 0.5))
+
+    def _hedge_delay(self, rid: int) -> float:
+        """When to mistrust a dispatch: the target replica's published
+        p95 (its own digest) × hedge_factor, floored at hedge_min."""
+        with self._lock:
+            r = self._replicas.get(rid)
+            d = (r.digest if r is not None else {}) or {}
+        p95_ms = (d.get("lat_ms") or {}).get("p95")
+        if p95_ms:
+            base = p95_ms / 1e3
+        elif d.get("exec_ewma_s"):
+            base = 2.0 * d["exec_ewma_s"]
+        else:
+            base = self._hedge_min
+        return max(self._hedge_min, base * self._hedge_factor)
+
+    def _fire_hedge(self, req: FleetRequest):
+        if req.done or req._finalized or req.hedges >= self._hedge_max:
+            return
+        if req.expired() or not req.dispatches:
+            return              # expiry timer / retry path owns it now
+        try:
+            rid = self._dispatch(req)
+        except ServingError:
+            return              # nobody to hedge to; original may still win
+        req.hedge_rids.add(rid)
+        req.hedges += 1
+        telemetry.count("fleet.hedge", event="fired")
+        self._counters["hedge_fired"] += 1
+        if req.hedges < self._hedge_max:
+            self._schedule(time.monotonic() + self._hedge_delay(rid),
+                           "hedge", req)
+
+    def _fire_expiry(self, req: FleetRequest):
+        if req.done or req._finalized:
+            return
+        self._complete_err(req, DeadlineExceeded(
+            "deadline passed with no replica result (tried %s)"
+            % sorted(req.tried)))
+
+    # ------------------------------------------------------------------
+    # rolling fleet swap
+    # ------------------------------------------------------------------
+    def swap_fleet(self, source, tag=None,
+                   swap_timeout: float = 60.0) -> List[int]:
+        """Drain → canary-swap → re-enroll one replica at a time.  Any
+        canary failure rolls back every already-swapped replica and
+        raises :class:`SwapFailed` — the old model never stops serving.
+        ``source`` is an artifact path (str) or a synthetic spec dict
+        (``{"batch":..., "scale":...}``, tests/benches).  Returns the
+        swapped rids."""
+        header = {"op": "swap", "tag": tag}
+        if isinstance(source, dict):
+            header["synthetic"] = source
+        else:
+            header["artifact"] = os.fspath(source)
+        with self._swap_lock:
+            with self._lock:
+                targets = sorted(r.rid for r in self._replicas.values()
+                                 if r.state == READY)
+            if not targets:
+                raise SwapFailed("no READY replica to swap")
+            swapped: List[int] = []
+            self._event("swap_begin", targets=targets, tag=tag)
+            for rid in targets:
+                try:
+                    self._drain(rid)
+                    with self._lock:
+                        r = self._replicas.get(rid)
+                        link = r.link if r is not None else None
+                    if link is None or link.down:
+                        raise ReplicaUnavailable(
+                            "replica %d lost during drain" % rid)
+                    link.call_sync(self._next_id(),
+                                   dict(header, id=None),
+                                   timeout=swap_timeout)
+                except ServingError as e:
+                    self._event("swap_fail", replica=rid, error=repr(e))
+                    self._undrain(rid)
+                    self._rollback_swapped(swapped)
+                    raise SwapFailed(
+                        "replica %d rejected the swap (%s); rolled back "
+                        "%d already-swapped replica(s) — the old model "
+                        "is still serving" % (rid, e, len(swapped)))
+                swapped.append(rid)
+                self._undrain(rid)
+                self._event("swap_ok", replica=rid, tag=tag)
+            self._event("swap_complete", replicas=swapped, tag=tag)
+            return swapped
+
+    def _drain(self, rid: int):
+        deadline = time.monotonic() + self._drain_timeout
+        with self._lock:
+            r = self._replicas.get(rid)
+            if r is None or r.state != READY:
+                raise ReplicaUnavailable("replica %d is not READY" % rid)
+            r.state = DRAINING
+        self._event("drain", replica=rid)
+        while time.monotonic() < deadline:
+            with self._lock:
+                r = self._replicas.get(rid)
+                if r is None or r.state != DRAINING:
+                    raise ReplicaUnavailable(
+                        "replica %d ejected while draining" % rid)
+                if r.inflight == 0:
+                    return
+            time.sleep(0.005)
+        raise ReplicaUnavailable(
+            "replica %d did not drain within %.1fs"
+            % (rid, self._drain_timeout))
+
+    def _undrain(self, rid: int):
+        with self._lock:
+            r = self._replicas.get(rid)
+            if r is not None and r.state == DRAINING:
+                r.state = READY
+
+    def _rollback_swapped(self, swapped: List[int]):
+        for rid in swapped:
+            with self._lock:
+                r = self._replicas.get(rid)
+                link = r.link if r is not None else None
+            if link is None or link.down:
+                continue
+            try:
+                link.call_sync(self._next_id(),
+                               {"op": "rollback", "id": None}, timeout=30.0)
+                self._event("rollback", replica=rid)
+            except ServingError as e:
+                self._event("rollback_fail", replica=rid, error=repr(e))
+
+    # ------------------------------------------------------------------
+    # introspection + lifecycle
+    # ------------------------------------------------------------------
+    def replicas(self) -> Dict[int, dict]:
+        with self._lock:
+            return {r.rid: {"state": r.state, "inflight": r.inflight,
+                            "dispatches": r.dispatch_count,
+                            "port": r.digest.get("port"),
+                            "pid": r.digest.get("pid"),
+                            "qps": r.digest.get("qps"),
+                            "queue_depth": r.digest.get("queue_depth"),
+                            "health": r.digest.get("health"),
+                            "eject_cause": r.eject_cause}
+                    for r in self._replicas.values()}
+
+    def num_ready(self) -> int:
+        with self._lock:
+            return sum(1 for r in self._replicas.values()
+                       if r.state == READY)
+
+    def wait_ready(self, n: int, timeout: float = 30.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.num_ready() >= n:
+                return True
+            time.sleep(0.02)
+        return False
+
+    def stats(self) -> dict:
+        with self._lock:
+            counters = dict(self._counters)
+            tenants = {t: n for t, n in self._tenant_inflight.items() if n}
+        return {"replicas": self.replicas(), "counters": counters,
+                "tenant_inflight": tenants}
+
+    def close(self):
+        self._stop.set()
+        with self._timer_cond:
+            self._timer_cond.notify_all()
+        with self._lock:
+            links = [r.link for r in self._replicas.values()
+                     if r.link is not None]
+            self._replicas.clear()
+        for link in links:
+            link.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -406,6 +406,11 @@ class ServingRuntime:
     def _exec_once(self, prog, packed, seq):
         chaos.maybe_exec_error(seq)
         chaos.maybe_slow_exec(seq)
+        # fleet drills: a replica that dies mid-batch (SIGKILL, nothing
+        # propagates) and a replica turned persistent straggler — both
+        # land inside the armed dispatch region like the real failures
+        chaos.maybe_replica_crash(seq)
+        chaos.maybe_hedge_lag(seq)
         return [np.asarray(o) for o in prog.forward(**packed)]
 
     def _dispatch(self, batch: List[Request]):

@@ -1,0 +1,106 @@
+"""Pickle-free socket framing for the serving fleet's replica protocol.
+
+One frame = a fixed header ``MXW1 | header_len:u32 | payload_len:u64``
+followed by a UTF-8 JSON header and the raw C-order bytes of zero or
+more numpy arrays.  The JSON header carries the array manifest
+(``_arrays: [{"name", "dtype", "shape"}]``) so the receiver can slice
+the payload back without evaluating anything — same discipline as the
+checkpoint container (resilience/container.py): structure travels as
+JSON, bulk data travels as raw bytes, and nothing on the wire is ever
+executed.
+
+The router and the replica server (router.py / replica.py) speak only
+this framing; a short read, a garbage magic, or an oversized header is a
+:class:`WireError` — the connection is torn down and the fleet's
+eviction/retry machinery takes over, never a hung ``recv``.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["WireError", "send_msg", "recv_msg", "MAGIC"]
+
+MAGIC = b"MXW1"
+_FIXED = struct.Struct("<4sIQ")
+# a header larger than this is corruption, not a request — refuse before
+# allocating (the payload bound is per-array, derived from the manifest)
+_MAX_HEADER = 1 << 20
+
+
+class WireError(ConnectionError):
+    """Framing violation (bad magic, truncated frame, manifest mismatch).
+    Subclasses ConnectionError: every caller already treats a broken
+    connection and a corrupt one identically — drop the replica link."""
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise WireError("connection closed mid-frame (%d/%d bytes)"
+                            % (len(buf), n))
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def send_msg(sock: socket.socket, header: Dict,
+             arrays: Optional[Dict[str, np.ndarray]] = None):
+    """Send one frame: ``header`` (JSON-able dict) + named arrays."""
+    arrays = arrays or {}
+    manifest = []
+    blobs = []
+    for name, arr in arrays.items():
+        arr = np.ascontiguousarray(arr)
+        manifest.append({"name": name, "dtype": arr.dtype.str,
+                         "shape": list(arr.shape)})
+        blobs.append(arr.tobytes())
+    header = dict(header)
+    header["_arrays"] = manifest
+    hdr = json.dumps(header, default=repr).encode("utf-8")
+    payload_len = sum(len(b) for b in blobs)
+    sock.sendall(_FIXED.pack(MAGIC, len(hdr), payload_len) + hdr
+                 + b"".join(blobs))
+
+
+def recv_msg(sock: socket.socket) -> Tuple[Dict, Dict[str, np.ndarray]]:
+    """Receive one frame; returns ``(header, {name: array})``.  Raises
+    :class:`WireError` on any framing violation, ``ConnectionError`` /
+    ``OSError`` on transport death."""
+    magic, hdr_len, payload_len = _FIXED.unpack(_recv_exact(sock,
+                                                            _FIXED.size))
+    if magic != MAGIC:
+        raise WireError("bad frame magic %r" % magic)
+    if hdr_len > _MAX_HEADER:
+        raise WireError("header length %d exceeds the %d-byte bound"
+                        % (hdr_len, _MAX_HEADER))
+    try:
+        header = json.loads(_recv_exact(sock, hdr_len).decode("utf-8"))
+    except ValueError as e:
+        raise WireError("unparseable frame header: %s" % e)
+    manifest: List[dict] = header.pop("_arrays", [])
+    expect = 0
+    metas = []
+    for m in manifest:
+        dtype = np.dtype(m["dtype"])
+        shape = tuple(int(d) for d in m["shape"])
+        size = int(dtype.itemsize * int(np.prod(shape, dtype=np.int64)))
+        metas.append((m["name"], dtype, shape, size))
+        expect += size
+    if expect != payload_len:
+        raise WireError("manifest wants %d payload bytes, frame carries %d"
+                        % (expect, payload_len))
+    payload = _recv_exact(sock, payload_len) if payload_len else b""
+    arrays = {}
+    off = 0
+    for name, dtype, shape, size in metas:
+        arrays[name] = np.frombuffer(
+            payload, dtype=dtype, count=size // dtype.itemsize if
+            dtype.itemsize else 0, offset=off).reshape(shape).copy()
+        off += size
+    return header, arrays

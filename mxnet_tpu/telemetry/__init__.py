@@ -37,7 +37,8 @@ from .registry import (DEFAULT_BUCKETS, Counter, Gauge, Histogram, arm,
                        metrics_window, observe, prometheus_text,
                        reset_metrics, set_gauge, snapshot, window_tick)
 from .spans import open_spans, record_span, span, spans_active
-from .digest import fleet_view, rank_digest, render_fleet
+from .digest import (fleet_view, rank_digest, render_fleet,
+                     replica_digest, serving_fleet_view)
 from . import perf
 from . import memory
 
@@ -47,7 +48,8 @@ __all__ = [
     "histogram", "is_armed", "metrics_window", "observe", "prometheus_text",
     "reset_metrics", "set_gauge", "snapshot", "window_tick",
     "open_spans", "record_span", "span", "spans_active",
-    "fleet_view", "rank_digest", "render_fleet",
+    "fleet_view", "rank_digest", "render_fleet", "replica_digest",
+    "serving_fleet_view",
     "perf", "memory",
 ]
 

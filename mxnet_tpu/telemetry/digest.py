@@ -12,12 +12,14 @@ builds everything on).
 """
 from __future__ import annotations
 
+import os
 import time
 from typing import Optional
 
 from . import registry as _registry
 
-__all__ = ["rank_digest", "fleet_view", "render_fleet"]
+__all__ = ["rank_digest", "replica_digest", "fleet_view",
+           "serving_fleet_view", "render_fleet"]
 
 # counters folded into the digest (name -> short digest key)
 _DIGEST_COUNTERS = (
@@ -83,6 +85,84 @@ def rank_digest(step: Optional[int] = None) -> dict:
     return d
 
 
+def replica_digest(runtime, replica_id: int, *, port=None, qps=None,
+                   model=None, schema=None) -> dict:
+    """A serving replica's compact digest for the fleet's coordination-KV
+    lane — the serving analog of :func:`rank_digest`, built from the
+    runtime's own stats (queue depth, breaker, latency percentiles) plus
+    the facts the ROUTER needs to dispatch: the listen port, the input
+    schema (published once so the router can normalize caller inputs
+    without a round trip), and the digest-informed p95 that hedging
+    re-dispatches against."""
+    st = runtime.stats()
+    d = {"t": time.time(), "kind": "serving", "replica": int(replica_id),
+         "pid": os.getpid(),
+         "health": st["health"],
+         "queue_depth": st["queue_depth"],
+         "queue_bound": st["queue_bound"],
+         "exec_ewma_s": st["exec_time_ewma_s"]}
+    if port is not None:
+        d["port"] = int(port)
+    if qps is not None:
+        d["qps"] = round(float(qps), 2)
+    if model is not None:
+        d["model"] = model
+    if schema is not None:
+        d["schema"] = schema
+    lat = st.get("latency_s")
+    if lat:
+        d["lat_ms"] = {k: round(1e3 * v, 3) for k, v in lat.items()}
+    br = st.get("breaker") or {}
+    if br.get("open") or br.get("failure_streak"):
+        d["breaker"] = {"open": bool(br.get("open")),
+                        "streak": br.get("failure_streak", 0)}
+    c = st.get("counters") or {}
+    counters = {k: c[k] for k in ("completed", "batches", "swaps",
+                                  "exec_failures") if c.get(k)}
+    shed = st.get("shed_overload", 0) + st.get("shed_expired", 0) + \
+        c.get("shed_circuit", 0)
+    if shed:
+        counters["shed"] = shed
+    if counters:
+        d["counters"] = counters
+    # memory plane: same live/peak columns as training ranks, so one
+    # fleet table shows who is near the red line on either plane
+    live = _registry.gauge("mem.live_bytes_total").value()
+    peak = _registry.gauge("mem.peak_live_bytes").value()
+    if live or peak:
+        d["mem_mb"] = {"live": round(live / 1e6, 1),
+                       "peak": round(peak / 1e6, 1)}
+    return d
+
+
+def serving_fleet_view(fleet_dir: Optional[str] = None) -> Optional[dict]:
+    """Merge every serving replica's heartbeat + digest from the fleet's
+    file-backed coordination-KV lane (serving/fleet.py) into one table —
+    the serving twin of :func:`fleet_view`.  ``fleet_dir`` defaults to
+    ``MXNET_TPU_FLEET_DIR``; returns None when no fleet is configured."""
+    fleet_dir = fleet_dir or os.environ.get("MXNET_TPU_FLEET_DIR")
+    if not fleet_dir:
+        return None
+    from ..serving.fleet import fleet_lane
+    lane = fleet_lane(fleet_dir)
+    beats = lane.peers()
+    digests = lane.digests()
+    now = time.time()
+    replicas = {}
+    for rid in sorted(set(beats) | set(digests)):
+        row = {}
+        b = beats.get(rid)
+        if b:
+            row["batches"] = b["step"]
+            row["age_sec"] = round(now - b["time"], 3)
+        d = digests.get(rid)
+        if d:
+            row["digest"] = d
+        replicas[str(rid)] = row
+    return {"time": now, "fleet_dir": os.fspath(fleet_dir),
+            "replicas": replicas}
+
+
 def _throughput() -> Optional[float]:
     """Steps/sec from the rolling window: train.steps delta over the
     oldest in-window snapshot.  None with <2 samples."""
@@ -135,10 +215,19 @@ def fleet_view() -> dict:
         if d:
             row["digest"] = d
         ranks[str(rank)] = row
-    return {"time": now, "generation": gen, "world_size": world,
+    view = {"time": now, "generation": gen, "world_size": world,
             "ranks": ranks, "ghosts": ghosts,
             "resize_events": _resize_events(lane),
             "straggler": lane.straggler_report()}
+    # serving replicas ride along when a fleet is configured
+    # (MXNET_TPU_FLEET_DIR), so ONE view covers both planes
+    try:
+        serving = serving_fleet_view()
+    except Exception:
+        serving = None
+    if serving and serving.get("replicas"):
+        view["serving"] = serving
+    return view
 
 
 def _resize_events(lane) -> list:
@@ -186,9 +275,11 @@ def render_fleet(view: Optional[dict] = None) -> str:
     if "generation" in view:
         lines.append("generation %s  world %s"
                      % (view.get("generation"), view.get("world_size")))
-    lines.append("rank  gen  step   age_s   p50_ms   p95_ms   tput/s  "
-                 "live_mb  peak_mb  counters")
-    for rank, row in sorted(view["ranks"].items(), key=lambda kv: int(kv[0])):
+    if "ranks" in view:
+        lines.append("rank  gen  step   age_s   p50_ms   p95_ms   tput/s  "
+                     "live_mb  peak_mb  counters")
+    for rank, row in sorted((view.get("ranks") or {}).items(),
+                            key=lambda kv: int(kv[0])):
         d = row.get("digest") or {}
         sm = d.get("step_ms") or {}
         mm = d.get("mem_mb") or {}
@@ -214,4 +305,23 @@ def render_fleet(view: Optional[dict] = None) -> str:
     if strag:
         lines.append("step-time straggler: rank %s (p50 skew x%.2f)"
                      % (strag.get("slowest_rank"), strag.get("skew", 0.0)))
+    serving = view.get("serving")
+    if serving is None and "replicas" in view:
+        serving = view          # a bare serving_fleet_view() renders too
+    if serving and serving.get("replicas"):
+        lines.append("serving replicas (%s):"
+                     % serving.get("fleet_dir", "?"))
+        lines.append("repl  health    age_s   qps     queue  p95_ms  "
+                     "done     shed")
+        for rid, row in sorted(serving["replicas"].items(),
+                               key=lambda kv: int(kv[0])):
+            d = row.get("digest") or {}
+            lat = d.get("lat_ms") or {}
+            c = d.get("counters") or {}
+            lines.append(
+                "%-5s %-9s %-7s %-7s %-6s %-7s %-8s %s"
+                % (rid, d.get("health", "-"), row.get("age_sec", "-"),
+                   d.get("qps", "-"), d.get("queue_depth", "-"),
+                   lat.get("p95", "-"), c.get("completed", "-"),
+                   c.get("shed", 0)))
     return "\n".join(lines)

@@ -550,6 +550,28 @@ def test_phases_block_and_report_carry_collective_bytes():
         assert "collective bytes by axis" in r.pretty()
 
 
+def test_benchwatch_extras_only_round(tmp_path):
+    """An audit-level round (the MULTICHIP_r06 shape) carries ONLY
+    ungated extras — appendable via the CLI's --extra, readable by the
+    gate, and never gated."""
+    bw = _load_tool("benchwatch")
+    ledger = str(tmp_path / "l.jsonl")
+    bw.append_entry(ledger, {"m": 100.0}, source="r1")
+    assert bw.main(["append", "--ledger", ledger,
+                    "--source", "MULTICHIP_rX",
+                    "--extra", "dp8_overlap_pct=100.0",
+                    "--extra", "dp8_optimizer_state_mb_per_device=5.59"]) \
+        == 0
+    entries = bw.read_ledger(ledger)
+    assert entries[-1]["metrics"] == {}
+    assert entries[-1]["extra"]["dp8_overlap_pct"] == 100.0
+    ok, results = bw.check_ledger(entries)
+    assert ok and "dp8_overlap_pct" not in results
+    # a round with neither metrics nor extras is still refused
+    with pytest.raises(ValueError):
+        bw.append_entry(ledger, {}, source="empty")
+
+
 def test_benchwatch_cli_regression_exit_code(tmp_path):
     bw = _load_tool("benchwatch")
     ledger = str(tmp_path / "ledger.jsonl")

@@ -107,9 +107,12 @@ def extract_extra(doc):
 
 def append_entry(ledger_path, metrics, source="", t=None, extra=None):
     """Append one round to the ledger (plain append: the ledger is an
-    event log, each line self-contained)."""
-    if not metrics:
-        raise ValueError("no metrics to append")
+    event log, each line self-contained).  A round may carry only
+    ``extra`` (ungated) fields — audit-level artifacts like the
+    MULTICHIP dryrun publish wire-bytes/overlap facts without any
+    throughput metric to gate."""
+    if not metrics and not extra:
+        raise ValueError("no metrics or extras to append")
     entry = {"t": time.time() if t is None else t, "source": source,
              "metrics": {k: float(v) for k, v in metrics.items()}}
     if extra:
@@ -217,6 +220,9 @@ def _cmd_append(args):
     for kv in args.metric or []:
         k, _, v = kv.partition("=")
         metrics[k] = float(v)
+    for kv in args.extra or []:
+        k, _, v = kv.partition("=")
+        extra[k] = float(v)
     entry = append_entry(args.ledger, metrics,
                          source=args.source or ",".join(sources),
                          extra=extra or None)
@@ -285,6 +291,10 @@ def main(argv=None):
                     metavar="JSON")
     ap.add_argument("--metric", action="append", default=[],
                     metavar="NAME=VALUE")
+    ap.add_argument("--extra", action="append", default=[],
+                    metavar="NAME=VALUE",
+                    help="recorded-but-not-gated fields (see the extra "
+                         "block note in the module docstring)")
     ap.add_argument("--source", default="")
     ap.add_argument("--sigma", type=float, default=SIGMA_MULT)
     ap.add_argument("--floor", type=float, default=FLOOR)

@@ -167,6 +167,58 @@ def print_elastic_timeline(target):
     return 0
 
 
+def find_fleet_events(target):
+    """The serving fleet's router event log (fleet-events.jsonl, written
+    by mxnet_tpu/serving/router.py into the fleet dir)."""
+    if os.path.isfile(target):
+        if target.endswith(".jsonl"):
+            return target
+        target = os.path.dirname(os.path.abspath(target))
+    path = os.path.join(target, "fleet-events.jsonl")
+    return path if os.path.isfile(path) else None
+
+
+def print_fleet_timeline(target):
+    """Render the serving fleet's membership/swap timeline: one line per
+    router event — replica joins, evictions (with cause), re-admissions
+    after relaunch, and the drain/swap/rollback steps of rolling swaps."""
+    path = find_fleet_events(target)
+    if not path:
+        print("no fleet-events.jsonl under %r" % target, file=sys.stderr)
+        return 1
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except ValueError:
+                print("unreadable event line: %r" % line[:80],
+                      file=sys.stderr)
+    hrule("=")
+    print("SERVING FLEET TIMELINE (%d event(s)): %s" % (len(events), path))
+    hrule("=")
+    print("%-20s %-14s %-8s %s" % ("time", "event", "replica", "detail"))
+    counts = {}
+    for e in events:
+        ev = e.get("event", "?")
+        counts[ev] = counts.get(ev, 0) + 1
+        detail = []
+        for key in ("cause", "detail", "port", "pid", "tag", "targets",
+                    "replicas", "error"):
+            if e.get(key) is not None:
+                detail.append("%s=%s" % (key, e[key]))
+        print("%-20s %-14s %-8s %s"
+              % (fmt_ts(e.get("t")), ev,
+                 e.get("replica", "-"), "  ".join(detail)))
+    hrule()
+    print("summary: " + "  ".join("%s=%d" % kv
+                                  for kv in sorted(counts.items())))
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("target", help="a post-mortem .json or a directory "
@@ -177,9 +229,15 @@ def main(argv=None):
                     help="render the elastic resize timeline from the "
                          "elastic-manifest-g*.json files instead of "
                          "(before) the watchdog reports")
+    ap.add_argument("--fleet", action="store_true",
+                    help="render the serving fleet's join/evict/swap "
+                         "timeline from fleet-events.jsonl (a fleet dir "
+                         "or the file itself)")
     args = ap.parse_args(argv)
     if args.elastic:
         return print_elastic_timeline(args.target)
+    if args.fleet:
+        return print_fleet_timeline(args.target)
     reports = find_reports(args.target)
     if not reports:
         print("no watchdog post-mortem reports under %r" % args.target,

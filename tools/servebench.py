@@ -24,6 +24,21 @@ Usage:
     --queue-depth N / --max-batch N / --linger S   runtime knobs
     --json             emit ONE JSON document on stdout (for CI smoke)
 
+Fleet mode (--replicas N) drives a replicated ServingFleet instead of a
+single in-process runtime: N replica processes behind the router
+(mxnet_tpu/serving/fleet.py), reporting fleet-level p50/p95/p99,
+per-replica QPS share, shed-by-cause, hedge/eviction counters, and a
+LATE-OK count (any OK result delivered past its deadline — the fleet's
+acceptance invariant is that this is always zero):
+
+    --replicas N       run N replica processes behind the fleet router
+    --kill-after S     SIGKILL one replica S seconds into the run (the
+                       kill-one-replica acceptance drill; the supervisor
+                       relaunches it and the router re-admits it)
+    --kill-slot K      which replica --kill-after kills (default 0)
+    --tenant-rate R    per-tenant quota for the synthetic tenants
+                       (default: unlimited)
+
 The measurement loop is stdlib-only (threading/time/statistics); chaos
 faults armed via MXNET_TPU_CHAOS (slow_exec/exec_error) apply to the
 dispatch path as in production, making this the serving drill driver.
@@ -76,9 +91,11 @@ def _percentiles(hist):
 
 class Collector:
     """Thread-safe outcome tally: ok latencies (into a telemetry
-    histogram) + typed-error counts."""
+    histogram) + typed-error counts + late-OK detection (an OK result
+    whose measured latency exceeds its deadline — the invariant both the
+    runtime and the fleet router promise is that this NEVER happens)."""
 
-    def __init__(self):
+    def __init__(self, deadline=None):
         from mxnet_tpu import telemetry
         self._lock = threading.Lock()
         # reservoir sized past any bench run so percentiles stay exact
@@ -87,6 +104,8 @@ class Collector:
                                         reservoir=1 << 17)
         self.errors = {}
         self.total = 0
+        self.late_ok = 0
+        self._deadline = deadline
 
     @property
     def ok(self):
@@ -95,6 +114,11 @@ class Collector:
     def record_ok(self, latency):
         with self._lock:
             self.total += 1
+            # small slack: the worker measures wall time around
+            # submit+result, which includes its own scheduling delay
+            if (self._deadline is not None
+                    and latency > self._deadline + 0.05):
+                self.late_ok += 1
         self.hist.observe(latency)
 
     def record_error(self, exc):
@@ -111,7 +135,8 @@ def _example(prog):
                         prog.input_dtypes[n]) for n in prog.input_names}
 
 
-def run_closed(rt, prog, args, collector, stop_at, priorities):
+def run_closed(rt, prog, args, collector, stop_at, priorities,
+               tenants=None):
     """Closed loop: each worker keeps exactly one request in flight."""
     example = _example(prog)
     counter = [0]
@@ -121,11 +146,14 @@ def run_closed(rt, prog, args, collector, stop_at, priorities):
         while time.monotonic() < stop_at:
             with lock:
                 counter[0] += 1
-                prio = priorities[counter[0] % len(priorities)]
+                n = counter[0]
+                prio = priorities[n % len(priorities)]
+            kw = {"priority": prio, "deadline": args.deadline}
+            if tenants:
+                kw["tenant"] = tenants[n % len(tenants)]
             t0 = time.monotonic()
             try:
-                req = rt.submit(dict(example), priority=prio,
-                                deadline=args.deadline)
+                req = rt.submit(dict(example), **kw)
                 req.result(timeout=args.deadline + 5.0)
                 collector.record_ok(time.monotonic() - t0)
             except Exception as e:
@@ -139,7 +167,7 @@ def run_closed(rt, prog, args, collector, stop_at, priorities):
         t.join(timeout=args.duration + 30.0)
 
 
-def run_open(rt, prog, args, collector, stop_at, priorities):
+def run_open(rt, prog, args, collector, stop_at, priorities, tenants=None):
     """Open loop: arrivals at a fixed rate regardless of completions —
     the load shape that actually exposes shedding behavior."""
     example = _example(prog)
@@ -154,11 +182,13 @@ def run_open(rt, prog, args, collector, stop_at, priorities):
             continue
         next_at += interval
         n += 1
+        kw = {"priority": priorities[n % len(priorities)],
+              "deadline": args.deadline}
+        if tenants:
+            kw["tenant"] = tenants[n % len(tenants)]
         t0 = time.monotonic()
         try:
-            req = rt.submit(dict(example),
-                            priority=priorities[n % len(priorities)],
-                            deadline=args.deadline)
+            req = rt.submit(dict(example), **kw)
             pending.append((t0, req))
         except Exception as e:
             collector.record_error(e)
@@ -169,6 +199,123 @@ def run_open(rt, prog, args, collector, stop_at, priorities):
                                 else time.monotonic() - t0)
         except Exception as e:
             collector.record_error(e)
+
+
+def _main_fleet(args):
+    """--replicas N: drive a replicated ServingFleet and report the
+    fleet-level view (percentiles, per-replica share, shed-by-cause,
+    hedge/eviction counters, late-OK invariant)."""
+    from mxnet_tpu.serving.fleet import ServingFleet
+
+    tenants = [t for t in args.tenants.split(",") if t]
+    quotas = ({t: {"rate": args.tenant_rate} for t in tenants}
+              if args.tenant_rate and tenants else None)
+    fleet = ServingFleet(
+        args.replicas,
+        artifact=args.artifact,
+        synthetic=(None if args.artifact else
+                   (args.batch, args.features, args.exec_latency)),
+        quotas=quotas)
+    prog = SyntheticProgram(args.batch, args.features, 0)
+    if args.artifact:
+        # mirror the fleet's real schema for input synthesis
+        schema = fleet.router._schema
+        prog.input_names = schema["input_names"]
+        prog.input_shapes = {n: tuple(schema["input_shapes"][n])
+                             for n in prog.input_names}
+        import numpy as np
+        prog.input_dtypes = {n: np.dtype(schema["input_dtypes"][n])
+                             for n in prog.input_names}
+    priorities = [int(p) for p in args.priorities.split(",")]
+    collector = Collector(deadline=args.deadline)
+    kill = {}
+    stop_at = time.monotonic() + args.duration
+
+    def killer():
+        time.sleep(args.kill_after)
+        kill["pid"] = fleet.kill_replica(args.kill_slot)
+        kill["slot"] = args.kill_slot
+        kill["at_s"] = round(args.kill_after, 3)
+        print("servebench: SIGKILLed replica %d (pid %s) at t+%.1fs"
+              % (args.kill_slot, kill["pid"], args.kill_after),
+              file=sys.stderr)
+
+    if args.kill_after is not None:
+        threading.Thread(target=killer, daemon=True).start()
+    t_start = time.monotonic()
+    try:
+        if args.mode == "closed":
+            run_closed(fleet.router, prog, args, collector, stop_at,
+                       priorities, tenants=tenants)
+        else:
+            run_open(fleet.router, prog, args, collector, stop_at,
+                     priorities, tenants=tenants)
+        # let an in-drill relaunch finish re-enrolling before snapshotting
+        if args.kill_after is not None:
+            fleet.router.wait_ready(args.replicas, timeout=15.0)
+    finally:
+        stats = fleet.stats()
+        fleet.close()
+    elapsed = time.monotonic() - t_start
+
+    n_ok = collector.ok
+    dispatches = {str(rid): r.get("dispatches", 0)
+                  for rid, r in stats["replicas"].items()}
+    total_disp = max(sum(dispatches.values()), 1)
+    c = stats["counters"]
+    shed_by_cause = {k[4:]: v for k, v in c.items()
+                     if k.startswith("err:")}
+    shed_by_cause.update({k: v for k, v in collector.errors.items()})
+    report = {
+        "mode": args.mode,
+        "replicas": args.replicas,
+        "duration_s": round(elapsed, 3),
+        "requests": collector.total,
+        "ok": n_ok,
+        "late_ok": collector.late_ok,
+        "throughput_rps": round(n_ok / max(elapsed, 1e-9), 1),
+        "errors": collector.errors,
+        "shed_by_cause": shed_by_cause,
+        "latency": _percentiles(collector.hist),
+        "per_replica_share": {rid: round(n / total_disp, 4)
+                              for rid, n in sorted(dispatches.items())},
+        "hedge": {"fired": c.get("hedge_fired", 0),
+                  "won": c.get("hedge_won", 0)},
+        "evictions": c.get("evictions", 0),
+        "redispatched": c.get("redispatched", 0),
+        "quota_shed": c.get("quota_shed", 0),
+        "ready_at_end": sum(1 for r in stats["replicas"].values()
+                            if r["state"] == "READY"),
+        "fleet_stats": stats,
+    }
+    if kill:
+        report["kill"] = kill
+    if args.json:
+        json.dump(report, sys.stdout, indent=2, sort_keys=True,
+                  default=repr)
+        print()
+        return 0
+    print("servebench: fleet of %d, %s loop, %.2fs"
+          % (args.replicas, args.mode, elapsed))
+    print("  requests        %d (ok %d, %.1f ok/s)  LATE OKs %d"
+          % (collector.total, n_ok, report["throughput_rps"],
+             collector.late_ok))
+    if report["latency"]:
+        print("  latency ms      p50 %(p50_ms)s  p95 %(p95_ms)s  "
+              "p99 %(p99_ms)s  max %(max_ms)s" % report["latency"])
+    print("  shed by cause   %s" % (report["shed_by_cause"] or "none"))
+    print("  replica share   %s" % report["per_replica_share"])
+    print("  hedges          fired %d, won %d; evictions %d, "
+          "redispatched %d, quota shed %d"
+          % (report["hedge"]["fired"], report["hedge"]["won"],
+             report["evictions"], report["redispatched"],
+             report["quota_shed"]))
+    if kill:
+        print("  kill drill      replica %(slot)s pid %(pid)s at "
+              "t+%(at_s)ss" % kill)
+    print("  ready at end    %d/%d" % (report["ready_at_end"],
+                                       args.replicas))
+    return 0
 
 
 def main(argv=None):
@@ -187,7 +334,22 @@ def main(argv=None):
     ap.add_argument("--max-batch", type=int, default=None)
     ap.add_argument("--linger", type=float, default=0.002)
     ap.add_argument("--json", action="store_true")
+    ap.add_argument("--replicas", type=int, default=0,
+                    help="fleet mode: N replica processes behind the "
+                         "router (0 = single in-process runtime)")
+    ap.add_argument("--kill-after", type=float, default=None,
+                    help="fleet mode: SIGKILL one replica this many "
+                         "seconds into the run (supervisor relaunches)")
+    ap.add_argument("--kill-slot", type=int, default=0)
+    ap.add_argument("--tenants", default="",
+                    help="fleet mode: tenant names cycled per request")
+    ap.add_argument("--tenant-rate", type=float, default=None,
+                    help="fleet mode: per-tenant token-bucket rate")
     args = ap.parse_args(argv)
+    if args.replicas:
+        return _main_fleet(args)
+    if args.kill_after is not None or args.tenants or args.tenant_rate:
+        ap.error("--kill-after/--tenants/--tenant-rate need --replicas N")
 
     from mxnet_tpu.serving import ServingRuntime
 
@@ -201,7 +363,7 @@ def main(argv=None):
                         default_deadline=args.deadline, name="servebench")
     prog = rt._program        # resolve artifact path -> loaded program
 
-    collector = Collector()
+    collector = Collector(deadline=args.deadline)
     depth_samples = []
     stop_at = time.monotonic() + args.duration
     sampling = [True]
@@ -234,6 +396,7 @@ def main(argv=None):
         "duration_s": round(elapsed, 3),
         "requests": collector.total,
         "ok": n_ok,
+        "late_ok": collector.late_ok,
         "throughput_rps": round(n_ok / max(elapsed, 1e-9), 1),
         "errors": collector.errors,
         "shed_rate": round(shed / max(collector.total, 1), 4),
