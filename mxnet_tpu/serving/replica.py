@@ -262,13 +262,19 @@ class ReplicaServer:
             with pending_lock:
                 pending[call_id] = req
         elif op == "cancel":
+            target = header.get("target")
             with pending_lock:
-                req = pending.pop(header.get("target"), None)
+                req = pending.pop(target, None)
             if req is not None:
                 req._fail(Cancelled("cancelled by router (hedge won "
                                     "elsewhere)"))
                 telemetry.count("serve.fleet.cancelled")
-            # no reply: cancel is fire-and-forget
+                # echo a Cancelled outcome for the CANCELLED call id —
+                # the cancel op itself gets no reply, but the router
+                # must see the target call settle (its Cancelled path is
+                # idempotent with the router-side loser reap)
+                reply({"id": target, "ok": False, "error": "Cancelled",
+                       "msg": "cancelled by router"})
         elif op == "stats":
             reply({"id": call_id, "ok": True, "stats": self._rt.stats(),
                    "replica": self._id})

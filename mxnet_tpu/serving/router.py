@@ -218,6 +218,13 @@ class _ReplicaLink:
             raise ReplicaUnavailable("replica %d send failed: %s"
                                      % (self.rid, e))
 
+    def forget(self, call_id: int):
+        """Drop a pending callback without firing it (the router reaped
+        this call's bookkeeping itself — a reply, if one ever comes, is
+        ignored instead of double-counted)."""
+        with self._pending_lock:
+            self._pending.pop(call_id, None)
+
     def call_sync(self, call_id: int, header: dict, arrays=None,
                   timeout: Optional[float] = None):
         """Round-trip a control op; returns the reply header.  Raises the
@@ -593,6 +600,9 @@ class FleetRouter:
                 "tenant %r is over its %.1f req/s quota" %
                 (tenant, policy.rate))
         with self._lock:
+            # cap check and increment in ONE critical section: two
+            # acquisitions would let concurrent submits race past the
+            # check and exceed the tenant's cap
             if (policy.max_inflight is not None and
                     self._tenant_inflight[tenant] >= policy.max_inflight):
                 telemetry.count("fleet.shed", cause="inflight",
@@ -602,28 +612,34 @@ class FleetRouter:
                     "tenant %r has %d requests in flight (cap %d)"
                     % (tenant, self._tenant_inflight[tenant],
                        policy.max_inflight))
-            schema = self._schema
-        if schema is None:
-            raise ReplicaUnavailable(
-                "no replica has published a schema yet — fleet empty?")
-        feed = dict(inputs or {})
-        feed.update(kw_inputs)
-        shapes = {n: tuple(schema["input_shapes"][n])
-                  for n in schema["input_names"]}
-        dtypes = {n: np.dtype(schema["input_dtypes"][n])
-                  for n in schema["input_names"]}
-        max_rows = int(next(iter(shapes.values()))[0])
-        arrays, rows = batcher.normalize_inputs(
-            feed, schema["input_names"], shapes, dtypes, max_rows)
-        rel = self._default_deadline if deadline is None else deadline
-        abs_deadline = (time.monotonic() + rel
-                        if rel is not None and rel > 0 else None)
-        req = FleetRequest(
-            arrays, rows, tenant=tenant,
-            priority=policy.priority if priority is None else int(priority),
-            deadline=abs_deadline, seq=self._next_id())
-        with self._lock:
             self._tenant_inflight[tenant] += 1
+            schema = self._schema
+        try:
+            if schema is None:
+                raise ReplicaUnavailable(
+                    "no replica has published a schema yet — fleet empty?")
+            feed = dict(inputs or {})
+            feed.update(kw_inputs)
+            shapes = {n: tuple(schema["input_shapes"][n])
+                      for n in schema["input_names"]}
+            dtypes = {n: np.dtype(schema["input_dtypes"][n])
+                      for n in schema["input_names"]}
+            max_rows = int(next(iter(shapes.values()))[0])
+            arrays, rows = batcher.normalize_inputs(
+                feed, schema["input_names"], shapes, dtypes, max_rows)
+            rel = self._default_deadline if deadline is None else deadline
+            abs_deadline = (time.monotonic() + rel
+                            if rel is not None and rel > 0 else None)
+            req = FleetRequest(
+                arrays, rows, tenant=tenant,
+                priority=(policy.priority if priority is None
+                          else int(priority)),
+                deadline=abs_deadline, seq=self._next_id())
+        except BaseException:
+            with self._lock:
+                if self._tenant_inflight[tenant] > 0:
+                    self._tenant_inflight[tenant] -= 1
+            raise
         self._counters["submitted"] += 1
         try:
             rid = self._dispatch(req)
@@ -674,6 +690,11 @@ class FleetRouter:
         """Send one copy of ``req`` to the best untried replica; returns
         its rid or raises :class:`ReplicaUnavailable`/:class:`Overloaded`."""
         with self._lock:
+            if req._finalized:
+                # a hedge/retry raced the finalize: _finish's loser reap
+                # already ran (it holds this lock), so a copy registered
+                # now would never be cancelled — refuse instead
+                raise Cancelled("request already finalized")
             r = self._pick(req)
             if r is None:
                 if req.tried:
@@ -716,8 +737,8 @@ class FleetRouter:
                 req.dispatches.pop(rid, None)
                 if r.inflight > 0:
                     r.inflight -= 1
-            elif r is not None and r.inflight > 0:
-                r.inflight -= 1
+            # else: _finish already reaped this dispatch (hedge loser) —
+            # decrementing again would double-count
         if req.done or req._finalized:
             return
         if exc is None and hdr is not None and hdr.get("ok"):
@@ -785,20 +806,31 @@ class FleetRouter:
         self._finish(req)
 
     def _finish(self, req: FleetRequest, winner: Optional[int] = None):
-        """Decrement tenant in-flight; cancel losing copies."""
+        """Decrement tenant in-flight; reap and cancel losing copies.
+
+        Cancel is fire-and-forget on the wire, so a loser's bookkeeping
+        cannot wait for a reply that may never come: reap it HERE, under
+        the lock — the replica's inflight, the request's dispatch entry,
+        and the link's pending callback — then tell the replica to drop
+        the work.  Without this, one won hedge leaves the loser's
+        inflight pinned forever: least-loaded dispatch skews away from it
+        and swap_fleet's drain (inflight == 0) can never complete."""
         with self._lock:
             if self._tenant_inflight[req.tenant] > 0:
                 self._tenant_inflight[req.tenant] -= 1
-            losers = [(rid, cid) for rid, cid in req.dispatches.items()
-                      if rid != winner]
-            links = {rid: self._replicas[rid].link
-                     for rid, _ in losers
-                     if rid in self._replicas
-                     and self._replicas[rid].link is not None}
-        for rid, cid in losers:
-            link = links.get(rid)
+            losers = []
+            for rid, cid in list(req.dispatches.items()):
+                if rid == winner:
+                    continue
+                req.dispatches.pop(rid, None)
+                r = self._replicas.get(rid)
+                if r is not None and r.inflight > 0:
+                    r.inflight -= 1
+                losers.append((cid, r.link if r is not None else None))
+        for cid, link in losers:
             if link is None or link.down:
                 continue
+            link.forget(cid)
             try:
                 link.call_async(self._next_id(),
                                 {"op": "cancel", "id": None,

@@ -17,6 +17,7 @@ eviction/retry machinery takes over, never a hung ``recv``.
 from __future__ import annotations
 
 import json
+import os
 import socket
 import struct
 from typing import Dict, List, Optional, Tuple
@@ -28,8 +29,14 @@ __all__ = ["WireError", "send_msg", "recv_msg", "MAGIC"]
 MAGIC = b"MXW1"
 _FIXED = struct.Struct("<4sIQ")
 # a header larger than this is corruption, not a request — refuse before
-# allocating (the payload bound is per-array, derived from the manifest)
+# allocating; the payload gets the same treatment (its length is a
+# frame-supplied u64, so a corrupt frame could otherwise force a
+# multi-GB allocation before any manifest check runs)
 _MAX_HEADER = 1 << 20
+try:
+    _MAX_PAYLOAD = int(os.environ["MXNET_TPU_WIRE_MAX_PAYLOAD"])
+except (KeyError, ValueError):
+    _MAX_PAYLOAD = 1 << 30
 
 
 class WireError(ConnectionError):
@@ -79,6 +86,9 @@ def recv_msg(sock: socket.socket) -> Tuple[Dict, Dict[str, np.ndarray]]:
     if hdr_len > _MAX_HEADER:
         raise WireError("header length %d exceeds the %d-byte bound"
                         % (hdr_len, _MAX_HEADER))
+    if payload_len > _MAX_PAYLOAD:
+        raise WireError("payload length %d exceeds the %d-byte bound"
+                        % (payload_len, _MAX_PAYLOAD))
     try:
         header = json.loads(_recv_exact(sock, hdr_len).decode("utf-8"))
     except ValueError as e:

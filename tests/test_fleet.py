@@ -103,6 +103,17 @@ def test_wire_roundtrip_and_framing_errors():
         a.close()
         b.close()
 
+    # an absurd payload_len is refused BEFORE any allocation: a corrupt
+    # frame must not be able to force a multi-GB buffer into existence
+    a, b = socket.socketpair()
+    try:
+        a.sendall(wire._FIXED.pack(wire.MAGIC, 2, (1 << 62)) + b"{}")
+        with pytest.raises(wire.WireError, match="payload length"):
+            wire.recv_msg(b)
+    finally:
+        a.close()
+        b.close()
+
 
 def test_file_kv_client_and_lane(tmp_path):
     kv = FileKVClient(str(tmp_path / "kv"))
@@ -283,6 +294,44 @@ def test_fleet_hedging_bounds_straggler_tail(tmp_path):
         # every request that landed on the straggler was rescued by its
         # hedge far below the 0.4s lag
         assert max(lat) < 0.3, "tail not bounded: max=%.3fs" % max(lat)
+    finally:
+        fleet.close()
+
+
+def test_hedge_losers_are_reaped_and_fleet_still_swaps(tmp_path):
+    """Regression: a cancelled hedge loser gets no reply from the
+    replica, so the router must reap its bookkeeping itself in _finish.
+    Before the fix, one won hedge left the loser's ``inflight`` pinned
+    at 1 forever — skewing least-loaded dispatch and wedging
+    ``swap_fleet`` (whose drain waits for inflight == 0)."""
+    fleet = _mk_fleet(
+        2, tmp_path, latency=0.005,
+        hedge_min=0.05, hedge_factor=1.5,
+        replica_env={1: {"MXNET_TPU_CHAOS": "hedge_lagx1000000",
+                         "MXNET_TPU_CHAOS_HEDGE_LAG_SECONDS": "0.4"}})
+    try:
+        x = _row()
+        for _ in range(10):
+            fleet.predict(data=x, deadline=2.0)
+        c = fleet.stats()["counters"]
+        assert c.get("hedge_won", 0) >= 1, c   # losers actually existed
+        # every loser's inflight must have been reaped at finish time,
+        # not parked waiting for a cancel reply that never comes
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            inflight = {rid: r["inflight"]
+                        for rid, r in fleet.stats()["replicas"].items()}
+            if all(n == 0 for n in inflight.values()):
+                break
+            time.sleep(0.02)
+        assert all(n == 0 for n in inflight.values()), \
+            "leaked inflight after won hedges: %r" % inflight
+        # and the drain-gated rolling swap still completes
+        swapped = fleet.swap({"batch": 4, "features": 3, "scale": 3.0},
+                             tag="post-hedge")
+        assert len(swapped) == 2
+        out = fleet.predict(data=x, deadline=2.0)
+        np.testing.assert_allclose(out[0][0], 3.0 * x, rtol=1e-6)
     finally:
         fleet.close()
 
