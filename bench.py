@@ -71,6 +71,17 @@ def _attach_phases(result, step, n_dev, step_time_s, tag):
     (telemetry/perf.py; needs the AOT-compiled step — BENCH_AUTO_LAYOUT=0
     skips it).  Never fails the bench."""
     try:
+        # ungated ledger extra (same deal as peak_hbm_bytes): total jit
+        # compile time this process paid, from the compile/ span family
+        # — attached even when attribution is skipped below
+        from mxnet_tpu.telemetry import tracing as _tracing
+        cs = _tracing.compile_summary()
+        if cs["count"]:
+            result["phases"] = {"compile_seconds": cs["total_seconds"],
+                                "compile_by_name": cs["by_name"]}
+    except Exception:
+        pass
+    try:
         if not hasattr(step, "as_text"):
             return
         from mxnet_tpu.telemetry import perf as _perf
@@ -84,6 +95,18 @@ def _attach_phases(result, step, n_dev, step_time_s, tag):
         result["phases"] = _perf.phases_block(rep, path)
     except Exception as e:
         result["phases"] = {"error": str(e)[:200]}
+    try:
+        # ungated ledger extra (same deal as peak_hbm_bytes): total jit
+        # compile time this process paid, from the compile/ span family
+        from mxnet_tpu.telemetry import tracing as _tracing
+        cs = _tracing.compile_summary()
+        if cs["count"]:
+            result.setdefault("phases", {})
+            if isinstance(result["phases"], dict):
+                result["phases"]["compile_seconds"] = cs["total_seconds"]
+                result["phases"]["compile_by_name"] = cs["by_name"]
+    except Exception:
+        pass
 
 
 def _maybe_ledger(result):

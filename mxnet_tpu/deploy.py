@@ -208,9 +208,18 @@ class ServedProgram:
     def load(cls, path):
         from . import telemetry
         name = "ServedProgram(%s)" % os.path.basename(os.fspath(path))
-        with telemetry.span("deploy/load", cat="deploy", path=str(path)):
+        # compile/ span family: deserializing the AOT executable is this
+        # path's compile point — it feeds the same compile.seconds
+        # histogram and ungated ledger extra as the trainer's jit
+        with telemetry.span("deploy/load", cat="deploy", path=str(path)), \
+                telemetry.span("compile/served_load", cat="compile",
+                               metric="compile.seconds",
+                               timed=True) as _cs:
             arrays, meta, blobs = read_container(path)
             prog = cls(arrays, meta, blobs)
+        telemetry.tracing.note_compile(
+            "served_load", _cs.duration,
+            artifact=os.path.basename(os.fspath(path)))
         telemetry.count("deploy.loads")
         # memory plane: served weights are a first-class HBM bucket (a
         # hot-swap briefly holds two models — the accounting shows it),

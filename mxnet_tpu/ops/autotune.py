@@ -178,10 +178,16 @@ def autotune(op: str, sig: Sequence, candidates: Iterable,
                        metric="autotune.trial_seconds", op=op,
                        config=str(cand)):
             try:
-                dt = float(measure(cand))
+                # a trial's cost is dominated by compiling the candidate
+                # block config — it belongs to the compile/ span family
+                with _tel.span("compile/autotune_trial", cat="compile",
+                               metric="compile.seconds", timed=True,
+                               op=op) as _cs:
+                    dt = float(measure(cand))
             except Exception:
                 _tel.count("autotune.failed_trials", op=op)
                 continue
+        _tel.tracing.note_compile("autotune_trial", _cs.duration, op=op)
         trials += 1
         _tel.count("autotune.trials", op=op)
         if best_s is None or dt < best_s:

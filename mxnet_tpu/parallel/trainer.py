@@ -16,6 +16,7 @@ pod slice (multi-host: same program, jax.distributed handles DCN).
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import Dict, List, Optional, Sequence
 
@@ -452,14 +453,20 @@ class ShardedTrainer:
         self._maybe_preflight(params, mom, aux, inputs)
         keys = self._keys()
         guard = self._guard_arrays()
+        from .. import telemetry as _tel
         with self.spec.mesh:
             jitted = jax.jit(step_fn, in_shardings=in_shardings,
                              out_shardings=out_shardings,
                              donate_argnums=(0, 1, 2, 5))
-            compiled = jitted.lower(
-                tuple(sds(p) for p in params), tuple(sds(m) for m in mom),
-                tuple(sds(a) for a in aux), inputs, sds(keys),
-                (sds(guard[0]), sds(guard[1]))).compile()
+            with _tel.span("compile/auto_layout", cat="compile",
+                           metric="compile.seconds", timed=True) as _cs:
+                compiled = jitted.lower(
+                    tuple(sds(p) for p in params),
+                    tuple(sds(m) for m in mom),
+                    tuple(sds(a) for a in aux), inputs, sds(keys),
+                    (sds(guard[0]), sds(guard[1]))).compile()
+        _tel.tracing.note_compile("train_step_auto_layout", _cs.duration,
+                                  symbol=self.symbol.name or "symbol")
         from ..telemetry import perf as _perf
         _perf.maybe_attribute(
             compiled,
@@ -549,7 +556,8 @@ class ShardedTrainer:
         from .audit import record_collective
         self._arm_mesh()
         remat = backward_mirror_policy()
-        if self._step is None or remat != self._built_remat:
+        fresh_program = self._step is None or remat != self._built_remat
+        if fresh_program:
             self._built_remat = remat
             self._step = self._build_step()
         self._step_count += 1
@@ -597,8 +605,25 @@ class ShardedTrainer:
                           for n, v in batch.items()}
                 _memory.tag(inputs, "batch", label="ShardedTrainer.step")
                 keys = self._keys()
-                params, mom, aux, loss, ok, guard = self._step(
-                    params, mom, aux, inputs, keys, self._guard_arrays())
+                # compile/ span family (ROADMAP item 5): the first call
+                # of a freshly-built jitted step is where trace + lower
+                # + compile happen (dispatch is async — the device time
+                # lands in train/device_wait, not here), so its duration
+                # IS the compile cost; timed=True keeps the ungated
+                # compile_seconds ledger extra working when disarmed
+                _cspan = (_tel.span("compile/train_step", cat="compile",
+                                    metric="compile.seconds", timed=True,
+                                    step=self._step_count)
+                          if fresh_program else contextlib.nullcontext())
+                with _cspan:
+                    params, mom, aux, loss, ok, guard = self._step(
+                        params, mom, aux, inputs, keys,
+                        self._guard_arrays())
+                if fresh_program:
+                    from ..telemetry import tracing as _tracing
+                    _tracing.note_compile(
+                        "train_step", _cspan.duration,
+                        symbol=self.symbol.name or "symbol")
                 self._guard_state = guard
             # host-enqueue vs device-block split: the dispatch above is
             # async; this wait is where device time (and a straggling

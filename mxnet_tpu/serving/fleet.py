@@ -48,11 +48,16 @@ from .errors import ServingError
 from .router import FleetRouter
 
 __all__ = ["ServingFleet", "ReplicaSupervisor", "fleet_lane",
-           "events_path", "KV_SUBDIR", "EVENTS_FILE", "CAPACITY_FILE"]
+           "events_path", "KV_SUBDIR", "EVENTS_FILE", "CAPACITY_FILE",
+           "ROUTER_RANK"]
 
 KV_SUBDIR = "kv"
 EVENTS_FILE = "fleet-events.jsonl"
 CAPACITY_FILE = "fleet-capacity.json"
+# the lane rank the ROUTER publishes its per-tenant SLO digest under —
+# far above any replica id, so replica rows and the router row never
+# collide in the KV (digest kind "router" vs "serving" disambiguates)
+ROUTER_RANK = 1 << 16
 
 
 def _env_float(name, default):
@@ -213,6 +218,14 @@ class ServingFleet:
                               os.path.dirname(os.path.abspath(__file__))))]
                           + os.environ.get("PYTHONPATH", "").split(
                               os.pathsep)).rstrip(os.pathsep)}
+        # distributed tracing: when this process armed tracing but no
+        # sink dir is pinned, every replica's trace sink lands in the
+        # fleet dir — one directory for tracewatch to merge
+        from ..telemetry import tracing
+        if tracing.is_armed():
+            env_common.setdefault("MXNET_TPU_TRACE", "1")
+            if not os.environ.get("MXNET_TPU_TRACE_DIR"):
+                env_common["MXNET_TPU_TRACE_DIR"] = self.fleet_dir
         self.supervisors: Dict[int, ReplicaSupervisor] = {}
         for slot in range(self.n_replicas):
             env = dict(env_common)

@@ -39,6 +39,7 @@ from typing import Dict
 import numpy as np
 
 from .. import telemetry
+from ..telemetry import tracing
 from .errors import Cancelled, ServingError, SwapFailed
 from .runtime import ServingRuntime
 from . import wire
@@ -123,6 +124,11 @@ class ReplicaServer:
         self._stop = threading.Event()
         self.exit_code = 0
         self._qps_prev = (time.monotonic(), 0)
+        if tracing.is_armed():
+            # every span this process records names the replica, and the
+            # sink sits in the fleet dir unless something pinned one
+            tracing.set_process_label("replica%d" % self._id)
+            tracing.set_sink_dir(fleet_dir)
 
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -191,6 +197,10 @@ class ReplicaServer:
                             done.append((call_id, req))
                             del pending[call_id]
                 for call_id, req in done:
+                    # the pending-pop above is this request's settle
+                    # point: its replica-side trace lanes record exactly
+                    # once, whatever its outcome
+                    tracing.record_served_request(req)
                     try:
                         self._send_outcome(reply, call_id, req)
                     except OSError:
@@ -228,6 +238,7 @@ class ReplicaServer:
                 pending.clear()
             for req in orphans:
                 req._fail(Cancelled("router connection closed"))
+                tracing.record_served_request(req)
             try:
                 conn.close()
             except OSError:
@@ -250,6 +261,11 @@ class ReplicaServer:
         call_id = header.get("id")
         if op == "submit":
             deadline = header.get("deadline")
+            # rebind the wire-propagated trace context (the router's
+            # dispatch span becomes this request's parent) BEFORE the
+            # request enters the runtime, so every serving phase lands
+            # in the right trace
+            ctx = tracing.from_wire(header.get("trace"))
             try:
                 req = self._rt.submit(
                     arrays, priority=int(header.get("priority", 0)),
@@ -259,6 +275,7 @@ class ReplicaServer:
                        "error": type(e).__name__,
                        "msg": _errmsg(e)})
                 return
+            req.trace = ctx
             with pending_lock:
                 pending[call_id] = req
         elif op == "cancel":
@@ -268,6 +285,7 @@ class ReplicaServer:
             if req is not None:
                 req._fail(Cancelled("cancelled by router (hedge won "
                                     "elsewhere)"))
+                tracing.record_served_request(req)
                 telemetry.count("serve.fleet.cancelled")
                 # echo a Cancelled outcome for the CANCELLED call id —
                 # the cancel op itself gets no reply, but the router

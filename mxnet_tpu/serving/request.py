@@ -25,6 +25,7 @@ class Request:
 
     __slots__ = ("inputs", "rows", "priority", "deadline", "enqueued_at",
                  "seq", "t_popped", "t_dispatched", "t_exec_done",
+                 "trace", "batch_seq",
                  "_event", "_outputs", "_error", "_done_at")
 
     def __init__(self, inputs: Dict[str, np.ndarray], rows: int,
@@ -41,6 +42,11 @@ class Request:
         self.t_popped: Optional[float] = None
         self.t_dispatched: Optional[float] = None
         self.t_exec_done: Optional[float] = None
+        # distributed tracing (telemetry/tracing.py): the wire-propagated
+        # trace context this request belongs to, and the executor batch
+        # it rode in — both None outside a traced fleet
+        self.trace = None
+        self.batch_seq: Optional[int] = None
         self._event = threading.Event()
         self._outputs: Optional[List[np.ndarray]] = None
         self._error: Optional[BaseException] = None

@@ -72,13 +72,14 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from .. import telemetry
+from ..telemetry import tracing
 from . import batcher, wire
 from .errors import (Cancelled, CircuitOpen, DeadlineExceeded, ExecFailed,
                      Overloaded, QuotaExceeded, ReplicaUnavailable,
                      ServingError, SwapFailed)
 from .request import Request
 
-__all__ = ["TenantPolicy", "FleetRouter", "FleetRequest",
+__all__ = ["TenantPolicy", "FleetRouter", "FleetRequest", "TenantSLO",
            "JOINING", "READY", "DRAINING", "EJECTED"]
 
 JOINING, READY, DRAINING, EJECTED = "JOINING", "READY", "DRAINING", "EJECTED"
@@ -157,10 +158,12 @@ class TenantPolicy:
 class FleetRequest(Request):
     """A router-side request: the PR-4 one-shot future (same deadline
     enforcement in ``_deliver``) plus the fleet bookkeeping — which
-    replicas hold copies, how many hedges fired, who won."""
+    replicas hold copies, how many hedges fired, who won — and, when
+    tracing is armed, the root trace context plus one open dispatch
+    span per in-flight copy."""
 
     __slots__ = ("tenant", "dispatches", "tried", "first_rid", "hedges",
-                 "hedge_rids", "_finalized", "won_by")
+                 "hedge_rids", "_finalized", "won_by", "dispatch_spans")
 
     def __init__(self, inputs, rows, tenant="default", priority=0,
                  deadline=None, seq=-1):
@@ -174,6 +177,89 @@ class FleetRequest(Request):
         self.hedge_rids: set = set()
         self.won_by: Optional[int] = None
         self._finalized = False
+        # call id -> (span_id, t0_monotonic, rid): open dispatch spans
+        self.dispatch_spans: Dict[int, tuple] = {}
+
+    @property
+    def trace_id(self) -> Optional[str]:
+        return self.trace.trace_id if self.trace is not None else None
+
+
+# deadline-budget-burn buckets: latency as a fraction of the request's
+# deadline budget — >1.0 means the budget was blown
+_BURN_BUCKETS = (0.05, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9,
+                 1.0, 1.25, 1.5, 2.0, 4.0)
+
+
+class TenantSLO:
+    """One tenant's SLO ledger at the router: latency + deadline-budget
+    burn histograms (always-on, per-router — the registry mirror under
+    ``fleet.tenant.*`` records when telemetry is armed), outcome counts,
+    and shed-by-cause counts.  Availability = ok / finished, where
+    finished excludes quota sheds (policy, not failure) but includes
+    deadline misses and errors."""
+
+    __slots__ = ("lat", "burn", "outcomes", "shed", "_lock")
+
+    def __init__(self):
+        self.lat = telemetry.Histogram("fleet.tenant.latency_seconds",
+                                       registered=False, always=True)
+        self.burn = telemetry.Histogram("fleet.tenant.deadline_budget_burn",
+                                        registered=False, always=True,
+                                        buckets=_BURN_BUCKETS)
+        # pre-register the armed-telemetry mirror with ratio buckets —
+        # the get-or-create in observe() would otherwise give the
+        # budget-burn metric latency-shaped buckets
+        telemetry.histogram("fleet.tenant.deadline_budget_burn",
+                            buckets=_BURN_BUCKETS)
+        self.outcomes = collections.Counter()
+        self.shed = collections.Counter()
+        self._lock = threading.Lock()
+
+    def note_shed(self, cause: str, tenant: str):
+        with self._lock:
+            self.shed[cause] += 1
+        telemetry.count("fleet.tenant.shed", cause=cause, tenant=tenant)
+
+    def note_outcome(self, outcome: str, latency, burn, tenant: str):
+        with self._lock:
+            self.outcomes[outcome] += 1
+        if latency is not None:
+            self.lat.observe(latency)
+            telemetry.observe("fleet.tenant.latency_seconds", latency,
+                              tenant=tenant)
+        if burn is not None:
+            self.burn.observe(burn)
+            telemetry.observe("fleet.tenant.deadline_budget_burn", burn,
+                              tenant=tenant)
+        telemetry.count("fleet.tenant.requests", outcome=outcome,
+                        tenant=tenant)
+
+    def summary(self) -> dict:
+        with self._lock:
+            outcomes = dict(self.outcomes)
+            shed = dict(self.shed)
+        ok = outcomes.get("ok", 0)
+        finished = sum(outcomes.values())
+        out = {"requests": finished + sum(shed.values()),
+               "ok": ok,
+               "outcomes": outcomes,
+               "shed": shed,
+               "availability": round(ok / finished, 4) if finished
+               else None}
+        lat = self.lat.summary()
+        if lat["count"]:
+            ps = self.lat.percentiles((0.50, 0.95, 0.99))
+            out["latency_ms"] = {"p50": round(1e3 * ps[0.50], 3),
+                                 "p95": round(1e3 * ps[0.95], 3),
+                                 "p99": round(1e3 * ps[0.99], 3)}
+        burn = self.burn.summary()
+        if burn["count"]:
+            ps = self.burn.percentiles((0.50, 0.95))
+            out["budget_burn"] = {"p50": round(ps[0.50], 4),
+                                  "p95": round(ps[0.95], 4),
+                                  "max": round(burn["max"], 4)}
+        return out
 
 
 class _ReplicaLink:
@@ -330,7 +416,7 @@ class FleetRouter:
                  hedge_min=None, hedge_max=None, retry_max=None,
                  canary_timeout=None, drain_timeout=None,
                  default_deadline=None, name="fleet"):
-        from .fleet import fleet_lane, events_path
+        from .fleet import ROUTER_RANK, events_path, fleet_lane
         self._fleet_dir = os.fspath(fleet_dir)
         self._lane = fleet_lane(fleet_dir)
         self._events_path = events_path(fleet_dir)
@@ -370,6 +456,7 @@ class FleetRouter:
         self._lock = threading.RLock()
         self._replicas: Dict[int, _Replica] = {}
         self._tenant_inflight = collections.Counter()
+        self._tenant_slo: Dict[str, TenantSLO] = {}
         self._counters = collections.Counter()
         self._schema = None
         self._seq = 0
@@ -381,6 +468,17 @@ class FleetRouter:
         self._timers: List[Tuple[float, int, str, object]] = []
         self._timer_cond = threading.Condition()
         self._timer_seq = 0
+
+        # distributed tracing: the router names itself in its sink and,
+        # if nothing pinned a sink dir yet, traces land in the fleet dir
+        # next to fleet-events.jsonl (tracewatch's default haystack)
+        if tracing.is_armed():
+            tracing.set_process_label("router")
+            tracing.set_sink_dir(self._fleet_dir)
+        # per-tenant SLO digest published onto the fleet lane so ANY
+        # process's render_fleet() can show the tenant table
+        self._pub_lane = fleet_lane(fleet_dir, rank=ROUTER_RANK)
+        self._pub_last = 0.0
 
         self._scan_thread = threading.Thread(
             target=self._scan_loop, name="mxt-router-scan", daemon=True)
@@ -394,9 +492,11 @@ class FleetRouter:
     # ------------------------------------------------------------------
     def _event(self, event: str, **fields):
         """One line into fleet-events.jsonl (tools/postmortem.py --fleet
-        renders the timeline) + a labeled telemetry counter."""
+        renders the timeline) + a labeled telemetry counter.  None-valued
+        fields are dropped (``trace`` is only present when tracing is
+        armed)."""
         rec = {"t": time.time(), "event": event}
-        rec.update(fields)
+        rec.update({k: v for k, v in fields.items() if v is not None})
         try:
             with self._events_lock, open(self._events_path, "a") as f:
                 f.write(json.dumps(rec, default=repr) + "\n")
@@ -414,7 +514,31 @@ class FleetRouter:
                 self._scan_once()
             except Exception:
                 pass            # membership must survive any single scan
+            try:
+                self._publish_slo()
+            except Exception:
+                pass
             self._stop.wait(self._scan_interval)
+
+    def _publish_slo(self, min_interval: float = 0.5):
+        """Publish the per-tenant SLO digest onto the fleet lane (the
+        ``kind: "router"`` twin of the replicas' serving digests) so
+        ``telemetry.render_fleet()`` in ANY process shows the tenant
+        table next to the replica table."""
+        now = time.time()
+        if now - self._pub_last < min_interval:
+            return
+        with self._lock:
+            slos = dict(self._tenant_slo)
+            submitted = int(self._counters.get("submitted", 0))
+        if not slos:
+            return
+        self._pub_last = now
+        digest = {"t": now, "kind": "router", "pid": os.getpid(),
+                  "name": self._name,
+                  "tenants": {t: s.summary() for t, s in sorted(
+                      slos.items())}}
+        self._pub_lane.beat(submitted, force=True, digest=digest)
 
     def _scan_once(self):
         beats = self._lane.peers()
@@ -577,6 +701,13 @@ class FleetRouter:
         pol = self._policies.get(tenant)
         return pol if pol is not None else self._default_policy
 
+    def _slo(self, tenant: str) -> TenantSLO:
+        with self._lock:
+            s = self._tenant_slo.get(tenant)
+            if s is None:
+                s = self._tenant_slo[tenant] = TenantSLO()
+            return s
+
     def _next_id(self) -> int:
         with self._lock:
             self._seq += 1
@@ -596,6 +727,7 @@ class FleetRouter:
         if not policy.try_acquire():
             telemetry.count("fleet.shed", cause="quota", tenant=tenant)
             self._counters["quota_shed"] += 1
+            self._slo(tenant).note_shed("quota", tenant)
             raise QuotaExceeded(
                 "tenant %r is over its %.1f req/s quota" %
                 (tenant, policy.rate))
@@ -608,6 +740,7 @@ class FleetRouter:
                 telemetry.count("fleet.shed", cause="inflight",
                                 tenant=tenant)
                 self._counters["quota_shed"] += 1
+                self._slo(tenant).note_shed("inflight", tenant)
                 raise QuotaExceeded(
                     "tenant %r has %d requests in flight (cap %d)"
                     % (tenant, self._tenant_inflight[tenant],
@@ -641,10 +774,16 @@ class FleetRouter:
                     self._tenant_inflight[tenant] -= 1
             raise
         self._counters["submitted"] += 1
+        # mint the trace HERE — the one place every fleet request passes
+        # exactly once; every dispatch/hedge/re-dispatch below becomes a
+        # child span of this context
+        req.trace = tracing.new_context()
         try:
             rid = self._dispatch(req)
-        except ServingError:
-            self._finish(req)
+        except ServingError as e:
+            # settle through the one completion path so the tenant SLO
+            # ledger and the root trace span see this shed too
+            self._complete_err(req, e)
             raise
         if req.deadline is not None:
             self._schedule(req.deadline, "expire", req)
@@ -711,8 +850,18 @@ class FleetRouter:
                 req.first_rid = r.rid
             link = r.link
             rid = r.rid
+            # open this copy's fleet/dispatch span; its context rides the
+            # wire header so the replica's spans nest under it.  The t0
+            # is MONOTONIC (same clock as the request's root span) so
+            # the router's lane nests exactly in the merged trace
+            dctx = tracing.child_context(req.trace)
+            if dctx is not None:
+                req.dispatch_spans[call_id] = (dctx.span_id,
+                                               time.monotonic(), rid)
         header = {"op": "submit", "id": call_id, "priority": req.priority,
                   "deadline": req.remaining(), "tenant": req.tenant}
+        if dctx is not None:
+            header["trace"] = dctx.to_wire()
         try:
             link.call_async(
                 call_id, header, req.inputs,
@@ -724,10 +873,29 @@ class FleetRouter:
                 if rr is not None and rr.inflight > 0:
                     rr.inflight -= 1
                 req.dispatches.pop(rid, None)
+            self._trace_dispatch_done(req, call_id,
+                                      "error:ReplicaUnavailable")
             raise
         telemetry.count("fleet.dispatch", replica=str(rid))
         self._counters["dispatched"] += 1
         return rid
+
+    def _trace_dispatch_done(self, req: FleetRequest, call_id: int,
+                             outcome: str):
+        """Settle one fleet/dispatch span (reply, loser reap, or send
+        failure).  Idempotent: the first settle pops the entry, so a
+        reaped loser's late reply records nothing."""
+        info = req.dispatch_spans.pop(call_id, None)
+        if info is None or req.trace is None:
+            return
+        sid, t0, rid = info
+        tracing.record(
+            "fleet/dispatch",
+            tracing.TraceContext(req.trace.trace_id, sid,
+                                 req.trace.span_id, req.trace.sampled),
+            tracing.mono_to_epoch(t0), time.monotonic() - t0, cat="fleet",
+            outcome=outcome, replica=rid, call=call_id,
+            hedge=rid in req.hedge_rids)
 
     def _on_reply(self, req: FleetRequest, rid: int, call_id: int,
                   hdr, arrays, exc):
@@ -739,19 +907,29 @@ class FleetRouter:
                     r.inflight -= 1
             # else: _finish already reaped this dispatch (hedge loser) —
             # decrementing again would double-count
+        ok = exc is None and hdr is not None and hdr.get("ok")
+        err_name = (type(exc).__name__ if exc is not None else
+                    (hdr.get("error") if hdr is not None
+                     else "ServingError") if not ok else None)
+        self._trace_dispatch_done(
+            req, call_id,
+            "ok" if ok else
+            "cancelled" if err_name == "Cancelled" else
+            "deadline" if err_name == "DeadlineExceeded" else
+            "error:%s" % err_name)
         if req.done or req._finalized:
             return
-        if exc is None and hdr is not None and hdr.get("ok"):
+        if ok:
             outs = [arrays["out%d" % i]
                     for i in range(int(hdr.get("n_outputs", 0)))]
             self._complete_ok(req, outs, rid)
             return
         if exc is None:
-            name = hdr.get("error") if hdr is not None else "ServingError"
-            if name == "Cancelled":
+            if err_name == "Cancelled":
                 return          # our own cancel echoing back
-            err = _ERROR_TYPES.get(name, ServingError)(
-                hdr.get("msg") or name if hdr is not None else name)
+            err = _ERROR_TYPES.get(err_name, ServingError)(
+                hdr.get("msg") or err_name if hdr is not None
+                else err_name)
         else:
             err = exc
         # replica-side shed or death: try the next replica while the
@@ -762,10 +940,13 @@ class FleetRouter:
         if (retryable and not req.expired()
                 and len(req.tried) < self._retry_max):
             try:
-                self._dispatch(req)
+                new_rid = self._dispatch(req)
                 telemetry.count("fleet.redispatch",
                                 cause=type(err).__name__)
                 self._counters["redispatched"] += 1
+                self._event("redispatch", replica=new_rid,
+                            from_replica=rid, cause=type(err).__name__,
+                            trace=req.trace_id, seq=req.seq)
                 return
             except ServingError:
                 pass
@@ -789,6 +970,8 @@ class FleetRouter:
             if rid in req.hedge_rids:
                 telemetry.count("fleet.hedge", event="won")
                 self._counters["hedge_won"] += 1
+                self._event("hedge_won", replica=rid,
+                            trace=req.trace_id, seq=req.seq)
         else:
             telemetry.count("fleet.requests", outcome="late")
             self._counters["late"] += 1
@@ -826,8 +1009,14 @@ class FleetRouter:
                 r = self._replicas.get(rid)
                 if r is not None and r.inflight > 0:
                     r.inflight -= 1
-                losers.append((cid, r.link if r is not None else None))
-        for cid, link in losers:
+                losers.append((rid, cid, r.link if r is not None else None))
+        for rid, cid, link in losers:
+            # the loser's dispatch span settles as cancelled HERE (its
+            # reply, if any, was forgotten below) and the cancellation
+            # lands in the fleet event log with its trace id
+            self._trace_dispatch_done(req, cid, "cancelled")
+            self._event("cancelled", replica=rid, trace=req.trace_id,
+                        seq=req.seq)
             if link is None or link.down:
                 continue
             link.forget(cid)
@@ -837,6 +1026,34 @@ class FleetRouter:
                                  "target": cid}, None, None)
             except ReplicaUnavailable:
                 pass
+        self._note_finished(req)
+
+    def _note_finished(self, req: FleetRequest):
+        """Tenant SLO ledger + the root ``fleet/request`` trace span —
+        runs exactly once per request (_finish is reached once, behind
+        the ``_finalized`` guards in ``_complete_ok``/``_complete_err``)."""
+        outcome = tracing.request_outcome(req)
+        lat = req.latency
+        burn = None
+        if req.deadline is not None and lat is not None:
+            budget = req.deadline - req.enqueued_at
+            if budget > 0:
+                burn = lat / budget
+        self._slo(req.tenant).note_outcome(
+            outcome, lat if outcome == "ok" else None, burn, req.tenant)
+        if req.trace is not None:
+            # the root span closes NOW — after every loser's dispatch
+            # span settled above — so the router's lane nests exactly;
+            # the caller-visible latency rides as an attribute
+            end = time.monotonic()
+            tracing.record(
+                "fleet/request", req.trace,
+                tracing.mono_to_epoch(req.enqueued_at),
+                end - req.enqueued_at, cat="fleet", outcome=outcome,
+                tenant=req.tenant, seq=req.seq, rows=req.rows,
+                priority=req.priority, hedges=req.hedges,
+                tried=sorted(req.tried), won_by=req.won_by,
+                latency_ms=None if lat is None else round(1e3 * lat, 3))
 
     # ------------------------------------------------------------------
     # timers: hedging + deadline expiry
@@ -892,6 +1109,8 @@ class FleetRouter:
         req.hedges += 1
         telemetry.count("fleet.hedge", event="fired")
         self._counters["hedge_fired"] += 1
+        self._event("hedge_fired", replica=rid, trace=req.trace_id,
+                    seq=req.seq)
         if req.hedges < self._hedge_max:
             self._schedule(time.monotonic() + self._hedge_delay(rid),
                            "hedge", req)
@@ -1026,8 +1245,11 @@ class FleetRouter:
         with self._lock:
             counters = dict(self._counters)
             tenants = {t: n for t, n in self._tenant_inflight.items() if n}
+            slos = dict(self._tenant_slo)
         return {"replicas": self.replicas(), "counters": counters,
-                "tenant_inflight": tenants}
+                "tenant_inflight": tenants,
+                "tenants": {t: s.summary() for t, s in sorted(
+                    slos.items())}}
 
     def close(self):
         self._stop.set()

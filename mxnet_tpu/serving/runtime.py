@@ -423,6 +423,9 @@ class ServingRuntime:
         now = time.monotonic()
         for r in batch:
             r.t_dispatched = now
+            r.batch_seq = seq      # which device dispatch carried it —
+            # rides into the request's trace spans so cross-request
+            # batching is visible in a merged fleet trace
         deadlines = [r.remaining() for r in batch if r.deadline is not None]
         margin = min(deadlines) if deadlines else None
         wd_timeout = self._exec_timeout
@@ -452,7 +455,9 @@ class ServingRuntime:
             telemetry.count("serve.exec_failures")
             err = ExecFailed("executor failed after %d attempt(s): %r"
                              % (self._retry_tries, e))
+            fail_t = time.monotonic()
             for r in batch:
+                r.t_exec_done = fail_t
                 if r.expired():
                     r._fail(DeadlineExceeded(
                         "deadline passed while the executor was failing"))

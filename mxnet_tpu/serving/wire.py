@@ -13,6 +13,13 @@ The router and the replica server (router.py / replica.py) speak only
 this framing; a short read, a garbage magic, or an oversized header is a
 :class:`WireError` — the connection is torn down and the fleet's
 eviction/retry machinery takes over, never a hung ``recv``.
+
+Reserved header keys: ``_arrays`` (the manifest, owned by this module)
+and ``trace`` (the distributed-tracing context —
+``telemetry.tracing.TraceContext.to_wire()`` on the sending side,
+``from_wire`` on the receiving side; absent when tracing is disarmed,
+and never required: a frame with a garbage ``trace`` value still
+serves, it just drops out of the trace).
 """
 from __future__ import annotations
 

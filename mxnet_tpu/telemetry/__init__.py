@@ -21,6 +21,10 @@ Three pieces (full catalog + knobs in docs/observability.md):
   perf's time axis): tagged live-HBM accounting, per-program memory
   breakdowns, OOM forensics + leak watchdog
   (``MXNET_TPU_MEMWATCH*``).
+* :mod:`.tracing` — distributed request tracing (``MXNET_TPU_TRACE=1``):
+  trace contexts minted at the fleet router, propagated over the wire,
+  rebound in replicas; per-process bounded JSONL sinks merged into ONE
+  Perfetto trace by ``tools/tracewatch.py``.
 
 Quick start::
 
@@ -41,6 +45,7 @@ from .digest import (fleet_view, rank_digest, render_fleet,
                      replica_digest, serving_fleet_view)
 from . import perf
 from . import memory
+from . import tracing
 
 __all__ = [
     "DEFAULT_BUCKETS", "Counter", "Gauge", "Histogram", "arm", "count",
@@ -50,13 +55,15 @@ __all__ = [
     "open_spans", "record_span", "span", "spans_active",
     "fleet_view", "rank_digest", "render_fleet", "replica_digest",
     "serving_fleet_view",
-    "perf", "memory",
+    "perf", "memory", "tracing",
 ]
 
 
 def reset():
     """Full test reset: metrics, window, arm state (spans' open tables
     are self-healing — they empty as spans exit); the memory plane's
-    tags/timeline/peak reset with it."""
+    tags/timeline/peak and the tracing plane's sink/arm state reset
+    with it."""
     reset_metrics()
     memory.reset()
+    tracing.reset()

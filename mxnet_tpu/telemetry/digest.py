@@ -149,6 +149,7 @@ def serving_fleet_view(fleet_dir: Optional[str] = None) -> Optional[dict]:
     digests = lane.digests()
     now = time.time()
     replicas = {}
+    router = None
     for rid in sorted(set(beats) | set(digests)):
         row = {}
         b = beats.get(rid)
@@ -158,9 +159,17 @@ def serving_fleet_view(fleet_dir: Optional[str] = None) -> Optional[dict]:
         d = digests.get(rid)
         if d:
             row["digest"] = d
+        if d and d.get("kind") == "router":
+            # the router's per-tenant SLO digest rides the same lane
+            # under ROUTER_RANK — it is not a replica row
+            router = dict(row)
+            continue
         replicas[str(rid)] = row
-    return {"time": now, "fleet_dir": os.fspath(fleet_dir),
+    view = {"time": now, "fleet_dir": os.fspath(fleet_dir),
             "replicas": replicas}
+    if router is not None:
+        view["router"] = router
+    return view
 
 
 def _throughput() -> Optional[float]:
@@ -324,4 +333,25 @@ def render_fleet(view: Optional[dict] = None) -> str:
                    d.get("qps", "-"), d.get("queue_depth", "-"),
                    lat.get("p95", "-"), c.get("completed", "-"),
                    c.get("shed", 0)))
+    # per-tenant SLO table from the router's lane digest (fleet router
+    # publishes it under ROUTER_RANK; serving/router.py TenantSLO)
+    tenants = (((serving or {}).get("router") or {}).get("digest")
+               or {}).get("tenants")
+    if tenants:
+        lines.append("tenant SLO (router):")
+        lines.append("tenant      req      ok       avail   p50_ms  "
+                     "p95_ms  burn_p95  shed")
+        for name, t in sorted(tenants.items()):
+            lat = t.get("latency_ms") or {}
+            burn = t.get("budget_burn") or {}
+            shed = t.get("shed") or {}
+            avail = t.get("availability")
+            lines.append(
+                "%-11s %-8s %-8s %-7s %-7s %-7s %-9s %s"
+                % (name, t.get("requests", "-"), t.get("ok", "-"),
+                   "-" if avail is None else "%.1f%%" % (100 * avail),
+                   lat.get("p50", "-"), lat.get("p95", "-"),
+                   burn.get("p95", "-"),
+                   " ".join("%s=%d" % kv for kv in sorted(shed.items()))
+                   or "0"))
     return "\n".join(lines)

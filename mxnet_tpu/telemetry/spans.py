@@ -51,9 +51,12 @@ def _stack() -> List[dict]:
 
 
 def spans_active() -> bool:
-    """True when spans record anywhere (telemetry armed OR profiler
-    running) — the single gate the hot path checks."""
+    """True when spans record anywhere (telemetry armed, tracing armed,
+    OR profiler running) — the single gate the hot path checks."""
     if _registry.is_armed():
+        return True
+    from . import tracing as _tracing
+    if _tracing.is_armed():
         return True
     from .. import profiler
     return profiler.is_running()
@@ -115,6 +118,12 @@ class span:
                                   args=self.attrs or None)
         if self.metric is not None and _registry.is_armed():
             _registry.observe(self.metric, self.duration)
+        from . import tracing as _tracing
+        if _tracing.is_armed():
+            # a thread bound to a trace context (tracing.bind) donates
+            # its ordinary spans to the distributed trace too
+            _tracing.note_span(self.name, self.cat, self._entry["start"],
+                               self.duration, self.attrs)
         return False
 
 

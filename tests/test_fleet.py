@@ -500,7 +500,8 @@ def _run_servebench(extra, timeout=120):
 def test_servebench_fleet_smoke():
     rep = _run_servebench(["--replicas", "2", "--duration", "1.5",
                            "--exec-latency", "0.004",
-                           "--concurrency", "4", "--deadline", "0.5"])
+                           "--concurrency", "4", "--deadline", "0.5",
+                           "--tenants", "search,ads"])
     assert rep["replicas"] == 2
     assert rep["ok"] > 20 and rep["late_ok"] == 0
     assert rep["ready_at_end"] == 2
@@ -508,6 +509,14 @@ def test_servebench_fleet_smoke():
     assert set(share) == {"0", "1"}
     assert abs(share["0"] - share["1"]) < 0.5      # both replicas served
     assert "p99_ms" in rep["latency"]
+    # per-tenant SLO block (additive schema): both synthetic tenants
+    # show availability + budget burn, nobody shed
+    tenants = rep["tenants"]
+    assert set(tenants) == {"search", "ads"}
+    for t in tenants.values():
+        assert t["availability"] == 1.0
+        assert t["budget_burn"]["p95"] < 1.0
+        assert "latency_ms" in t
 
 
 def test_postmortem_fleet_renders_timeline(tmp_path):

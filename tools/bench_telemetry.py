@@ -27,13 +27,17 @@ sys.path.insert(0, _REPO)
 def bench_disarmed_gates(n=20000):
     """Per-step disarmed telemetry cost: the 3 spans + 1 counter + 1
     window tick ShardedTrainer.step issues, PLUS the memory-plane hooks
-    it gained in ISSUE 7 (oom_guard frame, batch tag, note_step) — the
-    gate bound covers the whole instrumented surface."""
+    it gained in ISSUE 7 (oom_guard frame, batch tag, note_step) and the
+    tracing-plane gates from ISSUE 12 (context mint + request-lane
+    emission, both no-ops while MXNET_TPU_TRACE is off) — the gate
+    bound covers the whole instrumented surface."""
     from mxnet_tpu import telemetry
-    from mxnet_tpu.telemetry import memory
+    from mxnet_tpu.telemetry import memory, tracing
     telemetry.disarm()
+    tracing.disarm()
     memory.reset()
     fake_batch = {"data": None, "softmax_label": None}
+    req = _settled_request()
     t0 = time.perf_counter()
     for i in range(n):
         with memory.oom_guard("bench/step", step=i), \
@@ -46,8 +50,81 @@ def bench_disarmed_gates(n=20000):
         memory.note_step(i)
         telemetry.count("train.steps")
         telemetry.window_tick()
+        tracing.new_context()                  # router-side disarmed gate
+        tracing.record_served_request(req)     # replica-side disarmed gate
     per_step = (time.perf_counter() - t0) / n
     return per_step
+
+
+def _settled_request():
+    """A pre-settled serving Request (no runtime, no device) — the shape
+    the replica's trace emission walks."""
+    from mxnet_tpu.serving.request import Request
+    req = Request({"data": None}, 1, priority=0,
+                  deadline=time.monotonic() + 60.0)
+    now = time.monotonic()
+    req.t_popped = now
+    req.t_dispatched = now
+    req.t_exec_done = now
+    req.batch_seq = 1
+    req._outputs = []
+    req._done_at = now
+    req._event.set()
+    return req
+
+
+def bench_tracing_armed(n=2000):
+    """Armed-with-sampling per-request tracing cost: the router's mint +
+    wire round trip + dispatch/root span records plus the replica's
+    request-lane emission (six line-buffered sink appends total) — the
+    FULL tracing work one fleet request causes, measured end to end
+    against a real tmp-dir sink."""
+    import tempfile
+    from mxnet_tpu.telemetry import tracing
+    tracing.reset()
+    tracing.arm(sample=1.0)
+    tracing.set_sink_dir(tempfile.mkdtemp(prefix="bench-trace-"))
+    req = _settled_request()
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ctx = tracing.new_context()
+        dctx = ctx.child()
+        req.trace = tracing.from_wire(dctx.to_wire())
+        tracing.record_served_request(req)
+        tracing.record("fleet/dispatch", dctx, time.time(), 1e-3,
+                       outcome="ok", replica=0)
+        tracing.record("fleet/request", ctx, time.time(), 1e-3,
+                       outcome="ok", tenant="bench")
+    per_req = (time.perf_counter() - t0) / n
+    tracing.reset()
+    return per_req
+
+
+def bench_request_latency(n=150):
+    """Median in-process serving request latency (synthetic 2 ms
+    executor — servebench's default) as the denominator the tracing
+    overhead is judged against; a real fleet request costs MORE (two
+    wire hops), so this is the conservative bound."""
+    import numpy as np
+    from mxnet_tpu.serving import ServingRuntime
+
+    class _Prog:
+        input_names = ["data"]
+        input_shapes = {"data": (8, 16)}
+        input_dtypes = {"data": np.dtype(np.float32)}
+
+        def forward(self, data):
+            time.sleep(0.002)
+            return [data]
+
+    lat = []
+    with ServingRuntime(_Prog(), name="bench-trace") as rt:
+        x = np.zeros((16,), np.float32)
+        for _ in range(n):
+            t0 = time.perf_counter()
+            rt.predict({"data": x}, deadline=5.0)
+            lat.append(time.perf_counter() - t0)
+    return statistics.median(lat)
 
 
 def bench_trainer_step(steps=30, armed=False):
@@ -94,6 +171,14 @@ def main(argv=None):
     gate = bench_disarmed_gates()
     print("disarmed telemetry gates: %.2f us / step" % (gate * 1e6))
 
+    trace_cost = bench_tracing_armed()
+    req_lat = bench_request_latency()
+    trace_frac = trace_cost / req_lat
+    print("tracing armed (sample=1.0): %.2f us / request, vs %.3f ms "
+          "request -> %.4f%% (gate < 2%%: %s)"
+          % (trace_cost * 1e6, req_lat * 1e3, 100 * trace_frac,
+             "PASS" if trace_frac < 0.02 else "FAIL"))
+
     disarmed = bench_trainer_step(args.steps, armed=False)
     armed = bench_trainer_step(args.steps, armed=True)
     frac = gate / disarmed
@@ -101,7 +186,8 @@ def main(argv=None):
           % (disarmed * 1e3, armed * 1e3))
     print("disarmed gate overhead: %.4f%% of step time (gate < 2%%: %s)"
           % (100 * frac, "PASS" if frac < 0.02 else "FAIL"))
-    return 0 if frac < 0.02 else 1
+    ok = frac < 0.02 and trace_frac < 0.02
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

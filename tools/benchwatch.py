@@ -98,6 +98,11 @@ def extract_extra(doc):
         for field in ("peak_hbm_bytes", "collective_bytes_per_step"):
             if isinstance(phases.get(field), (int, float)):
                 out[field] = int(phases[field])
+        # compile time (ROADMAP item 5): seconds, fractional — ungated
+        # like the byte extras (a compile-time improvement is a drop)
+        if isinstance(phases.get("compile_seconds"), (int, float)):
+            out["compile_seconds"] = round(
+                float(phases["compile_seconds"]), 6)
     sub = doc.get("transformer")
     if isinstance(sub, dict):
         for k, v in extract_extra(sub).items():

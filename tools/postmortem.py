@@ -181,7 +181,11 @@ def find_fleet_events(target):
 def print_fleet_timeline(target):
     """Render the serving fleet's membership/swap timeline: one line per
     router event — replica joins, evictions (with cause), re-admissions
-    after relaunch, and the drain/swap/rollback steps of rolling swaps."""
+    after relaunch, the drain/swap/rollback steps of rolling swaps, and
+    the per-request tail-tolerance events (hedge_fired / hedge_won /
+    cancelled losers / redispatch), each carrying its trace id when
+    distributed tracing was armed (feed the id to tools/tracewatch.py
+    --request for the full cross-process span tree)."""
     path = find_fleet_events(target)
     if not path:
         print("no fleet-events.jsonl under %r" % target, file=sys.stderr)
@@ -207,7 +211,7 @@ def print_fleet_timeline(target):
         counts[ev] = counts.get(ev, 0) + 1
         detail = []
         for key in ("cause", "detail", "port", "pid", "tag", "targets",
-                    "replicas", "error"):
+                    "replicas", "error", "from_replica", "seq", "trace"):
             if e.get(key) is not None:
                 detail.append("%s=%s" % (key, e[key]))
         print("%-20s %-14s %-8s %s"

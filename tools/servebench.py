@@ -286,6 +286,10 @@ def _main_fleet(args):
         "quota_shed": c.get("quota_shed", 0),
         "ready_at_end": sum(1 for r in stats["replicas"].values()
                             if r["state"] == "READY"),
+        # per-tenant SLO table (router TenantSLO ledgers): availability,
+        # latency percentiles, deadline-budget burn, shed-by-cause —
+        # additive schema
+        "tenants": stats.get("tenants", {}),
         "fleet_stats": stats,
     }
     if kill:
@@ -313,6 +317,16 @@ def _main_fleet(args):
     if kill:
         print("  kill drill      replica %(slot)s pid %(pid)s at "
               "t+%(at_s)ss" % kill)
+    for name, t in sorted((report["tenants"] or {}).items()):
+        lat = t.get("latency_ms") or {}
+        burn = t.get("budget_burn") or {}
+        avail = t.get("availability")
+        print("  tenant %-9s req %-6d ok %-6d avail %-7s p95 %-8s "
+              "burn_p95 %-7s shed %s"
+              % (name, t.get("requests", 0), t.get("ok", 0),
+                 "-" if avail is None else "%.1f%%" % (100 * avail),
+                 lat.get("p95", "-"), burn.get("p95", "-"),
+                 t.get("shed") or 0))
     print("  ready at end    %d/%d" % (report["ready_at_end"],
                                        args.replicas))
     return 0
