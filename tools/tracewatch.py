@@ -1,0 +1,360 @@
+#!/usr/bin/env python3
+"""Merge per-process trace sinks into ONE Perfetto trace.
+
+The distributed-tracing plane (mxnet_tpu/telemetry/tracing.py) leaves
+one bounded ``trace-<proc>-<pid>.jsonl`` flight-recorder file per
+process — router, every replica (including relaunched incarnations),
+training ranks.  This tool stitches them into a single Chrome-trace
+JSON that Perfetto (https://ui.perfetto.dev) or chrome://tracing opens:
+
+* every process gets its own process group (named after its ``proc``
+  label), every trace gets nest-clean lanes inside it — concurrent
+  hedged dispatches fan out onto sibling lanes instead of overlapping;
+* cross-process and cross-lane parent/child edges become **flow
+  events** (arrows), so the router's ``fleet/dispatch`` visually hands
+  off to the replica's ``replica/request`` and its serving phases;
+* spans carry their outcome (``ok`` / ``cancelled`` / ``deadline`` /
+  ``error:*``) and attrs as clickable args.
+
+``--request <trace_id>`` renders one request's full tree as text — the
+kill-drill autopsy view: which replica died, which hedge won, where the
+time went.  ``--check`` exits 1 when any span's parent is missing from
+the merged set (an orphan means a propagation bug, not a dead process:
+a SIGKILLed replica loses only unfinished spans, which are never
+written, never referenced as parents of other processes' spans).
+
+Usage:
+    python tools/tracewatch.py <dir|file...> [--out merged.json]
+    python tools/tracewatch.py <dir> --request 0123456789abcdef
+    python tools/tracewatch.py <dir> --list
+    python tools/tracewatch.py <dir> --check
+
+Stdlib-only so it runs on a bare recovery box; when the repo's
+telemetry layer is importable the merge itself is timed with a span
+(SL107: no hand-rolled timing — dogfood the span machinery).
+"""
+import argparse
+import glob
+import json
+import os
+import sys
+
+try:                            # optional: dogfood telemetry spans
+    from mxnet_tpu.telemetry import span as _span
+except Exception:               # bare recovery box: no timing, no loss
+    import contextlib
+
+    def _span(*a, **k):
+        return contextlib.nullcontext()
+
+_EPS = 1e-7
+# same-process children are clamped INTO their parents when they poke
+# out by less than this (seconds): span records round timestamps to the
+# microsecond and reconstruct phases from separately-rounded values, so
+# ~1us overhangs are quantization, not data.  Real violations (bugs)
+# are orders of magnitude bigger and stay visible.
+_CLAMP_TOL = 20e-6
+
+
+def find_sinks(target):
+    """``trace-*.jsonl`` files under a directory (or the file itself)."""
+    if os.path.isfile(target):
+        return [target]
+    return sorted(glob.glob(os.path.join(target, "trace-*.jsonl")))
+
+
+def load_spans(targets):
+    """Every span record from every sink; unreadable lines are counted,
+    not fatal (a process killed mid-write leaves at most one)."""
+    if isinstance(targets, str):
+        targets = [targets]
+    paths = []
+    for t in targets:
+        paths.extend(find_sinks(t))
+    spans, bad = [], 0
+    for path in paths:
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        bad += 1
+                        continue
+                    if rec.get("trace") and rec.get("span"):
+                        spans.append(rec)
+        except OSError:
+            bad += 1
+    return spans, bad
+
+
+def find_orphans(spans):
+    """Spans whose parent id is absent from the whole merged set (and
+    not a root).  Zero is the acceptance bar: every recorded child must
+    be reachable from its trace's root."""
+    known = {s["span"] for s in spans}
+    return [s for s in spans
+            if s.get("parent") is not None and s["parent"] not in known]
+
+
+def _contains(a, b):
+    """Interval a contains interval b (with slack for float rounding)."""
+    return (a[0] <= b[0] + _EPS) and (b[1] <= a[1] + _EPS)
+
+
+def _disjoint(a, b):
+    return b[0] >= a[1] - _EPS or a[0] >= b[1] - _EPS
+
+
+def _intervals(spans):
+    """``{id(span): (t0, end)}`` with same-process children clamped into
+    their parents (tolerance ``_CLAMP_TOL`` — see above).  Cross-process
+    edges are never clamped: clock skew between hosts is data."""
+    by_id = {s["span"]: s for s in spans}
+    memo = {}
+
+    def clamped(s, chain=()):
+        key = id(s)
+        if key in memo:
+            return memo[key]
+        t0, end = s["t0"], s["t0"] + s["dur"]
+        p = by_id.get(s.get("parent"))
+        if (p is not None and p["pid"] == s["pid"]
+                and p["span"] not in chain):
+            p0, p1 = clamped(p, chain + (s["span"],))
+            if p0 - _CLAMP_TOL <= t0 <= p0:
+                t0 = p0
+            if p1 <= end <= p1 + _CLAMP_TOL:
+                end = p1
+        memo[key] = (t0, max(t0, end))
+        return memo[key]
+
+    for s in spans:
+        clamped(s)
+    return memo
+
+
+def _assign_lanes(spans, intervals):
+    """Give every span a (pid-local) lane id such that spans sharing a
+    lane are disjoint or properly nested — hedged dispatches overlap in
+    time, so they fan out onto sibling lanes.  Returns {id(span): tid}."""
+    by_key = {}
+    for s in spans:
+        by_key.setdefault((s["pid"], s["trace"]), []).append(s)
+    lanes_of_pid = {}
+    tid_of = {}
+    for (pid, _trace), group in sorted(
+            by_key.items(), key=lambda kv: min(s["t0"] for s in kv[1])):
+        group.sort(key=lambda s: (s["t0"], -s["dur"]))
+        lanes = lanes_of_pid.setdefault(pid, [])   # [[interval, ...], ...]
+        placed = {}                                # span id -> lane idx
+        for s in group:
+            iv = intervals[id(s)]
+            # prefer the parent's lane, then existing lanes, else new;
+            # a lane admits a span only when every resident is disjoint
+            # from it or contains it — verified even for ancestors, so
+            # a span that (rarely) settles after its parent closed goes
+            # to a sibling lane instead of breaking the lane's nesting
+            order = []
+            if s.get("parent") in placed:
+                order.append(placed[s["parent"]])
+            order.extend(i for i in range(len(lanes)) if i not in order)
+            chosen = None
+            for i in order:
+                if all(_disjoint(other_iv, iv) or _contains(other_iv, iv)
+                       for _sid, other_iv in lanes[i]):
+                    chosen = i
+                    break
+            if chosen is None:
+                lanes.append([])
+                chosen = len(lanes) - 1
+            lanes[chosen].append((s["span"], iv))
+            placed[s["span"]] = chosen
+            tid_of[id(s)] = chosen + 1
+    return tid_of
+
+
+def merge_trace(spans):
+    """One Chrome-trace dict (``{"traceEvents": [...]}``) from span
+    records of any number of processes: X slices on nest-clean lanes,
+    process_name metadata, and flow arrows for every parent/child edge
+    that crosses a process or lane."""
+    with _span("tracewatch/merge", cat="tool", n_spans=len(spans)):
+        events = []
+        if not spans:
+            return {"traceEvents": events, "displayTimeUnit": "ms"}
+        t_min = min(s["t0"] for s in spans)
+        intervals = _intervals(spans)
+        tid_of = _assign_lanes(spans, intervals)
+        procs = {}
+        for s in spans:
+            procs.setdefault(s["pid"], s.get("proc") or str(s["pid"]))
+        for pid, label in sorted(procs.items()):
+            events.append({"ph": "M", "name": "process_name", "pid": pid,
+                           "tid": 0, "args": {"name": label}})
+        where = {}                  # span id -> (pid, tid, ts_us, dur_us)
+        for s in spans:
+            a, b = intervals[id(s)]
+            ts = (a - t_min) * 1e6
+            dur = (b - a) * 1e6
+            tid = tid_of[id(s)]
+            where[s["span"]] = (s["pid"], tid, ts, dur)
+            args = {"trace": s["trace"], "span": s["span"],
+                    "outcome": s.get("outcome", "ok"),
+                    "proc": s.get("proc")}
+            args.update(s.get("attrs") or {})
+            events.append({"ph": "X", "name": s["name"],
+                           "cat": s.get("cat", "trace"), "pid": s["pid"],
+                           "tid": tid, "ts": ts, "dur": dur, "args": args})
+        # flow arrows: parent -> child when the edge crosses a lane
+        flow = 0
+        for s in spans:
+            parent = s.get("parent")
+            if parent is None or parent not in where:
+                continue
+            p_pid, p_tid, p_ts, p_dur = where[parent]
+            c_pid, c_tid, c_ts, _ = where[s["span"]]
+            if (p_pid, p_tid) == (c_pid, c_tid):
+                continue            # same lane: visual nesting says it all
+            flow += 1
+            fid = "f%d" % flow
+            events.append({"ph": "s", "id": fid, "name": "trace",
+                           "cat": "flow", "pid": p_pid, "tid": p_tid,
+                           # bind inside the parent slice
+                           "ts": min(max(c_ts - 1.0, p_ts),
+                                     p_ts + max(p_dur - 1.0, 0.0))})
+            events.append({"ph": "f", "bp": "e", "id": fid,
+                           "name": "trace", "cat": "flow", "pid": c_pid,
+                           "tid": c_tid, "ts": c_ts + _EPS})
+        events.sort(key=lambda e: (e.get("pid", 0), e.get("tid", 0),
+                                   e.get("ts", 0.0), -e.get("dur", 0.0)))
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def list_traces(spans):
+    """``{trace_id: {"spans", "procs", "t0", "dur_ms", "outcome"}}`` —
+    the haystack index ``--list`` prints."""
+    out = {}
+    for s in spans:
+        t = out.setdefault(s["trace"], {"spans": 0, "procs": set(),
+                                        "t0": s["t0"], "end": s["t0"],
+                                        "outcome": None})
+        t["spans"] += 1
+        t["procs"].add(s.get("proc") or str(s["pid"]))
+        t["t0"] = min(t["t0"], s["t0"])
+        t["end"] = max(t["end"], s["t0"] + s["dur"])
+        if s["name"] == "fleet/request":        # the root carries it
+            t["outcome"] = s.get("outcome")
+    for t in out.values():
+        t["procs"] = sorted(t["procs"])
+        t["dur_ms"] = round((t.pop("end") - t["t0"]) * 1e3, 3)
+    return out
+
+
+def render_request(spans, trace_id, out=None):
+    """One request's span tree as indented text (the autopsy view)."""
+    out = out if out is not None else sys.stdout
+    mine = [s for s in spans if s["trace"] == trace_id]
+    if not mine:
+        print("no spans for trace %r" % trace_id, file=out)
+        return 1
+    ids = {s["span"] for s in mine}
+    children = {}
+    roots = []
+    for s in mine:
+        if s.get("parent") in ids:
+            children.setdefault(s["parent"], []).append(s)
+        else:
+            roots.append(s)
+    t_min = min(s["t0"] for s in mine)
+    procs = sorted({s.get("proc") or str(s["pid"]) for s in mine})
+    print("trace %s: %d span(s) across %d process(es): %s"
+          % (trace_id, len(mine), len(procs), ", ".join(procs)), file=out)
+
+    def walk(s, depth):
+        attrs = s.get("attrs") or {}
+        extra = "  ".join("%s=%s" % kv for kv in sorted(attrs.items()))
+        print("%s%-24s %-10s +%7.2fms %8.2fms  %-12s %s"
+              % ("  " * depth, s["name"],
+                 s.get("proc") or str(s["pid"]),
+                 (s["t0"] - t_min) * 1e3, s["dur"] * 1e3,
+                 s.get("outcome", "ok"), extra), file=out)
+        for c in sorted(children.get(s["span"], []),
+                        key=lambda c: c["t0"]):
+            walk(c, depth + 1)
+
+    for r in sorted(roots, key=lambda s: s["t0"]):
+        walk(r, 0)
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("target", nargs="*", default=["."],
+                    help="trace sink file(s) or directories holding "
+                         "trace-*.jsonl (default: cwd)")
+    ap.add_argument("--out", default=None,
+                    help="write the merged Perfetto trace here "
+                         "(default: <first dir>/merged-trace.json)")
+    ap.add_argument("--request", metavar="TRACE_ID",
+                    help="render one request's span tree as text "
+                         "instead of merging")
+    ap.add_argument("--list", action="store_true",
+                    help="list trace ids with span/process counts")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 when any span's parent is missing "
+                         "(orphan) from the merged set")
+    args = ap.parse_args(argv)
+
+    spans, bad = load_spans(args.target)
+    if bad:
+        print("tracewatch: skipped %d unreadable line(s)/file(s)" % bad,
+              file=sys.stderr)
+    if not spans:
+        print("tracewatch: no spans under %s" % args.target,
+              file=sys.stderr)
+        return 1
+
+    if args.request:
+        return render_request(spans, args.request)
+    if args.list:
+        for tid, t in sorted(list_traces(spans).items(),
+                             key=lambda kv: kv[1]["t0"]):
+            print("%s  %3d span(s)  %8.2fms  %-10s %s"
+                  % (tid, t["spans"], t["dur_ms"], t["outcome"] or "-",
+                     ",".join(t["procs"])))
+        return 0
+
+    orphans = find_orphans(spans)
+    trace = merge_trace(spans)
+    out = args.out
+    if out is None:
+        first = args.target[0]
+        base = first if os.path.isdir(first) else os.path.dirname(first)
+        out = os.path.join(base or ".", "merged-trace.json")
+    with open(out, "w") as f:
+        json.dump(trace, f)
+    traces = list_traces(spans)
+    print("tracewatch: %d span(s), %d trace(s), %d process(es) -> %s"
+          % (len(spans), len(traces),
+             len({s["pid"] for s in spans}), out))
+    if orphans:
+        print("tracewatch: %d ORPHAN span(s) (parent missing):"
+              % len(orphans), file=sys.stderr)
+        for s in orphans[:10]:
+            print("  %s %s parent=%s proc=%s"
+                  % (s["trace"], s["name"], s.get("parent"),
+                     s.get("proc")), file=sys.stderr)
+        if args.check:
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:      # `tracewatch --list | head` is fine
+        sys.exit(0)
