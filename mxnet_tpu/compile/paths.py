@@ -1,0 +1,52 @@
+"""The one cache-location rule every persisted cache shares.
+
+Two caches persist across processes today — the autotuner's winner table
+(``ops/autotune.py``) and the compiled-executable cache (``.cache``) —
+and both follow the same convention:
+
+* an explicit ``MXNET_TPU_<NAME>_CACHE`` env value wins outright (a
+  file path for file-shaped caches, a directory for directory-shaped
+  ones; ``0``/``off``-style values mean *disabled* where the cache
+  supports disabling);
+* otherwise the cache lives under ``~/.cache/mxnet_tpu/``.
+
+This module is import-light on purpose (stdlib only): both
+``mxnet_tpu.ops`` and ``mxnet_tpu.compile`` reach it without creating
+an import cycle.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+__all__ = ["cache_root", "cache_location", "env_disabled", "ENV_OFF"]
+
+# env values that mean "explicitly off" wherever a cache is optional
+ENV_OFF = ("0", "off", "false", "no", "disabled")
+
+
+def cache_root() -> str:
+    """``~/.cache/mxnet_tpu`` — the base every default cache path hangs
+    off (not created here; callers mkdir when they first write)."""
+    return os.path.join(os.path.expanduser("~"), ".cache", "mxnet_tpu")
+
+
+def env_disabled(env_name: str) -> bool:
+    """True when ``env_name`` is set to an explicit off value."""
+    return os.environ.get(env_name, "").strip().lower() in ENV_OFF and \
+        os.environ.get(env_name, "").strip() != ""
+
+
+def cache_location(env_name: str, default_name: str) -> Optional[str]:
+    """Resolve one cache's on-disk location: the ``env_name`` override
+    when set (and not an off value), else ``~/.cache/mxnet_tpu/
+    <default_name>``.  Returns None when the env explicitly disables the
+    cache.  ``1``/``on``-style values select the default location (the
+    common "just turn it on" spelling for opt-in caches)."""
+    raw = os.environ.get(env_name, "").strip()
+    if raw:
+        if raw.lower() in ENV_OFF:
+            return None
+        if raw.lower() not in ("1", "on", "true", "yes", "default"):
+            return raw
+    return os.path.join(cache_root(), default_name)

@@ -1,0 +1,154 @@
+"""AOT warm-standby pre-compiler: the executables recovery will need,
+compiled while nothing is on fire.
+
+While a gang trains at world N, the next topology it may be forced into
+is knowable in advance: N−1 (lose a rank) and the launcher-advertised
+grow-back size.  This module compiles those step programs in a
+background thread **through the persistent cache** (:mod:`.cache`), so
+the moment ``reform_mesh`` + the elastic resume path actually need the
+N−1 executable, the relaunched gang's first step deserializes it —
+zero in-drill compilation, proven by ``compile/*`` spans tagged
+``result=hit``.
+
+Key facts that make this sound:
+
+* the cache key is the sha256 of the lowered StableHLO text + the
+  exact device ids — and the lowered text for "this symbol, these
+  shapes, a dp=W mesh" is identical whether it is lowered by a shadow
+  trainer at world N or the real trainer after the resize (verified by
+  the cross-topology tests);
+* a standby compile only runs on a rank that OWNS a device of the
+  candidate mesh (in practice the saver, rank 0 — if rank 0 dies the
+  coordination KV dies with it and elastic falls back to full restart
+  anyway, documented in resilience/elastic.py);
+* a candidate needing more devices than this process can currently see
+  (grow-back while shrunk) is reported ``unavailable`` rather than
+  attempted — its warmth comes from the write-through of the original
+  cold compile at the bigger world, which the cache retains.
+
+The pre-compiler never raises into training: every job failure is
+recorded in :meth:`StandbyCompiler.results` and the drill/telemetry
+decide what to make of it.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["StandbyCompiler", "trainer_standby_jobs"]
+
+
+class StandbyCompiler:
+    """Run pre-compile jobs serially on one daemon thread.
+
+    ``jobs`` is a list of ``(name, thunk)``; each thunk does its own
+    compile-through-cache and returns a JSON-able result dict.  Results
+    (or ``{"result": "error", ...}``) land in :meth:`results` keyed by
+    name — the elastic coordinator folds them into the resize manifest
+    so the drill can prove which generations were pre-compiled."""
+
+    def __init__(self, jobs: Sequence[Tuple[str, Callable[[], dict]]],
+                 label: str = "standby"):
+        self._jobs = list(jobs)
+        self._label = label
+        self._results: Dict[str, dict] = {}
+        self._lock = threading.Lock()
+        self._done = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "StandbyCompiler":
+        if self._thread is not None:
+            return self
+        if not self._jobs:
+            self._done.set()
+            return self
+        self._thread = threading.Thread(target=self._run,
+                                        name="mxt-" + self._label,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self):
+        for name, thunk in self._jobs:
+            try:
+                res = thunk()
+            except Exception as e:
+                logging.exception("standby: pre-compile %r failed "
+                                  "(recovery will compile cold)", name)
+                res = {"result": "error", "error": repr(e)}
+            with self._lock:
+                self._results[name] = res if isinstance(res, dict) \
+                    else {"result": str(res)}
+        self._done.set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until every job finished; True when done."""
+        if self._thread is None and not self._done.is_set():
+            self.start()
+        return self._done.wait(timeout)
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def results(self) -> Dict[str, dict]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._results.items()}
+
+
+def trainer_standby_jobs(trainer, state, candidates,
+                         batch_shapes: Dict[str, tuple],
+                         input_dtypes: Optional[Dict] = None,
+                         ) -> List[Tuple[str, Callable[[], dict]]]:
+    """Build standby jobs for a :class:`ShardedTrainer`.
+
+    ``candidates`` is ``[(n_devices, grad_accum), ...]`` — the device
+    counts of the topologies recovery may re-form into, each with the
+    accumulation factor that keeps the global batch constant there.
+    ``batch_shapes`` are the GLOBAL per-update input shapes (constant
+    across world sizes — that is the whole point of elastic grad-accum).
+    Each job lowers the shadow step program, compiles it through the
+    persistent cache (``result=standby`` on a cold compile, ``hit``
+    when an earlier incarnation already cached it) and reports the
+    fingerprint so the manifest can name what is warm."""
+    import jax
+    from .. import telemetry as _tel
+    from . import cache as _cache
+
+    jobs: List[Tuple[str, Callable[[], dict]]] = []
+    my_ids = {d.id for d in jax.local_devices()}
+    for n_devices, accum in candidates:
+        name = "world%d" % n_devices
+
+        def job(n_devices=n_devices, accum=accum) -> dict:
+            devices = jax.devices()
+            if n_devices > len(devices):
+                return {"result": "unavailable",
+                        "detail": "%d devices needed, %d visible"
+                                  % (n_devices, len(devices))}
+            cand = devices[:n_devices]
+            if not my_ids & {d.id for d in cand}:
+                return {"result": "skipped",
+                        "detail": "no local device in the candidate mesh"}
+            with _tel.span("compile/standby", cat="compile",
+                           metric="compile.seconds", timed=True,
+                           devices=n_devices) as _cs:
+                lowered, mesh = trainer.lower_step_for(
+                    cand, accum, state, batch_shapes,
+                    input_dtypes=input_dtypes)
+                text = lowered.as_text()
+                compiled, result = _cache.cached_compile(
+                    lowered, "train_step", mesh=mesh, standby=True)
+            del compiled        # the entry on disk is the product
+            _tel.tracing.note_compile(
+                "standby", _cs.duration, result=result,
+                devices=n_devices,
+                fingerprint=_cache.program_fingerprint(text)[:16])
+            return {"result": result, "devices": n_devices,
+                    "grad_accum": accum,
+                    "fingerprint": _cache.program_fingerprint(text)[:16],
+                    "seconds": round(_cs.duration or 0.0, 4)}
+
+        jobs.append((name, job))
+    return jobs

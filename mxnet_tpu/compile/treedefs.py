@@ -1,0 +1,88 @@
+"""Pickle-free pytree treedef codec for cached-executable containers.
+
+``jax.experimental.serialize_executable`` hands back ``(payload,
+in_tree, out_tree)`` where the treedefs are live ``PyTreeDef`` objects;
+persisting them with pickle would put executable code in the cache file
+— the exact thing the container format exists to forbid (deploy.py
+solved this for the fixed serving signature by rebuilding trees from
+arity counts; this module is the general form of that trick).  A
+treedef built from tuples / lists / dicts / ``None`` round-trips
+through a tagged JSON structure::
+
+    (a, [b, c], {"x": d})  ->  {"t": "tuple", "c": [leaf, list..., dict...]}
+
+Anything else (custom pytree nodes, namedtuples, OrderedDict subtleties)
+raises :class:`UnsupportedTreedef` — the cache then simply refuses to
+persist that program (a safe miss), never a wrong reconstruction.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+__all__ = ["UnsupportedTreedef", "treedef_to_obj", "obj_to_treedef",
+           "template_to_obj"]
+
+_LEAF = {"t": "leaf"}
+
+
+class UnsupportedTreedef(ValueError):
+    """The pytree uses node types the JSON codec cannot represent."""
+
+
+def template_to_obj(template: Any) -> dict:
+    """Encode a pytree TEMPLATE (the structure with arbitrary leaves)
+    into the tagged-JSON form."""
+    if template is None:
+        return {"t": "none"}
+    t = type(template)
+    if t is tuple:
+        return {"t": "tuple", "c": [template_to_obj(c) for c in template]}
+    if t is list:
+        return {"t": "list", "c": [template_to_obj(c) for c in template]}
+    if t is dict:
+        keys = sorted(template.keys())
+        if not all(isinstance(k, str) for k in keys):
+            raise UnsupportedTreedef(
+                "dict pytree keys must be strings, got %r" % (keys,))
+        return {"t": "dict", "k": keys,
+                "c": [template_to_obj(template[k]) for k in keys]}
+    if t in (int, float, bool, str) or hasattr(template, "shape") \
+            or hasattr(template, "dtype"):
+        return dict(_LEAF)
+    raise UnsupportedTreedef(
+        "pytree node type %r is not JSON-representable" % (t,))
+
+
+def treedef_to_obj(treedef) -> dict:
+    """Encode a ``PyTreeDef`` (tuples/lists/dicts/None only)."""
+    template = treedef.unflatten([0] * treedef.num_leaves)
+    obj = template_to_obj(template)
+    # round-trip proof at ENCODE time: a structure the decoder would
+    # rebuild differently (e.g. a dict whose iteration order the codec
+    # normalizes) must fail here, not at load time in another process
+    if obj_to_treedef(obj) != treedef:
+        raise UnsupportedTreedef(
+            "treedef %r does not survive the JSON codec round-trip"
+            % (treedef,))
+    return obj
+
+
+def _obj_to_template(obj) -> Any:
+    t = obj.get("t") if isinstance(obj, dict) else None
+    if t == "leaf":
+        return 0
+    if t == "none":
+        return None
+    if t == "tuple":
+        return tuple(_obj_to_template(c) for c in obj["c"])
+    if t == "list":
+        return [_obj_to_template(c) for c in obj["c"]]
+    if t == "dict":
+        return {k: _obj_to_template(c) for k, c in zip(obj["k"], obj["c"])}
+    raise UnsupportedTreedef("unknown treedef tag %r" % (t,))
+
+
+def obj_to_treedef(obj):
+    """Decode the tagged-JSON form back into a live ``PyTreeDef``."""
+    import jax
+    return jax.tree_util.tree_structure(_obj_to_template(obj))

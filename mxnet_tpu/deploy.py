@@ -56,6 +56,18 @@ def _current_topology():
     return (jax.default_backend(), devices[0].device_kind, len(devices))
 
 
+def device_fingerprint(topology=None) -> str:
+    """The per-topology key an artifact's executable blobs are filed
+    under: ``platform|device_kind|device_count``.  One artifact can
+    carry an AOT executable per topology it may serve from (a 1-chip
+    dev box, the tp2 serving slice, ...) — the loader picks the blob
+    matching the running backend, so replica relaunches and rolling
+    swaps deserialize a warm executable instead of refusing or
+    compiling."""
+    platform, kind, count = topology or _current_topology()
+    return "%s|%s|%d" % (platform, kind, int(count))
+
+
 def _to_host(arr):
     return np.asarray(arr)
 
@@ -71,13 +83,19 @@ def _arity_trees(n_params, n_inputs, n_outputs):
 
 
 def export_compiled(prog, const_args, aux, input_names, input_shapes,
-                    path, input_dtypes=None):
+                    path, input_dtypes=None, append=False):
     """AOT-compile prog's inference forward and write the deploy bundle.
 
     ``prog`` is an executor GraphProgram; ``const_args`` maps non-input
     arg names to their (trained) values; ``aux`` is the aux-state tuple.
     The compiled program takes (params_tuple, inputs_tuple) so weights
     stay out of the executable and visible in the artifact.
+
+    ``append=True`` adds THIS topology's executable to an existing
+    artifact instead of overwriting it (refusing if weights or schema
+    differ) — the per-topology AOT workflow: run the export once per
+    deployment topology (dev chip, tp2 slice, ...) and ship ONE
+    artifact whose loader picks the matching executable everywhere.
     """
     import jax
     import jax.numpy as jnp
@@ -125,6 +143,7 @@ def export_compiled(prog, const_args, aux, input_names, input_shapes,
             % (in_tree, out_tree))
 
     platform, device_kind, device_count = _current_topology()
+    fp = device_fingerprint()
     meta = {
         "magic": _MAGIC,
         "platform": platform,
@@ -141,11 +160,53 @@ def export_compiled(prog, const_args, aux, input_names, input_shapes,
         "output_shapes": [list(s.shape) for s in out_structs],
         "output_dtypes": [np.dtype(s.dtype).name for s in out_structs],
         "n_outputs": len(out_structs),
+        # per-topology executable directory: device fingerprint -> blob
+        "topologies": {fp: "executable"},
     }
     arrays = {"param/%s" % n: _to_host(const_args[n]) for n in param_names}
-    write_container(path, arrays=arrays, meta=meta,
-                    blobs={"executable": payload})
+    blobs = {"executable": payload}
+    if append and os.path.exists(path):
+        arrays, meta, blobs = _merge_topology(path, meta, arrays, payload,
+                                              fp)
+    write_container(path, arrays=arrays, meta=meta, blobs=blobs)
     return path
+
+
+def _merge_topology(path, new_meta, new_arrays, payload, fp):
+    """Fold THIS topology's executable into an existing artifact,
+    refusing if the weights or the input/output schema differ — one
+    artifact must mean one model, whatever it is compiled for."""
+    arrays, meta, blobs = read_container(path)
+    if meta.get("magic") != _MAGIC:
+        raise MXNetError("%s is not a served-program artifact "
+                         "(magic %r)" % (path, meta.get("magic")))
+    for field in ("param_names", "input_names", "input_shapes",
+                  "input_dtypes", "output_shapes", "output_dtypes",
+                  "n_outputs"):
+        if meta.get(field) != new_meta.get(field):
+            raise MXNetError(
+                "export_compiled(append=True): %s differs from the "
+                "existing artifact (%r != %r) — refusing to mix models "
+                "in one file" % (field, new_meta.get(field),
+                                 meta.get(field)))
+    for name, arr in new_arrays.items():
+        if name not in arrays or not np.array_equal(
+                np.asarray(arrays[name]), np.asarray(arr)):
+            raise MXNetError(
+                "export_compiled(append=True): weights %r differ from "
+                "the existing artifact — refusing to mix models" % name)
+    topo = dict(meta.get("topologies")
+                or {device_fingerprint((meta.get("platform"),
+                                        meta.get("device_kind"),
+                                        meta.get("device_count") or 0)):
+                    "executable"})
+    blob_name = topo.get(fp) or ("executable@%s" % fp)
+    topo[fp] = blob_name
+    blobs = dict(blobs)
+    blobs[blob_name] = payload
+    meta = dict(meta)
+    meta["topologies"] = topo
+    return arrays, meta, blobs
 
 
 def _check_topology(meta):
@@ -178,6 +239,38 @@ def _check_topology(meta):
         "(set MXNET_TPU_SERVED_IGNORE_TOPOLOGY=1 to override)" % detail)
 
 
+def _select_executable(meta, blobs):
+    """Pick the executable blob matching the running topology; returns
+    ``(payload, result)`` with result ``hit`` (exact AOT match — the
+    warm-load path), ``legacy`` (pre-fingerprint artifact) or ``forced``
+    (operator override)."""
+    topo = meta.get("topologies")
+    if topo:
+        fp = device_fingerprint()
+        name = topo.get(fp)
+        if name is not None and name in blobs:
+            return blobs[name], "hit"
+        if os.environ.get("MXNET_TPU_SERVED_IGNORE_TOPOLOGY") == "1":
+            logging.warning(
+                "MXNET_TPU_SERVED_IGNORE_TOPOLOGY=1: this process is %s "
+                "but the artifact only carries %s — loading the primary "
+                "executable anyway", fp, sorted(topo))
+            return blobs["executable"], "forced"
+        raise TopologyMismatch(
+            "this process is %s but the artifact carries executables "
+            "for %s; re-run export_compiled(append=True) on a matching "
+            "host to add this topology (or set "
+            "MXNET_TPU_SERVED_IGNORE_TOPOLOGY=1 to force the primary)"
+            % (fp, sorted(topo)))
+    # legacy artifact (one executable, topology fields at the top level
+    # or absent): the v2 refuse-on-mismatch semantics, unchanged
+    _check_topology(meta)
+    recorded = (meta.get("platform"), meta.get("device_kind"),
+                meta.get("device_count"))
+    result = "hit" if recorded == _current_topology() else "legacy"
+    return blobs["executable"], result
+
+
 class ServedProgram:
     """A deserialized AOT executable + its weights; no tracing anywhere."""
 
@@ -187,12 +280,12 @@ class ServedProgram:
         if meta.get("magic") != _MAGIC:
             raise MXNetError("not a mxnet_tpu served-program file "
                              "(magic %r)" % meta.get("magic"))
-        _check_topology(meta)
+        payload, self.load_result = _select_executable(meta, blobs)
         in_tree, out_tree = _arity_trees(
             len(meta["param_names"]), len(meta["input_names"]),
             int(meta["n_outputs"]))
         self._compiled = serialize_executable.deserialize_and_load(
-            blobs["executable"], in_tree, out_tree)
+            payload, in_tree, out_tree)
         self.input_names = meta["input_names"]
         self.input_shapes = {n: tuple(s)
                              for n, s in meta["input_shapes"].items()}
@@ -217,9 +310,14 @@ class ServedProgram:
                                timed=True) as _cs:
             arrays, meta, blobs = read_container(path)
             prog = cls(arrays, meta, blobs)
+            # `hit` = an AOT executable for exactly this topology was in
+            # the artifact (zero compile; the warm replica-relaunch /
+            # rolling-swap path the fleet drills assert on)
+            _cs.attrs["result"] = prog.load_result
         telemetry.tracing.note_compile(
             "served_load", _cs.duration,
-            artifact=os.path.basename(os.fspath(path)))
+            artifact=os.path.basename(os.fspath(path)),
+            result=prog.load_result)
         telemetry.count("deploy.loads")
         # memory plane: served weights are a first-class HBM bucket (a
         # hot-swap briefly holds two models — the accounting shows it),

@@ -810,7 +810,7 @@ class Executor:
         return dict(zip(self._symbol.list_outputs(), self.outputs))
 
     def export_compiled(self, path, input_names=("data",),
-                        input_dtypes=None):
+                        input_dtypes=None, append=False):
         """Write a serialized AOT deploy artifact (see deploy.py).
 
         The bound arg arrays become the artifact's weights; ``input_names``
@@ -826,7 +826,7 @@ class Executor:
         aux = tuple(a._handle for a in self.aux_arrays)
         input_shapes = {n: self.arg_dict[n].shape for n in input_names}
         return _export(self._prog, const_args, aux, list(input_names),
-                       input_shapes, path, input_dtypes)
+                       input_shapes, path, input_dtypes, append=append)
 
     def copy_params_from(self, arg_params, aux_params=None,
                          allow_extra_params=False):

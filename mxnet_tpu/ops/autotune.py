@@ -73,11 +73,15 @@ def device_kind() -> str:
 
 
 def cache_path() -> str:
-    env = os.environ.get("MXNET_TPU_AUTOTUNE_CACHE")
-    if env:
-        return env
-    return os.path.join(os.path.expanduser("~"), ".cache", "mxnet_tpu",
-                        "autotune-%s.json" % device_kind())
+    # the shared cache-location rule (compile/paths.py): env override
+    # wins, else ~/.cache/mxnet_tpu/ — the same convention the compiled-
+    # executable cache follows, so MXNET_TPU_*_CACHE knobs behave
+    # identically across both
+    from ..compile import paths as _paths
+    return _paths.cache_location(
+        "MXNET_TPU_AUTOTUNE_CACHE",
+        "autotune-%s.json" % device_kind()) or os.path.join(
+        _paths.cache_root(), "autotune-%s.json" % device_kind())
 
 
 def _load() -> Dict[str, dict]:
@@ -137,7 +141,9 @@ def lookup(op: str, sig: Sequence) -> Optional[dict]:
 def record(op: str, sig: Sequence, config, score_ms: float,
            trials: int = 0) -> dict:
     """Persist a winner (atomic rewrite of the whole cache file)."""
-    entry = {"config": list(config), "score_ms": round(float(score_ms), 4),
+    entry = {"config": (list(config) if isinstance(config, (list, tuple))
+                        else config),
+             "score_ms": round(float(score_ms), 4),
              "trials": int(trials), "device_kind": device_kind(),
              "t": time.time()}
     with _LOCK:
@@ -152,12 +158,19 @@ def measuring_enabled() -> bool:
 
 def autotune(op: str, sig: Sequence, candidates: Iterable,
              measure: Callable[[object], float], default=None,
-             force: bool = False):
+             force: bool = False, lower: Optional[Callable] = None):
     """Generic search: return the cached winner for ``(op, sig)`` or —
     when measuring is enabled — time every candidate with ``measure``
     (seconds per call; smaller is better), cache the winner, and return
     it.  With measuring disabled and no cache entry, returns
     ``default`` (or the first candidate).
+
+    ``lower``: optional ``cand -> jax Lowered``.  When given, each
+    candidate is compiled THROUGH the persistent executable cache
+    (mxnet_tpu/compile) before measuring and ``measure`` is called as
+    ``measure(cand, compiled)`` — so a re-tune (new shapes sweep, a
+    relaunched tuning job) pays zero compilation for candidates any
+    earlier run already built.
 
     A candidate whose measurement RAISES is skipped (an over-budget
     block config that fails to compile is data, not an error)."""
@@ -180,14 +193,25 @@ def autotune(op: str, sig: Sequence, candidates: Iterable,
             try:
                 # a trial's cost is dominated by compiling the candidate
                 # block config — it belongs to the compile/ span family
+                cc_result = None
                 with _tel.span("compile/autotune_trial", cat="compile",
                                metric="compile.seconds", timed=True,
                                op=op) as _cs:
-                    dt = float(measure(cand))
+                    if lower is not None:
+                        from .. import compile as _cc
+                        built, cc_result = _cc.cached_compile(
+                            lower(cand), "autotune_trial",
+                            extra=(op, str(cand)))
+                        _cs.attrs["result"] = cc_result
+                        dt = float(measure(cand, built))
+                    else:
+                        dt = float(measure(cand))
             except Exception:
                 _tel.count("autotune.failed_trials", op=op)
                 continue
-        _tel.tracing.note_compile("autotune_trial", _cs.duration, op=op)
+        _tel.tracing.note_compile(
+            "autotune_trial", _cs.duration, op=op,
+            **({"result": cc_result} if cc_result else {}))
         trials += 1
         _tel.count("autotune.trials", op=op)
         if best_s is None or dt < best_s:
@@ -264,12 +288,14 @@ def tune_flash(q, k, v, causal: bool = True, kinds=("fwd", "bwd"),
             jax.block_until_ready(out)
             sync = out[0] if isinstance(out, tuple) else out
             float(jnp.sum(sync.astype(jnp.float32)))
-            t0 = time.perf_counter()
-            for _ in range(iters):
-                out = fn(bq, bk)
-            sync = out[0] if isinstance(out, tuple) else out
-            float(jnp.sum(sync.astype(jnp.float32)))
-            return (time.perf_counter() - t0) / iters
+            from .. import telemetry as _tel
+            with _tel.span("autotune/measure", cat="autotune",
+                           timed=True) as sp:
+                for _ in range(iters):
+                    out = fn(bq, bk)
+                sync = out[0] if isinstance(out, tuple) else out
+                float(jnp.sum(sync.astype(jnp.float32)))
+            return sp.duration / iters
         return run
 
     if "fwd" in kinds:

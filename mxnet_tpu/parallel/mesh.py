@@ -123,15 +123,21 @@ def data_parallel_mesh(num_devices: Optional[int] = None,
     return MeshSpec(make_mesh((n,), ("dp",)), generation=generation)
 
 
-def reform_mesh(spec: MeshSpec, generation: Optional[int] = None) -> MeshSpec:
+def reform_mesh(spec: MeshSpec, generation: Optional[int] = None,
+                devices=None) -> MeshSpec:
     """Re-form ``spec`` over the CURRENT device set — the elastic-resize
     re-layout: after survivors relaunch at a smaller (or restored) world
     size, the same axis layout is rebuilt over however many devices now
     exist, with the generation bumped.  Non-dp axes keep their extent
     (model parallelism doesn't shrink with the fleet); the dp axis
     absorbs the change, so the checkpoint's resharding restore and the
-    trainer's grad-accum adjustment see a consistent topology."""
-    devices = jax.devices()
+    trainer's grad-accum adjustment see a consistent topology.
+
+    ``devices`` overrides the device set — the warm-standby
+    pre-compiler (compile/standby.py) re-forms over a *subset* of the
+    live devices to build the N−1 generation's mesh before anything has
+    actually died."""
+    devices = list(devices) if devices is not None else jax.devices()
     axes = list(spec.mesh.axis_names)
     sizes = dict(spec.mesh.shape)
     other = 1

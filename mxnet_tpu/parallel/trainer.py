@@ -97,6 +97,12 @@ class ShardedTrainer:
             raise ValueError("grad_accum must be >= 1, got %r" % grad_accum)
         self.grad_accum = int(grad_accum)
         self._step = None
+        # AOT executable for the step when the persistent compile cache
+        # (mxnet_tpu/compile) is armed: the first step either
+        # deserializes a warm entry (result=hit — the elastic-resume
+        # path) or compiles and writes through (result=miss); later
+        # steps call it directly.  None = cache off, dispatch via jit.
+        self._step_exec = None
         from ..executor import backward_mirror_policy
         self._built_remat = backward_mirror_policy()
         # tensor parallelism: the tp mesh axis (auto-detected) + per-var
@@ -384,7 +390,17 @@ class ShardedTrainer:
                                  P(None, self.spec.dp_axis))
         return self.spec.batch_sharding()
 
-    def _build_step(self, donate=True):
+    def _build_step(self, donate=None):
+        if donate is None:
+            # deserialized executables with donated (aliased) buffers
+            # compute wrong results on backends whose runtime never
+            # implemented donation (XLA:CPU) — with the compile cache
+            # armed there, build donation-free: identical numerics AND
+            # identical cost (the runtime was ignoring the donation
+            # anyway), and the executable round-trips the cache safely
+            # (compile/cache.py donation_safe).
+            from .. import compile as _cc
+            donate = not (_cc.enabled() and not _cc.donation_safe())
         self._arm_mesh()
         step_fn = self._make_step_fn()
         rep = self.spec.replicated()
@@ -454,19 +470,37 @@ class ShardedTrainer:
         keys = self._keys()
         guard = self._guard_arrays()
         from .. import telemetry as _tel
+        from .. import compile as _cc
+        # same donation rule as _build_step: donation-free when the
+        # cache is armed on a backend that never implemented donation
+        donate_argnums = ((0, 1, 2, 5)
+                          if not (_cc.enabled() and not _cc.donation_safe())
+                          else ())
         with self.spec.mesh:
             jitted = jax.jit(step_fn, in_shardings=in_shardings,
                              out_shardings=out_shardings,
-                             donate_argnums=(0, 1, 2, 5))
+                             donate_argnums=donate_argnums)
             with _tel.span("compile/auto_layout", cat="compile",
                            metric="compile.seconds", timed=True) as _cs:
-                compiled = jitted.lower(
+                lowered = jitted.lower(
                     tuple(sds(p) for p in params),
                     tuple(sds(m) for m in mom),
                     tuple(sds(a) for a in aux), inputs, sds(keys),
-                    (sds(guard[0]), sds(guard[1]))).compile()
+                    (sds(guard[0]), sds(guard[1])))
+                compiled, cc_result = _cc.cached_compile(
+                    lowered, "auto_layout", mesh=self.spec.mesh)
+                if cc_result == "hit":
+                    try:        # the re-lay below needs the layouts; a
+                        # deserialized executable that cannot expose
+                        # them degrades to a fresh compile
+                        _ = (getattr(compiled, "input_formats", None)
+                             or compiled.input_layouts)
+                    except Exception:
+                        compiled, cc_result = lowered.compile(), "miss"
+                _cs.attrs["result"] = cc_result
         _tel.tracing.note_compile("train_step_auto_layout", _cs.duration,
-                                  symbol=self.symbol.name or "symbol")
+                                  symbol=self.symbol.name or "symbol",
+                                  result=cc_result)
         from ..telemetry import perf as _perf
         _perf.maybe_attribute(
             compiled,
@@ -492,6 +526,105 @@ class ShardedTrainer:
                                                     or "symbol"), compiled)
         return compiled, params, mom, aux
 
+    def _compile_step_cached(self, params, mom, aux, inputs, keys):
+        """First-step compile through the persistent executable cache
+        (mxnet_tpu/compile): returns ``(compiled_or_None, result)`` with
+        ``result`` in hit/miss/off.  ``None`` means "dispatch through
+        the jit as before" — the cache disabled, or any cache-path
+        failure (which must degrade to the stock path, never break a
+        step)."""
+        from .. import compile as _cc
+        if not _cc.enabled():
+            return None, "off"
+        try:
+            def sds(x):
+                return jax.ShapeDtypeStruct(x.shape, x.dtype)
+            structs = jax.tree_util.tree_map(
+                sds, (params, mom, aux, inputs, keys,
+                      self._guard_arrays()))
+            with self.spec.mesh:
+                lowered = self._step.lower(*structs)
+            compiled, result = _cc.cached_compile(
+                lowered, "train_step", mesh=self.spec.mesh)
+            return compiled, result
+        except Exception:
+            import logging
+            logging.exception("compile-cache: trainer step path failed; "
+                              "dispatching through jit")
+            return None, "off"
+
+    def clone(self, spec: Optional[MeshSpec] = None,
+              grad_accum: Optional[int] = None) -> "ShardedTrainer":
+        """A sibling trainer with the same symbol/hyperparameters over a
+        (possibly different) mesh — the standby pre-compiler's shadow:
+        its step program IS the program a post-resize trainer of that
+        spec would build, so pre-compiling it warms the real thing."""
+        return ShardedTrainer(
+            self.symbol, spec if spec is not None else self.spec,
+            data_names=self.data_names, label_names=self.label_names,
+            lr=self.lr, momentum=self.momentum, wd=self.wd,
+            loss_scale=self.init_loss_scale, param_dtype=self.param_dtype,
+            shard_optimizer_state=self.shard_optimizer_state,
+            dynamic_loss_scale=self.dynamic_loss_scale,
+            loss_scale_growth_interval=self.loss_scale_growth_interval,
+            nonfinite_budget=self.nonfinite_budget,
+            guard_nonfinite=self.guard_nonfinite,
+            grad_accum=(grad_accum if grad_accum is not None
+                        else self.grad_accum),
+            zero=self.zero)
+
+    def lower_step_for(self, devices, grad_accum, state, batch_shapes,
+                       input_dtypes=None):
+        """Lower the step program as it would exist over ``devices``
+        with ``grad_accum`` — the warm-standby entry point
+        (compile/standby.py).  ``state`` is this trainer's live
+        ``(params, mom, aux)`` (shapes/dtypes are world-independent);
+        ``batch_shapes`` are the GLOBAL per-update input shapes.
+        Returns ``(lowered, mesh)``; the lowered text is identical to
+        what the post-resize trainer's first step will lower, which is
+        what makes the cache key match."""
+        from .mesh import reform_mesh
+        spec = reform_mesh(self.spec, generation=self.spec.generation + 1,
+                           devices=devices)
+        shadow = self.clone(spec=spec, grad_accum=grad_accum)
+        self._param_shardings()          # resolve parent shapes once
+        shadow._param_shapes = dict(self._param_shapes or {})
+        shadow._last_shapes = dict(getattr(self, "_last_shapes", {}) or {})
+        params, mom, aux = state
+
+        def sds(x):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+
+        dts = input_dtypes or {}
+        accum = shadow.grad_accum
+        input_sds = {}
+        for n in shadow.input_names:
+            shape = tuple(batch_shapes[n])
+            if accum > 1:
+                if shape[0] % accum:
+                    raise ValueError(
+                        "global batch dim %d of %r is not divisible by "
+                        "grad_accum=%d" % (shape[0], n, accum))
+                shape = (accum, shape[0] // accum) + shape[1:]
+            input_sds[n] = jax.ShapeDtypeStruct(
+                shape, dts.get(n, jnp.float32))
+        num_rng = shadow.prog.num_rng
+        keys_sds = jax.ShapeDtypeStruct((num_rng if num_rng else 0, 2),
+                                        jnp.uint32)
+        guard_sds = (jax.ShapeDtypeStruct((), jnp.float32),
+                     jax.ShapeDtypeStruct((), jnp.int32))
+        jitted = shadow._build_step()
+        try:
+            with spec.mesh:
+                lowered = jitted.lower(
+                    tuple(sds(p) for p in params),
+                    tuple(sds(m) for m in mom),
+                    tuple(sds(a) for a in aux),
+                    input_sds, keys_sds, guard_sds)
+        finally:
+            self._arm_mesh()             # _build_step armed the shadow's
+        return lowered, spec.mesh
+
     def set_grad_accum(self, accum: int):
         """Change the gradient-accumulation factor (one optimizer update
         per ``accum`` micro-batches).  The elastic resize path calls this
@@ -504,6 +637,7 @@ class ShardedTrainer:
         if accum != self.grad_accum:
             self.grad_accum = accum
             self._step = None
+            self._step_exec = None
         return self
 
     def _prepare_batch(self, batch):
@@ -560,6 +694,7 @@ class ShardedTrainer:
         if fresh_program:
             self._built_remat = remat
             self._step = self._build_step()
+            self._step_exec = None
         self._step_count += 1
         _chaos.maybe_preempt(self._step_count)
         if _chaos.fire("nan_grad", self._step_count) is not None:
@@ -616,14 +751,30 @@ class ShardedTrainer:
                                     step=self._step_count)
                           if fresh_program else contextlib.nullcontext())
                 with _cspan:
-                    params, mom, aux, loss, ok, guard = self._step(
-                        params, mom, aux, inputs, keys,
-                        self._guard_arrays())
+                    cc_result = "off"
+                    if fresh_program:
+                        # persistent compile cache (mxnet_tpu/compile):
+                        # when armed, the first step deserializes a warm
+                        # executable instead of compiling — the elastic
+                        # resume path pays zero compile after a resize
+                        self._step_exec, cc_result = \
+                            self._compile_step_cached(
+                                params, mom, aux, inputs, keys)
+                    if self._step_exec is not None:
+                        params, mom, aux, loss, ok, guard = \
+                            self._step_exec(params, mom, aux, inputs,
+                                            keys, self._guard_arrays())
+                    else:
+                        params, mom, aux, loss, ok, guard = self._step(
+                            params, mom, aux, inputs, keys,
+                            self._guard_arrays())
                 if fresh_program:
                     from ..telemetry import tracing as _tracing
+                    _cspan.attrs["result"] = cc_result
                     _tracing.note_compile(
                         "train_step", _cspan.duration,
-                        symbol=self.symbol.name or "symbol")
+                        symbol=self.symbol.name or "symbol",
+                        result=cc_result)
                 self._guard_state = guard
             # host-enqueue vs device-block split: the dispatch above is
             # async; this wait is where device time (and a straggling

@@ -44,6 +44,13 @@ recovery machinery is *proven* by tests instead of trusted:
   turned into a persistent straggler past its own published p95, so the
   fleet router's hedging path — not a timeout or a crash — is what keeps
   tail latency bounded.
+* ``corrupt_compile_cache`` — damage a persistent compile-cache entry
+  in place (``mode`` param: ``garbage`` bit-flips inside a buffer,
+  ``truncate`` chops the file) at the moment the cache tries to LOAD
+  it (mxnet_tpu/compile/cache.py consumes the fault), so the drill
+  proves the real read path quarantines the entry (``*.corrupt``),
+  counts ``compile.cache{result=corrupt}`` and falls back to a fresh
+  compile — never a crash, never a stale executable.
 * ``oom``          — request an impossibly large device allocation
   INSIDE the watchdog-armed step region, so the REAL allocator raises
   ``RESOURCE_EXHAUSTED`` through the real dispatch path and the memory

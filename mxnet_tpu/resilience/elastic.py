@@ -440,6 +440,8 @@ class ElasticCoordinator:
         self._resigned = False     # terminal: a resize exit was driven
         self._monitor = None
         self._monitor_stop = threading.Event()
+        self._standby = None       # compile.StandbyCompiler when enabled
+        self._standby_static = {}  # infeasible/unavailable world notes
         set_generation(self.gen)
         if register:
             global _COORD
@@ -696,6 +698,77 @@ class ElasticCoordinator:
             t.join(timeout=2.0)
         self._monitor = None
 
+    # -- warm-standby pre-compilation (compile/standby.py) -----------------
+    def standby_candidates(self, micro_batch: int):
+        """``[(n_devices, grad_accum), ...]`` for the topologies recovery
+        may re-form into: world−1 (lose a rank, bounded by min_workers)
+        and the launcher-advertised grow-back capacity.  Worlds whose
+        grad-accum cannot keep the global batch constant are recorded as
+        infeasible rather than attempted."""
+        world = self.world()
+        # devices-per-rank from the trainer's OWN mesh (a process may
+        # see more devices than the gang uses — single-process tests)
+        per_proc = max(1, self.trainer.spec.mesh.size // max(world, 1))
+        global_batch = micro_batch * world * self.trainer.grad_accum
+        targets = []
+        if world - 1 >= self.min_workers:
+            targets.append(world - 1)
+        cap = self.capacity()
+        if cap is not None and cap > world:
+            targets.append(cap)
+        cands, infeasible = [], {}
+        for w in dict.fromkeys(targets):        # ordered dedupe
+            try:
+                accum = grad_accum_for(global_batch, micro_batch, w)
+            except ValueError as e:
+                infeasible["world%d" % w] = {"result": "infeasible",
+                                             "detail": str(e)}
+                continue
+            cands.append((w * per_proc, accum))
+        return cands, infeasible
+
+    def enable_standby(self, state, micro_batch: int, batch_shapes,
+                       input_dtypes=None, wait: bool = False,
+                       timeout: Optional[float] = None):
+        """Pre-compile the step programs of the adjacent generations into
+        the persistent compile cache (ROADMAP item 5): when the resize
+        actually happens, the relaunched gang's first step deserializes
+        a warm executable — zero in-drill compilation, and the resize
+        manifest records what was pre-compiled.
+
+        Runs on the saver rank only (rank 0 — if IT dies, the
+        coordination KV dies too and elastic already falls back to full
+        restart).  ``state`` is the live ``(params, mom, aux)``;
+        ``batch_shapes`` the GLOBAL per-update input shapes.  A no-op
+        (returning None) when the compile cache is disarmed, the rank is
+        not the saver, or there is no trainer."""
+        from .. import compile as _compile
+        if self.trainer is None or not _compile.enabled() \
+                or not self.is_saver():
+            return None
+        cands, infeasible = self.standby_candidates(micro_batch)
+        self._standby_static = infeasible
+        jobs = _compile.trainer_standby_jobs(
+            self.trainer, state, cands, batch_shapes,
+            input_dtypes=input_dtypes)
+        self._standby = _compile.StandbyCompiler(jobs).start()
+        if wait:
+            self._standby.wait(timeout)
+        return self._standby
+
+    def standby_report(self) -> Optional[dict]:
+        """What the standby plane pre-compiled (folded into the resize
+        manifest so warmth is provable post-hoc): per-world result —
+        ``standby``/``hit`` mean the cache holds that generation's
+        executable — plus the cache directory recovery will read."""
+        if self._standby is None:
+            return None
+        from .. import compile as _compile
+        worlds = dict(getattr(self, "_standby_static", {}) or {})
+        worlds.update(self._standby.results())
+        return {"complete": self._standby.done, "worlds": worlds,
+                "cache_dir": _compile.cache_dir()}
+
     # -- graceful leave / grow-back ---------------------------------------
     def _announce_leave(self, grace: float, step: int):
         """Phase 1 of a graceful leave: publish the notice with the
@@ -857,6 +930,11 @@ class ElasticCoordinator:
                         "reason": reason,
                         "step": int(step),
                         "time": time.time()}
+            standby = self.standby_report()
+            if standby is not None:
+                # which generations the standby plane pre-compiled (the
+                # relaunched gang's first step should find these warm)
+                manifest["precompiled"] = standby
             path = write_manifest(self.elastic_dir, manifest)
             if client is not None:
                 try:

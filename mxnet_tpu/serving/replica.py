@@ -29,6 +29,7 @@ relaunches a 44 immediately and treats anything else as a crash.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import socket
 import sys
@@ -256,6 +257,22 @@ class ReplicaServer:
                    "error": type(err).__name__,
                    "msg": _errmsg(err)})
 
+    @staticmethod
+    def _swap_source(header):
+        """Resolve a prewarm/swap op's model source + the canonical key
+        that lets the swap recognize its own prewarmed standby."""
+        if header.get("synthetic") is not None:
+            src = SyntheticProgram.from_spec(header["synthetic"])
+            key = json.dumps({"synthetic": header["synthetic"]},
+                             sort_keys=True)
+        else:
+            src = header.get("artifact")
+            if not src:
+                raise SwapFailed("op carries neither 'artifact' nor "
+                                 "'synthetic'")
+            key = json.dumps({"artifact": src}, sort_keys=True)
+        return src, key
+
     def _handle(self, header, arrays, reply, pending, pending_lock):
         op = header.get("op")
         call_id = header.get("id")
@@ -296,18 +313,28 @@ class ReplicaServer:
         elif op == "stats":
             reply({"id": call_id, "ok": True, "stats": self._rt.stats(),
                    "replica": self._id})
+        elif op == "prewarm":
+            # the warm half of a rolling swap: validate the incoming
+            # model into the runtime's standby slot while serving
+            # continues; the later swap op with the same source only
+            # flips the pointer inside the drain window
+            try:
+                new, key = self._swap_source(header)
+                self._rt.prewarm(new, key=key)
+                reply({"id": call_id, "ok": True})
+            except ServingError as e:
+                reply({"id": call_id, "ok": False,
+                       "error": type(e).__name__,
+                       "msg": _errmsg(e)})
         elif op == "swap":
             try:
-                if header.get("synthetic") is not None:
-                    new = SyntheticProgram.from_spec(header["synthetic"])
-                else:
-                    new = header.get("artifact")
-                    if not new:
-                        raise SwapFailed("swap op carries neither "
-                                         "'artifact' nor 'synthetic'")
-                self._rt.swap(new)
+                new, key = self._swap_source(header)
+                before = self._rt.stats()["counters"].get("swaps_warm", 0)
+                self._rt.swap(new, prewarmed=key)
+                warm = self._rt.stats()["counters"].get(
+                    "swaps_warm", 0) > before
                 self._model_tag = header.get("tag", self._model_tag)
-                reply({"id": call_id, "ok": True})
+                reply({"id": call_id, "ok": True, "warm": warm})
             except ServingError as e:
                 reply({"id": call_id, "ok": False,
                        "error": type(e).__name__,

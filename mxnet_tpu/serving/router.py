@@ -1148,6 +1148,21 @@ class FleetRouter:
             self._event("swap_begin", targets=targets, tag=tag)
             for rid in targets:
                 try:
+                    # warm rollout: the replica loads + canaries the
+                    # incoming model into its standby slot BEFORE the
+                    # drain, so the drained window holds nothing but the
+                    # pointer flip — p99 stays flat while the fleet
+                    # rolls (a prewarm failure aborts before any drain)
+                    with self._lock:
+                        r = self._replicas.get(rid)
+                        link = r.link if r is not None else None
+                    if link is None or link.down:
+                        raise ReplicaUnavailable(
+                            "replica %d lost before prewarm" % rid)
+                    link.call_sync(self._next_id(),
+                                   dict(header, op="prewarm", id=None),
+                                   timeout=swap_timeout)
+                    self._event("prewarm_ok", replica=rid, tag=tag)
                     self._drain(rid)
                     with self._lock:
                         r = self._replicas.get(rid)
@@ -1155,9 +1170,9 @@ class FleetRouter:
                     if link is None or link.down:
                         raise ReplicaUnavailable(
                             "replica %d lost during drain" % rid)
-                    link.call_sync(self._next_id(),
-                                   dict(header, id=None),
-                                   timeout=swap_timeout)
+                    hdr = link.call_sync(self._next_id(),
+                                         dict(header, id=None),
+                                         timeout=swap_timeout)
                 except ServingError as e:
                     self._event("swap_fail", replica=rid, error=repr(e))
                     self._undrain(rid)
@@ -1168,7 +1183,8 @@ class FleetRouter:
                         "is still serving" % (rid, e, len(swapped)))
                 swapped.append(rid)
                 self._undrain(rid)
-                self._event("swap_ok", replica=rid, tag=tag)
+                self._event("swap_ok", replica=rid, tag=tag,
+                            warm=bool(hdr.get("warm")))
             self._event("swap_complete", replicas=swapped, tag=tag)
             return swapped
 

@@ -109,6 +109,7 @@ class ServingRuntime:
                  watchdog_action=None, report_dir=None, name="serving"):
         self._program = self._load_program(program)
         self._previous = None
+        self._standby_swap = None   # (key, program) validated by prewarm
         self._name = name
         self._batch_dim = int(
             self._program.input_shapes[self._program.input_names[0]][0])
@@ -201,52 +202,90 @@ class ServingRuntime:
                         % (n, new.input_dtypes[n], cur.input_dtypes[n]))
         return None
 
-    def swap(self, source, canary_inputs: Optional[Dict] = None):
-        """Hot-swap to a new model: load (CRC + topology validated by the
-        container path), schema-check, warm-run a canary batch OFF the
-        serving path, then atomically flip the program pointer.  Any
-        validation failure raises :class:`SwapFailed` and the previous
-        model keeps serving — no live request ever sees the rejected
-        artifact.  Returns the installed program."""
+    def _validate_swap(self, source, canary_inputs: Optional[Dict] = None):
+        """Load (CRC + topology validated by the container path),
+        schema-check and canary-run one incoming model OFF the serving
+        path.  Returns the validated program; any failure raises
+        :class:`SwapFailed` (counted) and costs zero live requests.
+        Shared by the direct :meth:`swap` and the :meth:`prewarm` half
+        of a warm rolling swap — the ``bad_swap`` chaos fault fires at
+        whichever validation actually runs."""
+        try:
+            new = self._load_program(source)
+        except Exception as e:
+            with self._lock:
+                self._counters["swap_failures"] += 1
+            raise SwapFailed("could not load %r: %s" % (source, e))
+        mismatch = self._schema_mismatch(new)
+        if mismatch:
+            with self._lock:
+                self._counters["swap_failures"] += 1
+            raise SwapFailed("schema mismatch: %s" % mismatch)
+        canary = canary_inputs or {
+            n: np.zeros(tuple(new.input_shapes[n]), new.input_dtypes[n])
+            for n in new.input_names}
+        try:
+            outs = [np.asarray(o) for o in new.forward(**canary)]
+        except Exception as e:
+            with self._lock:
+                self._counters["swap_failures"] += 1
+            raise SwapFailed("canary run raised: %r" % e)
+        if chaos.fire("bad_swap") is not None:
+            # simulate a poisoned artifact: the canary "computes" NaN
+            outs = [np.full_like(o, np.nan)
+                    if np.issubdtype(o.dtype, np.floating) else o
+                    for o in outs]
+        bad = [i for i, o in enumerate(outs)
+               if np.issubdtype(o.dtype, np.floating)
+               and not np.isfinite(o).all()]
+        if bad:
+            with self._lock:
+                self._counters["swap_failures"] += 1
+            raise SwapFailed(
+                "canary produced non-finite outputs at indices %s; "
+                "previous model keeps serving" % bad)
+        return new
+
+    def prewarm(self, source, key=None, canary_inputs: Optional[Dict] = None):
+        """Load + validate the NEXT model into a standby slot while the
+        current one keeps serving — the warm half of a rolling swap.  A
+        later :meth:`swap` carrying the same ``key`` only flips the
+        program pointer, so the drained window of a fleet rollout
+        contains zero load / deserialize / canary work and p99 stays
+        flat.  Returns the validated standby program."""
         with self._swap_lock:
-            try:
-                new = self._load_program(source)
-            except Exception as e:
-                with self._lock:
-                    self._counters["swap_failures"] += 1
-                raise SwapFailed("could not load %r: %s" % (source, e))
-            mismatch = self._schema_mismatch(new)
-            if mismatch:
-                with self._lock:
-                    self._counters["swap_failures"] += 1
-                raise SwapFailed("schema mismatch: %s" % mismatch)
-            canary = canary_inputs or {
-                n: np.zeros(tuple(new.input_shapes[n]), new.input_dtypes[n])
-                for n in new.input_names}
-            try:
-                outs = [np.asarray(o) for o in new.forward(**canary)]
-            except Exception as e:
-                with self._lock:
-                    self._counters["swap_failures"] += 1
-                raise SwapFailed("canary run raised: %r" % e)
-            if chaos.fire("bad_swap") is not None:
-                # simulate a poisoned artifact: the canary "computes" NaN
-                outs = [np.full_like(o, np.nan)
-                        if np.issubdtype(o.dtype, np.floating) else o
-                        for o in outs]
-            bad = [i for i, o in enumerate(outs)
-                   if np.issubdtype(o.dtype, np.floating)
-                   and not np.isfinite(o).all()]
-            if bad:
-                with self._lock:
-                    self._counters["swap_failures"] += 1
-                raise SwapFailed(
-                    "canary produced non-finite outputs at indices %s; "
-                    "previous model keeps serving" % bad)
+            new = self._validate_swap(source, canary_inputs)
+            self._standby_swap = (key, new)
+            with self._lock:
+                self._counters["prewarms"] += 1
+            telemetry.count("serve.prewarms")
+            return new
+
+    def swap(self, source, canary_inputs: Optional[Dict] = None,
+             prewarmed=None):
+        """Hot-swap to a new model: with ``prewarmed`` matching a
+        standby slot key, atomically flip to the already-validated
+        standby (the WARM path — no load, no canary, nothing slow
+        inside the swap window); otherwise validate ``source`` the
+        PR-4 way first.  Any validation failure raises
+        :class:`SwapFailed` and the previous model keeps serving.
+        Returns the installed program."""
+        with self._swap_lock:
+            standby = self._standby_swap
+            warm = (prewarmed is not None and standby is not None
+                    and standby[0] == prewarmed)
+            if warm:
+                new = standby[1]
+                self._standby_swap = None
+            else:
+                new = self._validate_swap(source, canary_inputs)
             with self._lock:
                 self._previous = self._program
                 self._program = new
                 self._counters["swaps"] += 1
+                if warm:
+                    self._counters["swaps_warm"] += 1
+            telemetry.count("serve.swaps", warm="1" if warm else "0")
             return new
 
     def rollback(self):

@@ -325,11 +325,21 @@ def set_gauge(name, value, **labels):
     gauge(name).set(value, **labels)
 
 
-def counter_total(name) -> float:
-    """Sum of a counter across every label set (0.0 when absent)."""
+def counter_total(name, **labels) -> float:
+    """Sum of a counter across every label set (0.0 when absent).  With
+    ``labels``, only series carrying those exact label values count —
+    e.g. ``counter_total("compile.cache", result="hit")`` sums hits
+    across every ``what``."""
     with _LOCK:
         m = _METRICS.get(name)
-    return m.total() if isinstance(m, Counter) else 0.0
+    if not isinstance(m, Counter):
+        return 0.0
+    if not labels:
+        return m.total()
+    want = set(labels.items())
+    with m._lock:
+        return float(sum(v for k, v in m._series.items()
+                         if want <= set(k)))
 
 
 # ---------------------------------------------------------------------------

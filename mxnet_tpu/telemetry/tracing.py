@@ -453,24 +453,43 @@ _COMPILES_LOCK_FREE: List[dict] = []
 
 def note_compile(name: str, seconds: float, **attrs):
     """Record one compile event (``compile/*`` span family): feeds the
-    ``compile.seconds`` registry histogram when telemetry is armed and
-    an always-on in-process log that :func:`compile_summary` folds into
-    the ungated ``compile_seconds`` bench/ledger extra."""
-    _COMPILES_LOCK_FREE.append({"name": name, "seconds": float(seconds),
+    ``compile.seconds`` registry histogram when telemetry is armed, an
+    always-on in-process log that :func:`compile_summary` folds into
+    the gated ``compile_seconds`` bench/ledger metric, and — when
+    tracing is armed — a root span in this process's flight-recorder
+    sink, so ``tools/postmortem.py --compile`` and tracewatch can prove
+    a recovery window compiled nothing (every span carries the compile
+    cache's ``result`` tag: hit/miss/standby)."""
+    seconds = float(seconds or 0.0)
+    _COMPILES_LOCK_FREE.append({"name": name, "seconds": seconds,
                                 "time": time.time(), **attrs})
     del _COMPILES_LOCK_FREE[:-256]
     if _registry.is_armed():
-        _registry.observe("compile.seconds", float(seconds), what=name)
+        _registry.observe("compile.seconds", seconds, what=name)
+    if is_armed():
+        try:
+            ctx = TraceContext(_new_id(), _new_id(), None, True)
+            record("compile/%s" % name, ctx, time.time() - seconds,
+                   seconds, cat="compile", **attrs)
+        except Exception:
+            pass            # a trace is never worth failing a compile over
 
 
 def compile_summary() -> dict:
-    """``{"count", "total_seconds", "by_name": {name: seconds}}`` over
-    every compile this process has seen (bench.py attaches
-    ``total_seconds`` to its JSON as the ``compile_seconds`` extra)."""
+    """``{"count", "total_seconds", "by_name": {name: seconds},
+    "by_result": {result: count}}`` over every compile this process has
+    seen (bench.py attaches ``total_seconds`` to its JSON as the
+    ``compile_seconds`` metric).  ``by_result`` counts the compile-cache
+    outcome tags (``hit``/``miss``/``standby``/...; events predating the
+    cache count as ``untagged``) — the drills assert warmness from it."""
     events = list(_COMPILES_LOCK_FREE)
     by_name: Dict[str, float] = {}
+    by_result: Dict[str, int] = {}
     for e in events:
         by_name[e["name"]] = by_name.get(e["name"], 0.0) + e["seconds"]
+        r = str(e.get("result", "untagged"))
+        by_result[r] = by_result.get(r, 0) + 1
     return {"count": len(events),
             "total_seconds": round(sum(e["seconds"] for e in events), 6),
-            "by_name": {k: round(v, 6) for k, v in sorted(by_name.items())}}
+            "by_name": {k: round(v, 6) for k, v in sorted(by_name.items())},
+            "by_result": dict(sorted(by_result.items()))}

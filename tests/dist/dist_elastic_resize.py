@@ -24,6 +24,17 @@ Run via ``tools/launch.py -n 4 --elastic --min-workers 3``:
     checks the elastic run's final params/loss match within tolerance,
     and that the fleet view shows the current generation/world plus both
     resize events.
+
+Since PR 13 the drill also proves the compile-time plane (ROADMAP item
+5): the persistent compile cache + warm standby are armed
+(MXNET_TPU_COMPILE_CACHE / MXNET_TPU_TRACE set by the test harness), so
+rank 0 pre-compiles the world-3 step program during generation 0 and
+the generation-1 manifest records it; every resized generation's first
+step must then be a cache HIT — each gen>0 rank asserts ZERO miss/
+untagged compile events (recovery paid no compilation), provable
+post-hoc from the compile/* spans in the trace sinks
+(tools/tracewatch.py --check / tools/postmortem.py --compile run over
+them in the tier-1 test).
 """
 import os
 import sys
@@ -124,6 +135,7 @@ def main():
     telemetry.arm()
     rank, world = jax.process_index(), jax.process_count()
     gen = elastic.generation()
+    telemetry.tracing.set_process_label("rank%d-g%d" % (rank, gen))
     if rank == 0 and gen == 0:
         os.makedirs(CKPT_DIR, exist_ok=True)
     parallel.barrier("elastic_start")
@@ -179,6 +191,27 @@ def main():
         print("dist_elastic_resize rank %d RESUMED gen=%d world=%d "
               "updates=%d accum=%d" % (rank, gen, world, updates, accum),
               flush=True)
+        if gen == 1 and rank == 0:
+            # the satellite: the resize manifest names the pre-compiled
+            # generation — world 3 must have been warmed before the kill
+            m = elastic.read_manifest(CKPT_DIR, 1) or {}
+            w3 = ((m.get("precompiled") or {}).get("worlds")
+                  or {}).get("world3") or {}
+            assert w3.get("result") in ("standby", "hit"), m
+            print("dist_elastic_resize MANIFEST precompiled world3=%s"
+                  % w3.get("result"), flush=True)
+
+    # warm-standby plane (ROADMAP item 5): rank 0 pre-compiles the
+    # adjacent generations' step programs into the shared persistent
+    # cache BEFORE anything fails, so each resized generation's first
+    # step below deserializes instead of compiling.  The drill waits
+    # for the background compile (the kill at update 8 must find the
+    # cache warm); production would let it run free.
+    coord.enable_standby(
+        (params, mom, aux), micro_batch=MICRO,
+        batch_shapes={"data": (GLOBAL_BATCH, DIM),
+                      "softmax_label": (GLOBAL_BATCH,)},
+        wait=True, timeout=120)
 
     if gen == 0 and rank == 1:
         if MODE == "kill":
@@ -187,6 +220,7 @@ def main():
             chaos.inject("preempt_notice", at_step=NOTICE_AT,
                          grace=30.0).__enter__()
 
+    resumed_at = updates
     while updates < TOTAL_UPDATES:
         coord.precheck(updates)
         batch = next_update_batch(it)
@@ -201,6 +235,18 @@ def main():
                 os._exit(77)
         updates += 1
         coord.note_step(updates, (params, mom, aux))
+        if gen > 0 and updates == resumed_at + 1:
+            # ROADMAP item 5 acceptance, checked at the exact moment it
+            # matters — the first post-resize update: the step program
+            # was deserialized from the warm cache (hit), nothing was
+            # compiled in-drill (no miss, no untagged event)
+            cs = telemetry.tracing.compile_summary()
+            assert cs["by_result"].get("miss", 0) == 0 and \
+                cs["by_result"].get("untagged", 0) == 0, cs
+            assert cs["by_result"].get("hit", 0) >= 1, cs
+            print("dist_elastic_resize rank %d gen=%d WARM compile "
+                  "by_result=%s" % (rank, gen, cs["by_result"]),
+                  flush=True)
 
     # -- completion ---------------------------------------------------------
     if MODE == "kill":
@@ -211,6 +257,17 @@ def main():
         # notice -> shrink, no capacity pressure to grow: finish at 3
         assert gen == 1, "expected one graceful resize, got gen %d" % gen
         assert world == 3, world
+    # the acceptance bound (ROADMAP item 5): a resized generation must
+    # resume with ZERO in-drill compilation — every compile/* event in
+    # this process was a cache hit, none was a miss (the standby or the
+    # previous full-size run warmed the cache).  Asserted BEFORE the
+    # reference run below, which deliberately compiles a fresh program.
+    if gen > 0:
+        # still zero in-drill compilation by the END of the generation
+        cs = telemetry.tracing.compile_summary()
+        assert cs["by_result"].get("miss", 0) == 0 and \
+            cs["by_result"].get("untagged", 0) == 0, cs
+
     # training is done — de-arm the elastic machinery and relax the
     # watchdog before the verification phase: rank 0's solo reference
     # run keeps the others waiting in the final barrier far longer than

@@ -62,7 +62,12 @@ def _run_elastic(mode, tmp_path, final_world, timeout=420):
     env.pop("XLA_FLAGS", None)      # ... with ONE local device per rank
     env.update({"ELASTIC_CKPT_DIR": str(tmp_path),
                 "ELASTIC_DRILL_MODE": mode,
-                "MXNET_TPU_TELEMETRY": "1"})
+                "MXNET_TPU_TELEMETRY": "1",
+                # the compile-time plane (PR 13): persistent executable
+                # cache + warm standby armed for every generation, trace
+                # sinks in the drill dir so warmness is provable post-hoc
+                "MXNET_TPU_COMPILE_CACHE": str(tmp_path / "compile-cache"),
+                "MXNET_TPU_TRACE": "1"})
     r = subprocess.run(
         [sys.executable, LAUNCH, "-n", "4", "--elastic", "--min-workers",
          "3", "--elastic-dir", str(tmp_path), sys.executable,
@@ -95,10 +100,19 @@ def test_dist_elastic_resize_4proc(tmp_path):
     assert "resize: generation 1 -> world 3" in out
     assert "resize: generation 2 -> world 4" in out
 
+    # zero in-drill compilation (ROADMAP item 5 acceptance): every
+    # resized rank asserted its compile events were all cache hits —
+    # 3 ranks at gen 1 + 4 at gen 2
+    assert out.count("WARM compile by_result=") == 7, out[-1500:]
+    assert "MANIFEST precompiled world3=" in out
+
     # the committed manifests ARE the resize record the tooling renders
     with open(tmp_path / "elastic-manifest-g0001.json") as f:
         m1 = json.load(f)
     assert m1["world_size"] == 3 and m1["dead"] == [1]
+    # the manifest records the pre-compiled generation (warm standby)
+    assert m1["precompiled"]["worlds"]["world3"]["result"] in (
+        "standby", "hit"), m1
     with open(tmp_path / "elastic-manifest-g0002.json") as f:
         m2 = json.load(f)
     assert m2["world_size"] == 4 and m2["reason"] == "grow_back"
@@ -110,6 +124,25 @@ def test_dist_elastic_resize_4proc(tmp_path):
     assert r.returncode == 0
     assert "ELASTIC RESIZE TIMELINE" in r.stdout
     assert "4 -> 3" in r.stdout and "3 -> 4" in r.stdout
+
+    # the drill's trace sinks carry the compile/* spans: tracewatch
+    # --check must merge them orphan-free, and postmortem --compile
+    # renders the hit/miss timeline + cache stats
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "tracewatch.py"),
+         str(tmp_path), "--check",
+         "--out", str(tmp_path / "merged-trace.json")],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "postmortem.py"),
+         str(tmp_path), "--compile",
+         "--cache-dir", str(tmp_path / "compile-cache")],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "COMPILE TIMELINE" in r.stdout
+    assert "hit=" in r.stdout        # summary counts warm loads
+    assert "CACHE" in r.stdout       # entry/quarantine stats rendered
 
 
 def test_dist_elastic_notice_4proc(tmp_path):
@@ -127,6 +160,9 @@ def test_dist_elastic_notice_4proc(tmp_path):
     assert "RESUMED gen=1 world=3 updates=9 accum=4" in out
     assert "resize: generation 1 -> world 3 (from 4, peer_preempt_notice)" \
         in out
+    # the graceful resize is warm too: the 3 survivors' first step at
+    # world 3 deserialized the standby executable
+    assert out.count("WARM compile by_result=") == 3, out[-1500:]
 
 
 def test_dist_async_train_4proc():

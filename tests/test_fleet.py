@@ -477,9 +477,25 @@ def test_rolling_swap_under_load_with_rollback(tmp_path):
         assert not res["err"], \
             "requests failed during rolling swaps: %s" % res
         assert res["ok"] > 100
-        events = [e["event"] for e in _events(fleet)]
+        all_events = _events(fleet)
+        events = [e["event"] for e in all_events]
         assert "swap_complete" in events
         assert events.count("drain") >= 3
+        # warm-load on every replica: each swapped replica prewarmed the
+        # incoming model BEFORE its drain (prewarm_ok precedes drain in
+        # the event log) and activated the prewarmed standby (warm=True
+        # echoed by the replica) — the drained window held nothing but
+        # the pointer flip
+        swap_oks = [e for e in all_events if e["event"] == "swap_ok"]
+        assert swap_oks and all(e.get("warm") for e in swap_oks), swap_oks
+        prewarm_rids = {e["replica"] for e in all_events
+                        if e["event"] == "prewarm_ok"}
+        assert {e["replica"] for e in swap_oks} <= prewarm_rids
+        for rid in sorted(prewarm_rids):
+            seq = [e["event"] for e in all_events
+                   if e.get("replica") == rid
+                   and e["event"] in ("prewarm_ok", "drain")]
+            assert seq.index("prewarm_ok") < seq.index("drain"), seq
     finally:
         fleet.close()
 

@@ -291,7 +291,8 @@ def test_topology_mismatch_refused_and_overridable(artifact, tmp_path,
     wrong = _rewrite_meta(
         artifact, str(tmp_path / "wrong.mxt"),
         lambda m: m.update(platform="tpu", device_kind="TPU v9000",
-                           device_count=4096))
+                           device_count=4096,
+                           topologies={"tpu|TPU v9000|4096": "executable"}))
     with pytest.raises(TopologyMismatch, match="TPU v9000"):
         ServedProgram.load(wrong)
     monkeypatch.setenv("MXNET_TPU_SERVED_IGNORE_TOPOLOGY", "1")
@@ -302,8 +303,9 @@ def test_legacy_artifact_without_topology_loads_with_warning(
         artifact, tmp_path, caplog):
     legacy = _rewrite_meta(
         artifact, str(tmp_path / "legacy.mxt"),
-        lambda m: [m.pop(k) for k in
-                   ("platform", "device_kind", "device_count")])
+        lambda m: [m.pop(k, None) for k in
+                   ("platform", "device_kind", "device_count",
+                    "topologies")])
     import logging
     with caplog.at_level(logging.WARNING):
         ServedProgram.load(legacy)

@@ -22,12 +22,17 @@ Usage:
                     (default 0.05 = 5%: sub-noise-floor trajectories
                     would otherwise flag measurement jitter)
 
-Gate semantics (per metric, higher-is-better):  the latest entry is
-compared against the best-known value in the history; the noise scale is
-the sigma of historical DRAWDOWNS (relative drops below the running max
-— improvements are signal, not noise, and must not widen the band).  A
-drop beyond ``max(sigma * noise, floor)`` is a regression.  ``append``
-accepts bench.py's raw JSON line or the driver's BENCH_r*.json wrapper
+Gate semantics (per metric):  the latest entry is compared against the
+best-known value in the history; the noise scale is the sigma of
+historical excursions past the running best (drawdowns below the
+running max for higher-is-better metrics — improvements are signal,
+not noise, and must not widen the band).  A move beyond
+``max(sigma * noise, floor)`` in the WRONG direction is a regression.
+Most metrics (img/s, tok/s, MFU) are higher-is-better;
+``compile_seconds`` (and its ``transformer_`` twin) is gated
+LOWER-is-better — a compile-time improvement (a drop) can never read
+as a regression, a compile-time blow-up does.  ``append`` accepts
+bench.py's raw JSON line or the driver's BENCH_r*.json wrapper
 (``{"parsed": {...}}``); bench.py appends automatically when
 ``BENCH_LEDGER`` names a ledger path.
 
@@ -50,10 +55,16 @@ import time
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_LEDGER = os.path.join(_REPO, "PERF_LEDGER.jsonl")
 
-# metrics where lower is better would invert the gate; everything the
-# bench emits today (img/s, tok/s, MFU) is higher-is-better
 SIGMA_MULT = 4.0
 FLOOR = 0.05
+
+
+def lower_is_better(name):
+    """Metrics gated in the inverted direction (a DROP is the
+    improvement): today the compile-time plane's ``compile_seconds``
+    (promoted from an ungated extra once the compile cache landed —
+    recovery-without-recompilation is a gated property now)."""
+    return name.endswith("compile_seconds")
 
 
 # ---------------------------------------------------------------------------
@@ -74,10 +85,18 @@ def extract_metrics(doc):
         out[name] = float(doc["value"])
     if isinstance(doc.get("mfu"), (int, float)):
         out[(name or "bench") + "_mfu"] = float(doc["mfu"])
+    # compile time is a GATED metric since the compile-cache round
+    # (lower-is-better: see lower_is_better()); it was an ungated extra
+    # before — metric_series() still folds those legacy extras into the
+    # same history
+    phases = doc.get("phases")
+    if isinstance(phases, dict) and \
+            isinstance(phases.get("compile_seconds"), (int, float)):
+        out["compile_seconds"] = round(float(phases["compile_seconds"]), 6)
     sub = doc.get("transformer")
     if isinstance(sub, dict):
         for k, v in extract_metrics(sub).items():
-            out[k] = v
+            out["transformer_" + k if k == "compile_seconds" else k] = v
     return out
 
 
@@ -98,11 +117,8 @@ def extract_extra(doc):
         for field in ("peak_hbm_bytes", "collective_bytes_per_step"):
             if isinstance(phases.get(field), (int, float)):
                 out[field] = int(phases[field])
-        # compile time (ROADMAP item 5): seconds, fractional — ungated
-        # like the byte extras (a compile-time improvement is a drop)
-        if isinstance(phases.get("compile_seconds"), (int, float)):
-            out["compile_seconds"] = round(
-                float(phases["compile_seconds"]), 6)
+        # compile_seconds moved from here into extract_metrics when it
+        # was promoted to a (lower-is-better) gated metric
     sub = doc.get("transformer")
     if isinstance(sub, dict):
         for k, v in extract_extra(sub).items():
@@ -146,10 +162,17 @@ def read_ledger(path):
 
 def metric_series(entries):
     """{metric: [values in ledger order]} (rounds missing a metric are
-    simply absent from that series)."""
+    simply absent from that series).  Lower-is-better metrics that
+    older rounds recorded in the ungated ``extra`` block (compile
+    seconds before its promotion) are folded into the same series, so
+    the gate has its full history from day one."""
     out = {}
     for e in entries:
-        for k, v in e["metrics"].items():
+        merged = dict(e["metrics"])
+        for k, v in (e.get("extra") or {}).items():
+            if lower_is_better(k) and k not in merged:
+                merged[k] = v
+        for k, v in merged.items():
             if isinstance(v, (int, float)):
                 out.setdefault(k, []).append(float(v))
     return out
@@ -175,25 +198,50 @@ def drawdown_sigma(history):
     return statistics.stdev(draws)
 
 
-def check_series(values, sigma_mult=SIGMA_MULT, floor=FLOOR):
+def rise_sigma(history):
+    """Noise scale of a LOWER-is-better series: the sigma of relative
+    rises above the running min — mirror image of drawdown_sigma
+    (improvements, i.e. drops, are signal and never widen the band)."""
+    if len(history) < 2:
+        return 0.0
+    run_min = history[0]
+    rises = []
+    for v in history[1:]:
+        run_min = min(run_min, v)
+        rises.append((v - run_min) / run_min if run_min > 0 else 0.0)
+    if len(rises) < 2:
+        return rises[0] if rises else 0.0
+    return statistics.stdev(rises)
+
+
+def check_series(values, sigma_mult=SIGMA_MULT, floor=FLOOR, lower=False):
     """Gate one metric's trajectory: is the LATEST value a regression
-    against the best-known, beyond the history's own noise?
+    against the best-known, beyond the history's own noise?  ``lower``
+    inverts the direction (best = running MIN, a rise regresses) — so a
+    compile-time improvement can never read as a regression and a
+    blow-up cannot hide.
 
     Returns {"checked", "regression", "latest", "best", "drop",
-    "threshold", "noise_sigma"}."""
+    "threshold", "noise_sigma", "direction"}."""
     if len(values) < 2:
         return {"checked": False, "regression": False,
                 "n": len(values)}
     history, latest = values[:-1], values[-1]
-    best = max(history)
-    drop = (best - latest) / best if best > 0 else 0.0
-    noise = drawdown_sigma(history)
+    if lower:
+        best = min(history)
+        move = (latest - best) / best if best > 0 else 0.0
+        noise = rise_sigma(history)
+    else:
+        best = max(history)
+        move = (best - latest) / best if best > 0 else 0.0
+        noise = drawdown_sigma(history)
     threshold = max(sigma_mult * noise, floor)
     return {"checked": True,
-            "regression": drop > threshold,
+            "regression": move > threshold,
             "latest": latest, "best": best,
-            "drop": round(drop, 4), "threshold": round(threshold, 4),
-            "noise_sigma": round(noise, 4), "n": len(values)}
+            "drop": round(move, 4), "threshold": round(threshold, 4),
+            "noise_sigma": round(noise, 4), "n": len(values),
+            "direction": "lower" if lower else "higher"}
 
 
 def check_ledger(entries, sigma_mult=SIGMA_MULT, floor=FLOOR):
@@ -201,7 +249,8 @@ def check_ledger(entries, sigma_mult=SIGMA_MULT, floor=FLOOR):
     results = {}
     ok = True
     for name, values in sorted(metric_series(entries).items()):
-        r = check_series(values, sigma_mult=sigma_mult, floor=floor)
+        r = check_series(values, sigma_mult=sigma_mult, floor=floor,
+                         lower=lower_is_better(name))
         results[name] = r
         if r["regression"]:
             ok = False
@@ -253,9 +302,10 @@ def _cmd_check(args):
                 print("  %-48s %d point(s), not gated" % (name, r["n"]))
                 continue
             verdict = "REGRESSION" if r["regression"] else "ok"
-            print("  %-48s latest %.4g vs best %.4g  drop %.1f%% "
+            word = ("rise" if r.get("direction") == "lower" else "drop")
+            print("  %-48s latest %.4g vs best %.4g  %s %.1f%% "
                   "(threshold %.1f%%, noise sigma %.2f%%)  %s"
-                  % (name, r["latest"], r["best"], 100 * r["drop"],
+                  % (name, r["latest"], r["best"], word, 100 * r["drop"],
                      100 * r["threshold"], 100 * r["noise_sigma"],
                      verdict))
         if not ok:

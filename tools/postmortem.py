@@ -223,6 +223,88 @@ def print_fleet_timeline(target):
     return 0
 
 
+def find_trace_sinks(target):
+    if os.path.isfile(target):
+        if target.endswith(".jsonl"):
+            return [target]
+        target = os.path.dirname(os.path.abspath(target))
+    return sorted(glob.glob(os.path.join(target, "trace-*.jsonl")))
+
+
+def print_compile_timeline(target, cache_dir=None):
+    """Render the compile-time plane: every ``compile/*`` span from the
+    per-process trace sinks (tagged hit/miss/standby by the persistent
+    compile cache) as a per-program timeline, plus the cache
+    directory's entry/quarantine stats — the view that proves "recovery
+    paid zero compilation" (or shows exactly where it did not)."""
+    sinks = find_trace_sinks(target)
+    spans = []
+    for path in sinks:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    s = json.loads(line)
+                except ValueError:
+                    continue
+                if str(s.get("name", "")).startswith("compile/"):
+                    spans.append(s)
+    hrule("=")
+    print("COMPILE TIMELINE (%d compile span(s) from %d sink(s))"
+          % (len(spans), len(sinks)))
+    hrule("=")
+    if spans:
+        spans.sort(key=lambda s: s.get("t0", 0))
+        print("%-20s %-12s %-26s %-8s %9s  %s"
+              % ("time", "proc", "what", "result", "seconds", "detail"))
+        by_result = {}
+        total = 0.0
+        for s in spans:
+            attrs = s.get("attrs") or {}
+            result = str(attrs.get("result", "untagged"))
+            by_result[result] = by_result.get(result, 0) + 1
+            dur = float(s.get("dur", 0.0))
+            total += dur
+            detail = "  ".join(
+                "%s=%s" % (k, v) for k, v in sorted(attrs.items())
+                if k not in ("result",))
+            print("%-20s %-12s %-26s %-8s %9.3f  %s"
+                  % (fmt_ts(s.get("t0")), s.get("proc", "?"),
+                     s.get("name", "?"), result, dur, detail))
+        print()
+        print("summary: " + "  ".join("%s=%d" % kv for kv in
+                                      sorted(by_result.items()))
+              + "  total %.3fs" % total)
+        misses = by_result.get("miss", 0) + by_result.get("untagged", 0)
+        if not misses:
+            print("zero cache misses: every compile in this window was "
+                  "served warm (hit) or taken off the hot path (standby)")
+    else:
+        print("(no compile/* spans — was MXNET_TPU_TRACE armed?)")
+
+    cache_dir = cache_dir or os.environ.get("MXNET_TPU_COMPILE_CACHE")
+    if cache_dir and os.path.isdir(cache_dir):
+        entries = quarantined = size = 0
+        for name in os.listdir(cache_dir):
+            p = os.path.join(cache_dir, name)
+            if name.startswith("cc-") and name.endswith(".mxc"):
+                entries += 1
+                try:
+                    size += os.path.getsize(p)
+                except OSError:
+                    pass
+            elif name.endswith(".corrupt"):
+                quarantined += 1
+        print()
+        print("CACHE %s: %d entr%s (%.1f MB), %d quarantined"
+              % (cache_dir, entries, "y" if entries == 1 else "ies",
+                 size / 1e6, quarantined))
+    hrule()
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("target", help="a post-mortem .json or a directory "
@@ -237,11 +319,22 @@ def main(argv=None):
                     help="render the serving fleet's join/evict/swap "
                          "timeline from fleet-events.jsonl (a fleet dir "
                          "or the file itself)")
+    ap.add_argument("--compile", action="store_true", dest="compile_plane",
+                    help="render the compile timeline (compile/* spans "
+                         "with their cache hit/miss/standby tags) from "
+                         "the trace-*.jsonl sinks, plus compile-cache "
+                         "stats")
+    ap.add_argument("--cache-dir", default=None,
+                    help="compile-cache directory for --compile stats "
+                         "(default: $MXNET_TPU_COMPILE_CACHE)")
     args = ap.parse_args(argv)
     if args.elastic:
         return print_elastic_timeline(args.target)
     if args.fleet:
         return print_fleet_timeline(args.target)
+    if args.compile_plane:
+        return print_compile_timeline(args.target,
+                                      cache_dir=args.cache_dir)
     reports = find_reports(args.target)
     if not reports:
         print("no watchdog post-mortem reports under %r" % args.target,
