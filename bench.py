@@ -202,9 +202,94 @@ def _transformer_main(as_dict=False, batch=None, iters=None):
     print(json.dumps(result))
 
 
+def _recommender_main(as_dict=False):
+    """BENCH_MODEL=recommender: DLRM-style criteo-toy click predictor —
+    the sparse-at-scale number of record.  Categorical features hit
+    mesh-sharded embedding tables through the routed lookup
+    (mxnet_tpu/sparse: all-to-all bytes ~ touched rows, tables
+    row-sharded over dp), dense features run the MLP, and the tables
+    take the touched-rows-only lazy SGD.  Geometry knobs:
+    BENCH_REC_TABLES/VOCAB/EMBED_DIM/DENSE, batch via BENCH_BATCH.
+    MXNET_TPU_PALLAS_EMBED picks the shard-local kernel backend (unset:
+    the autotune-cache winner)."""
+    batch = int(os.environ.get("BENCH_BATCH", "4096"))
+    n_tables = int(os.environ.get("BENCH_REC_TABLES", "4"))
+    vocab = int(os.environ.get("BENCH_REC_VOCAB", "100000"))
+    dim = int(os.environ.get("BENCH_REC_EMBED_DIM", "16"))
+    dense_dim = int(os.environ.get("BENCH_REC_DENSE", "13"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "3"))
+    iters = int(os.environ.get("BENCH_ITERS", "20"))
+
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu  # noqa: F401
+    from mxnet_tpu.parallel.mesh import MeshSpec, make_mesh
+    from mxnet_tpu.sparse import (ShardedEmbedding, make_recommender_step,
+                                  recommender_state,
+                                  step_alltoall_model_bytes)
+
+    devices = jax.devices()
+    n_dev = len([d for d in devices if d.platform != "cpu"]) or 1
+    platform = devices[0].platform
+    spec = MeshSpec(make_mesh((n_dev,), ("dp",)))
+    gb = batch * n_dev
+    embs = [ShardedEmbedding(vocab, dim, spec, name="table%d" % f)
+            for f in range(n_tables)]
+    state = recommender_state(embs, dense_dim=dense_dim,
+                              hidden=(64, 32), seed=0)
+    step = make_recommender_step(embs, lr=0.05, momentum=0.9)
+    key = jax.random.PRNGKey(0)
+    bat = spec.batch_sharding()
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    ids = jax.device_put(
+        jax.random.randint(key, (n_tables, gb), 0, vocab, jnp.int32),
+        NamedSharding(spec.mesh, P(None, "dp")))
+    dense = jax.device_put(
+        jax.random.uniform(key, (gb, dense_dim), jnp.float32), bat)
+    label = jax.device_put(
+        (jax.random.uniform(key, (gb,)) > 0.5).astype(jnp.float32), bat)
+    feed = {"ids": ids, "dense": dense, "label": label}
+    for _ in range(warmup):
+        state, loss = step(state, feed)
+    float(loss)   # full sync (bench methodology: drain the tunnel)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, loss = step(state, feed)
+    float(loss)
+    dt = time.perf_counter() - t0
+    ex_s = gb * iters / dt / n_dev
+    a2a = n_tables * step_alltoall_model_bytes(gb, dim, n_dev)
+    result = {
+        "metric": "recommender_train_examples_per_sec_per_chip",
+        "value": round(ex_s, 2),
+        "unit": "examples/sec/chip (%d tables x %dx%d, dense %d, bs%d, "
+                "%d %s dev%s)" % (n_tables, vocab, dim, dense_dim, batch,
+                                  n_dev, platform,
+                                  "s" if n_dev > 1 else ""),
+        "vs_baseline": None,
+        "embedding": {
+            "tables": n_tables, "vocab": vocab, "dim": dim,
+            "table_mb_total": round(
+                sum(e.table_bytes for e in embs) / 1e6, 2),
+            "alltoall_model_bytes_per_step": a2a,
+            "backend": embs[0].backend or "auto",
+        },
+    }
+    _attach_phases(result, step, n_dev, dt / iters, "recommender")
+    if as_dict:
+        return result
+    print(json.dumps(result))
+
+
 def main():
-    if os.environ.get("BENCH_MODEL", "resnet50") == "transformer":
+    model = os.environ.get("BENCH_MODEL", "resnet50")
+    if model == "transformer":
         result = _transformer_main(as_dict=True)
+        _maybe_ledger(result)
+        print(json.dumps(result))
+        return
+    if model == "recommender":
+        result = _recommender_main(as_dict=True)
         _maybe_ledger(result)
         print(json.dumps(result))
         return

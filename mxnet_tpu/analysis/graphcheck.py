@@ -49,6 +49,15 @@ GC304     collectives-serialized   warning   multi-device program moving real
                                              PR-6 overlap instrument,
                                              costmodel.collective_compute_
                                              overlap, is the oracle)
+GC306     densified-embedding-     warning   a program that contains a routed
+          grad                               sharded-embedding lookup (all-to-
+                                             all present) yet moves full-table-
+                                             sized gradient bytes through ONE
+                                             dense all-reduce / all-gather —
+                                             the "you densified your embedding
+                                             grad" footgun: wire bytes scale
+                                             with table size instead of
+                                             touched rows
 GC401     static-float-attr        warning   per-step float attr (lr/wd/...)
                                              reaching an op as a STATIC jit
                                              key -> recompile every step
@@ -87,8 +96,8 @@ except ImportError:                     # older: the classic namespace
 __all__ = ["CollectiveEvent", "collect_collectives", "check_jaxpr",
            "check_fn", "check_symbol", "check_registry",
            "check_replication", "check_capacity", "check_overlap",
-           "check_trainer", "check_executor", "PER_STEP_ATTRS",
-           "COLLECTIVE_PRIMS"]
+           "check_embedding_grad", "check_trainer", "check_executor",
+           "PER_STEP_ATTRS", "COLLECTIVE_PRIMS"]
 
 # every collective primitive we track (axis_index is deliberately absent:
 # it reads the axis env but moves no data and cannot desync)
@@ -685,6 +694,80 @@ def check_zero_update(dp_size: int, update_sharded: bool,
                  "weights all-gather back — identical numerics; or "
                  "raise MXNET_TPU_GC305_MIN_MB",
         extra={"grad_payload_bytes": payload, "dp_size": int(dp_size)})
+    return rep
+
+
+def _embedding_threshold_bytes() -> int:
+    try:
+        mb = float(os.environ.get("MXNET_TPU_GC306_MIN_MB", "8"))
+    except ValueError:
+        mb = 8.0
+    return int(mb * (1 << 20))
+
+
+def check_embedding_grad(hlo_text: str, table_bytes=None, target: str = "",
+                         min_bytes: Optional[int] = None) -> Report:
+    """GC306: the densified-embedding-gradient footgun.
+
+    A program that routes a sharded-embedding lookup (the all-to-all
+    signature of :mod:`mxnet_tpu.sparse.embedding`) should move gradient
+    bytes proportional to *touched rows*; a single dense all-reduce /
+    all-gather of full-table-sized payload in the same program means a
+    table's gradient was materialized dense — usually a half-migrated
+    model that still differentiates a replicated copy of a table, paying
+    table-size wire bytes every step.
+
+    ``table_bytes``: per-table GLOBAL byte sizes (defaults to the live
+    :func:`~mxnet_tpu.sparse.embedding.live_tables` registry).  The
+    flagging threshold is ``max(MXNET_TPU_GC306_MIN_MB, half the
+    smallest table)`` so toy MLP grads in the same program never trip
+    it.  Payload conventions match ``parallel.audit``: sync ops report
+    result bytes, async ``-start`` their operand bytes."""
+    from ..parallel.audit import _shape_bytes
+    from . import costmodel
+    rep = Report("graphcheck", target)
+    instrs = list(costmodel.iter_instructions(hlo_text))
+    if not any(i.opcode.split("-start")[0] == "all-to-all"
+               for i in instrs):
+        return rep          # no routed lookup in this program
+    if table_bytes is None:
+        try:
+            from ..sparse.embedding import live_tables
+            table_bytes = [b for _n, b in live_tables()]
+        except Exception:
+            table_bytes = []
+    table_bytes = [int(b) for b in (table_bytes or []) if b]
+    floor = _embedding_threshold_bytes() if min_bytes is None \
+        else int(min_bytes)
+    threshold = max(floor, min(table_bytes) // 2) if table_bytes else floor
+    for ins in instrs:
+        op = ins.opcode
+        base = op[:-len("-start")] if op.endswith("-start") else op
+        if base not in ("all-reduce", "all-gather") or \
+                op.endswith("-done"):
+            continue
+        payload = _shape_bytes(ins.operands) if op.endswith("-start") \
+            else ins.result_bytes
+        if payload < threshold:
+            continue
+        rep.add(
+            "GC306", "warning",
+            "%s %r moves %.1f MB in ONE dense collective while this "
+            "program also routes a sharded-embedding lookup: an "
+            "embedding gradient was densified, so wire bytes scale "
+            "with table size (%s MB tables live) instead of touched "
+            "rows" % (base, ins.name, payload / 1e6,
+                      ",".join("%.0f" % (b / 1e6) for b in table_bytes)
+                      or "?"),
+            location=target,
+            fix_hint="differentiate with respect to the looked-up ROWS "
+                     "and feed (ids, grad_rows) to ShardedEmbedding."
+                     "apply_sgd/apply_adam (the touched-rows lazy "
+                     "update); shard the table with __shard__/P(axis) "
+                     "instead of replicating it; or raise "
+                     "MXNET_TPU_GC306_MIN_MB",
+            extra={"payload_bytes": int(payload), "instruction": ins.name,
+                   "table_bytes": table_bytes})
     return rep
 
 

@@ -11,6 +11,13 @@ without a sparse rule densifies first — the exact storage-fallback semantics
 of the reference (src/common/exec_utils.h).  The capability the reference
 gets from row_sparse — touching only the active rows of a huge embedding —
 is preserved in `RowSparseNDArray.retain` + sparse optimizer paths.
+
+This module is the HOST boundary (kvstore push/pull, eager optimizer
+updates) and the single semantic reference for lazy updates.  The IN-JIT
+twin — tables row-sharded over the mesh, lookups compiled as owner-shard
+routing with all-to-all bytes proportional to touched rows, sharded lazy
+SGD/Adam proven bit-equal to the kernels here — lives in
+:mod:`mxnet_tpu.sparse` (docs/sparse.md).
 """
 from __future__ import annotations
 

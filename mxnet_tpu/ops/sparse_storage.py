@@ -14,6 +14,13 @@ carry a storage-type rule so ``infer_storage_type`` can mark which graph
 edges are logically sparse: the executor uses that to accept sparse
 NDArray feeds (densified lazily at the boundary) and to convert outputs
 back via ``tostype``.
+
+"Every tensor is dense inside jit" stopped being the whole story when
+the sharded embedding plane landed: :mod:`mxnet_tpu.sparse` compiles
+row-sharded tables with touched-rows-only lookup/update INSIDE jit
+(owner-shard routing over the mesh, docs/sparse.md).  These registry
+ops stay the symbolic-graph surface; graphs that need tables beyond
+one device's HBM use the sparse plane directly.
 """
 from __future__ import annotations
 

@@ -44,9 +44,10 @@ breaking and hot model-swap built ON these primitives — is
 """
 from .container import (CorruptContainer, peek_header, read_container,
                         write_container)
-from .checkpoint import (Checkpoint, CheckpointManager, restore_gluon_trainer,
-                         restore_module, restore_trainer, save_gluon_trainer,
-                         save_module, save_trainer)
+from .checkpoint import (Checkpoint, CheckpointManager, restore_embedding,
+                         restore_gluon_trainer, restore_module,
+                         restore_trainer, save_embedding,
+                         save_gluon_trainer, save_module, save_trainer)
 from .guards import GradientGuard, NonFiniteError
 from .retry import call_with_retry, retry_config
 from .watchdog import HeartbeatLane, Watchdog

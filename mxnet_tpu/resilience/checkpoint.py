@@ -38,7 +38,8 @@ from .container import CorruptContainer, read_container, write_container
 
 __all__ = ["Checkpoint", "CheckpointManager", "save_trainer",
            "restore_trainer", "save_module", "restore_module",
-           "save_gluon_trainer", "restore_gluon_trainer"]
+           "save_gluon_trainer", "restore_gluon_trainer",
+           "save_embedding", "restore_embedding"]
 
 _SUFFIX = ".mxtck"
 
@@ -575,3 +576,65 @@ def _load_guard(guard, meta):
     if guard is not None and "loss_scale" in meta:
         guard.scale = float(meta["loss_scale"])
         guard.good_steps = int(meta.get("good_steps", 0))
+
+
+# ---------------------------------------------------------------------------
+# ShardedEmbedding adapter (mxnet_tpu/sparse): resharding restore
+# ---------------------------------------------------------------------------
+
+def save_embedding(manager, embs, states, step, extra_meta=None):
+    """Snapshot one or more sharded embedding planes as ONE atomic
+    checkpoint.  ``embs``: ShardedEmbedding (or list); ``states``: per
+    plane a dict ``{"table": arr, <slot>: arr, ...}`` of its live device
+    arrays.  Rows are stored UNPADDED (world-size independent), so the
+    restore side re-pads for whatever shard count the new mesh has — the
+    elastic 4->3 resize needs nothing else."""
+    from .. import telemetry
+    embs = embs if isinstance(embs, (list, tuple)) else [embs]
+    states = states if isinstance(states, (list, tuple)) else [states]
+    arrays = {}
+    meta = dict(extra_meta or {})
+    meta["kind"] = "sharded_embedding"
+    meta["names"] = [e.name for e in embs]
+    with telemetry.span("checkpoint/snapshot", cat="checkpoint",
+                        metric="checkpoint.snapshot_seconds",
+                        step=int(step)):
+        for e, st in zip(embs, states):
+            host = e.state_dict(st["table"],
+                                **{k: v for k, v in st.items()
+                                   if k != "table"})
+            for k, v in host.items():
+                arrays["emb/%s/%s" % (e.name, k)] = v
+    return manager.save(step, arrays, meta)
+
+
+def restore_embedding(manager, embs, step=None, old_states=None):
+    """Restore embedding planes onto (possibly re-formed) meshes: each
+    array is re-padded for the plane's CURRENT shard count and
+    ``device_put`` row-sharded (``ShardedEmbedding.load_array``) — the
+    same resharding-restore contract as :func:`restore_trainer`.
+    Returns ``(states, step, meta)`` or None; ``old_states`` are
+    released before materializing (the double-residency rule)."""
+    from ..telemetry import memory as _memory
+    embs = embs if isinstance(embs, (list, tuple)) else [embs]
+    ck = manager.restore(step) if step is not None else manager.latest()
+    if ck is None:
+        return None
+    meta = ck.meta
+    if meta.get("kind") != "sharded_embedding":
+        raise MXNetError("checkpoint %s holds %r state, not a "
+                         "sharded_embedding" % (ck.path, meta.get("kind")))
+    if old_states is not None:
+        _memory.release(old_states)
+    states = []
+    for e in embs:
+        prefix = "emb/%s/" % e.name
+        st = {}
+        for key, host in ck.arrays.items():
+            if key.startswith(prefix):
+                st[key[len(prefix):]] = e.load_array(host)
+        if "table" not in st:
+            raise MXNetError("checkpoint %s has no table for embedding "
+                             "%r" % (ck.path, e.name))
+        states.append(st)
+    return states, ck.step, meta

@@ -1,0 +1,22 @@
+"""In-jit sparse plane: mesh-sharded embeddings, touched-rows compute.
+
+The compiled-program twin of the host row_sparse boundary
+(:mod:`mxnet_tpu.ndarray.sparse`): tables row-sharded over a mesh axis,
+lookups compiled as owner-shard routing (all-to-all bytes proportional
+to touched rows, never table size), gradients deduped in-jit and applied
+by sharded lazy SGD/Adam that touch only the routed rows at shard
+shapes.  Pallas gather/scatter kernels serve the shard-local halves
+(``MXNET_TPU_PALLAS_EMBED`` / autotune-decided).  See docs/sparse.md.
+"""
+from .embedding import (ShardedEmbedding, live_tables, lookup_wire_bytes,
+                        step_alltoall_model_bytes)
+from .kernels import (embed_backend, embedding_gather, embedding_scatter,
+                      tune_embedding)
+from .step import (init_mlp, lower_step, make_recommender_step,
+                   recommender_state)
+
+__all__ = ["ShardedEmbedding", "live_tables", "lookup_wire_bytes",
+           "step_alltoall_model_bytes", "embed_backend",
+           "embedding_gather", "embedding_scatter", "tune_embedding",
+           "init_mlp", "lower_step", "make_recommender_step",
+           "recommender_state"]

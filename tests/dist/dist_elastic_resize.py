@@ -54,6 +54,11 @@ from mxnet_tpu.parallel.trainer import ShardedTrainer  # noqa: E402
 from mxnet_tpu.resilience import (CheckpointManager, chaos, elastic,  # noqa: E402
                                   restore_trainer, watchdog)
 
+import jax.numpy as jnp  # noqa: E402
+
+from mxnet_tpu.resilience import restore_embedding, save_embedding  # noqa: E402
+from mxnet_tpu.sparse import ShardedEmbedding  # noqa: E402
+
 CKPT_DIR = os.environ["ELASTIC_CKPT_DIR"]
 # "kill": hard preemption (no goodbye) -> shrink -> grow back to full.
 # "notice": graceful preempt_notice -> checkpoint-then-leave -> finish at
@@ -68,6 +73,33 @@ KILL_AT = 8             # rank 1 hard-preempted at its 8th update (gen 0)
 NOTICE_AT = 8           # rank 1 gets the graceful notice after update 8
 GROW_AFTER = 6          # updates at reduced size before growing back
 SEED = 11
+# sharded-embedding side plane: the table rides the SAME dp mesh (rows
+# 1/world per rank), takes one routed touched-rows lazy-SGD update per
+# trainer update, checkpoints unpadded, and RESHARDS across every resize
+# (4 -> 3 -> 4).  48 rows divide both world sizes; grads are exact
+# multiples of 2^-10 and lr/momentum are powers of two, so routed sums
+# and the fused update math are association- and FMA-free — the final-
+# parity check against an uninterrupted single-device replay is
+# BIT-exact across any shard-count history.
+EMB_V, EMB_D, EMB_B = 48, 8, 24
+
+
+def emb_batch(u):
+    ers = np.random.RandomState(1000 + u)
+    ids = ers.randint(0, EMB_V, EMB_B).astype(np.int32)
+    rows = (ers.randint(-8, 8, (EMB_B, EMB_D)) / 1024.0).astype(np.float32)
+    return ids, rows
+
+
+def emb_apply(emb, state, u):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    ids, rows = emb_batch(u)
+    bat = NamedSharding(emb.mesh, P(emb.axis))
+    t, m = emb.apply_sgd(state["table"], state["mom"],
+                         jax.device_put(ids, bat),
+                         jax.device_put(rows, bat),
+                         lr=0.125, momentum=0.5)
+    return {"table": t, "mom": m}
 
 
 def make_data():
@@ -149,6 +181,17 @@ def main():
     it, accum = make_iter(X, y, world, rank)
     trainer.set_grad_accum(accum)
     mgr = CheckpointManager(CKPT_DIR, keep=5)
+    # embedding side plane over THIS generation's mesh, created — and
+    # its routed-update + host-gather programs COMPILED, via a discarded
+    # priming call — BEFORE the elastic machinery arms: a ~20 s first
+    # compile mid-loop would stall heartbeats past dead_sec and spam
+    # false-alarm resize rounds right when the real kill needs a clean
+    # one
+    emb = ShardedEmbedding(EMB_V, EMB_D, spec, name="drill")
+    emb_mgr = CheckpointManager(CKPT_DIR, prefix="emb", keep=5)
+    emb_state = {"table": emb.init_state(seed=42), "mom": emb.zeros_slot()}
+    emb_apply(emb, emb_state, 0)               # discarded: compile only
+    emb.state_dict(emb_state["table"], mom=emb_state["mom"])
     # watchdog backstop with the RESIZE action: if the dead peer wedges
     # the collective instead of erroring it, the deadline still turns
     # the hang into a coordinated resize (post-mortem included)
@@ -172,6 +215,29 @@ def main():
                                old_state=(params, mom, aux))
     if restored is not None:
         params, mom, aux, updates, _meta = restored
+
+    # embedding restore: a resized generation reshards the unpadded
+    # snapshot onto the new world and replays any updates the trainer
+    # is ahead by (ids/grads are deterministic functions of the update
+    # index, so the replay is exact)
+    emb_updates = 0
+    restored_emb = restore_embedding(emb_mgr, emb, old_states=[emb_state])
+    if restored_emb is not None:
+        (emb_state,), emb_updates, _emeta = restored_emb
+    if gen > 0:
+        assert restored_emb is not None, \
+            "a resized generation must reshard the embedding table"
+        shard_b = emb_state["table"].addressable_shards[0].data.nbytes
+        assert shard_b * world == emb_state["table"].nbytes, \
+            (shard_b, emb_state["table"].nbytes, world)
+        print("dist_elastic_resize rank %d EMB resharded gen=%d world=%d"
+              " rows/rank=%d emb_updates=%d" % (
+                  rank, gen, world, EMB_V // world, emb_updates),
+              flush=True)
+    assert emb_updates <= updates, (emb_updates, updates)
+    while emb_updates < updates:           # replay the save gap
+        emb_updates += 1
+        emb_state = emb_apply(emb, emb_state, emb_updates)
     if gen > 0:
         assert restored is not None, \
             "a resized generation must resume from a checkpoint"
@@ -234,6 +300,15 @@ def main():
                       % (rank, updates + 1), flush=True)
                 os._exit(77)
         updates += 1
+        # one routed touched-rows update on the sharded table per
+        # trainer update; every rank gathers the host snapshot (the
+        # state_dict all-gather is a collective), rank 0 persists it
+        emb_state = emb_apply(emb, emb_state, updates)
+        emb_updates = updates
+        emb_host = emb.state_dict(emb_state["table"],
+                                  mom=emb_state["mom"])
+        if rank == 0:
+            save_embedding(emb_mgr, emb, emb_host, updates)
         coord.note_step(updates, (params, mom, aux))
         if gen > 0 and updates == resumed_at + 1:
             # ROADMAP item 5 acceptance, checked at the exact moment it
@@ -278,6 +353,10 @@ def main():
                        report_dir=CKPT_DIR, poll=0.2)
     watchdog.heartbeat(updates, force=True)   # freshen digests for the view
 
+    # embedding final state to host on EVERY rank (collective gather)
+    # before the rank-0-only verification below
+    emb_final = emb.state_dict(emb_state["table"], mom=emb_state["mom"])
+
     if rank == 0:
         view = telemetry.fleet_view()
         assert view["generation"] == gen and view["world_size"] == world, \
@@ -307,6 +386,27 @@ def main():
         assert el_ce < 0.2, "elastic run failed to converge: CE=%.4f" % el_ce
         print("dist_elastic_resize LOSS ref=%.4f elastic=%.4f"
               % (ref_ce, el_ce), flush=True)
+
+        # embedding parity: the table that lived through 4 -> 3 -> 4
+        # resharding must BIT-match an uninterrupted single-device
+        # replay of the same update schedule (exact-representable
+        # grads make the routed sums association-free)
+        ref_spec = MeshSpec(make_mesh((1,), ("dp",),
+                                      devices=jax.local_devices()[:1]))
+        ref_emb = ShardedEmbedding(EMB_V, EMB_D, ref_spec, name="drill")
+        ref_state = {"table": ref_emb.init_state(seed=42),
+                     "mom": ref_emb.zeros_slot()}
+        for u in range(1, TOTAL_UPDATES + 1):
+            ref_state = emb_apply(ref_emb, ref_state, u)
+        ref_host = ref_emb.state_dict(ref_state["table"],
+                                      mom=ref_state["mom"])
+        assert np.array_equal(emb_final["table"], ref_host["table"]), \
+            "embedding table diverged from the uninterrupted replay"
+        assert np.array_equal(emb_final["mom"], ref_host["mom"]), \
+            "embedding momentum diverged from the uninterrupted replay"
+        print("dist_elastic_resize EMB table bit-exact vs uninterrupted"
+              " replay after %d resharded updates" % TOTAL_UPDATES,
+              flush=True)
 
     parallel.barrier("elastic_done")
     print("dist_elastic_resize rank %d/%d OK gen=%d updates=%d"

@@ -258,3 +258,119 @@ def test_sparse_embedding_trains_symbolically():
     score = mod.score(it, mx.metric.Accuracy())
     acc = dict(score)["accuracy"]
     assert acc > 0.8, acc
+
+
+# ---------------------------------------------------------------------------
+# exactness under duplicates / unsorted ids / zero-nnz shards, against
+# dense reference math (the host plane is the semantic reference the
+# in-jit sharded embedding plane is proven equal to — its own edge cases
+# must be airtight)
+# ---------------------------------------------------------------------------
+
+def test_merge_row_sparse_duplicates_within_one_input():
+    """Duplicate indices INSIDE one array: merge segment-sums them too,
+    matching the dense sum."""
+    data = np.array([[1., 2.], [10., 20.], [100., 200.]], np.float32)
+    idx = np.array([4, 4, 1], np.int64)
+    a = sp.RowSparseNDArray(mx.nd.array(data)._handle,
+                            mx.nd.array(idx).astype("int64")._handle,
+                            (6, 2))
+    m = sp.merge_row_sparse([a])
+    dense = np.zeros((6, 2), np.float32)
+    np.add.at(dense, idx, data)
+    np.testing.assert_array_equal(np.asarray(m._indices), [1, 4])
+    np.testing.assert_array_equal(np.asarray(m._data),
+                                  dense[[1, 4]])
+    np.testing.assert_array_equal(m.asnumpy(), dense)
+
+
+def test_merge_row_sparse_unsorted_and_cross_array_duplicates():
+    rs = np.random.RandomState(0)
+    shape = (20, 3)
+    dense_sum = np.zeros(shape, np.float32)
+    arrays = []
+    for seed in range(3):
+        k = rs.randint(1, 8)
+        idx = rs.randint(0, shape[0], k).astype(np.int64)  # dupes likely
+        data = rs.randn(k, shape[1]).astype(np.float32)
+        np.add.at(dense_sum, idx, data)
+        # constructor receives UNSORTED indices (sorts internally)
+        arrays.append(sp.RowSparseNDArray(
+            mx.nd.array(data)._handle,
+            mx.nd.array(idx).astype("int64")._handle, shape))
+    m = sp.merge_row_sparse(arrays)
+    # indices sorted unique, data is nnz-sized — and the merge itself
+    # never densified (asnumpy below is what builds the dense view)
+    got_idx = np.asarray(m._indices)
+    assert np.all(np.diff(got_idx) > 0)
+    assert m._data.shape[0] == len(got_idx)
+    assert m._dense_cache is None
+    np.testing.assert_allclose(m.asnumpy(), dense_sum, rtol=1e-6)
+
+
+def test_merge_row_sparse_zero_nnz_shards_mixed():
+    """Zero-nnz inputs mixed with real ones (a worker that touched no
+    rows this step) must neither crash nor perturb the sum; the
+    all-empty merge is the empty gradient."""
+    shape = (8, 2)
+    empty = sp.zeros_sparse("row_sparse", shape)
+    a = sp.RowSparseNDArray(mx.nd.ones((2, 2))._handle,
+                            mx.nd.array([1, 6]).astype("int64")._handle,
+                            shape)
+    m = sp.merge_row_sparse([empty, a, empty])
+    dense = np.zeros(shape, np.float32)
+    dense[[1, 6]] = 1.0
+    np.testing.assert_array_equal(m.asnumpy(), dense)
+    m0 = sp.merge_row_sparse([empty, empty])
+    assert m0._data.shape[0] == 0
+    np.testing.assert_array_equal(m0.asnumpy(), np.zeros(shape))
+
+
+def test_row_sparse_pull_repeated_and_unsorted_ids():
+    """row_sparse_pull with repeated + unsorted row_ids: the pulled
+    row_sparse holds each requested row ONCE (sorted unique), valued
+    exactly as the dense store."""
+    kv = mx.kv.create("local")
+    rs = np.random.RandomState(2)
+    w = rs.rand(12, 3).astype(np.float32)
+    kv.init("w", mx.nd.array(w))
+    req = np.array([7, 2, 7, 2, 11, 0, 0], np.int64)
+    out = sp.zeros_sparse("row_sparse", (12, 3))
+    kv.row_sparse_pull("w", out=out, row_ids=mx.nd.array(req))
+    uniq = np.unique(req)
+    np.testing.assert_array_equal(np.asarray(out._indices), uniq)
+    np.testing.assert_allclose(np.asarray(out._data), w[uniq], rtol=1e-6)
+    # dense out honors the same contract: requested rows only
+    dense_out = mx.nd.zeros((12, 3))
+    kv.row_sparse_pull("w", out=dense_out, row_ids=mx.nd.array(req))
+    exp = np.zeros_like(w)
+    exp[uniq] = w[uniq]
+    np.testing.assert_allclose(dense_out.asnumpy(), exp, rtol=1e-6)
+
+
+def test_row_sparse_pull_from_zero_nnz_store():
+    """Pulling from a store holding a zero-nnz row_sparse value returns
+    zero rows for every requested id (the gather_rows empty-store
+    path)."""
+    kv = mx.kv.create("local")
+    kv.init("z", sp.zeros_sparse("row_sparse", (10, 4)))
+    out = sp.zeros_sparse("row_sparse", (10, 4))
+    kv.row_sparse_pull("z", out=out, row_ids=mx.nd.array([3, 3, 8]))
+    np.testing.assert_array_equal(np.asarray(out._indices), [3, 8])
+    np.testing.assert_array_equal(np.asarray(out._data),
+                                  np.zeros((2, 4), np.float32))
+
+
+def test_retain_unsorted_request_and_empties():
+    arr = sp.RowSparseNDArray(
+        mx.nd.array(np.arange(8).reshape(4, 2)).astype("float32")._handle,
+        mx.nd.array([0, 3, 5, 7]).astype("int64")._handle, (9, 2))
+    # unsorted + duplicated + absent ids in the request
+    kept = arr.retain([7, 1, 3, 7, 3])
+    np.testing.assert_array_equal(np.asarray(kept._indices), [3, 7])
+    np.testing.assert_array_equal(np.asarray(kept._data),
+                                  [[2., 3.], [6., 7.]])
+    # empty request -> empty result, dense shape preserved
+    kept0 = arr.retain(np.array([], np.int64))
+    assert kept0._data.shape[0] == 0
+    np.testing.assert_array_equal(kept0.asnumpy(), np.zeros((9, 2)))

@@ -118,6 +118,33 @@ def _graphcheck_builtin(report):
             run, stacked, x, mesh=pp_mesh,
             target="parallel.pipeline_apply"))
     check_pipeline()
+
+    # sharded-embedding plane: routed lookup + lazy update must be GC306
+    # clean (no table-sized dense gradient collective) — the compiled
+    # HLO carries the collective payloads the rule reads
+    try:
+        from mxnet_tpu.sparse import ShardedEmbedding
+        emb = ShardedEmbedding(16 * n, 8, MeshSpec(mesh), axis="dp",
+                               name="tpulint")
+        table = emb.init_state(seed=0)
+        mom = emb.zeros_slot()
+        ids = jax.device_put(
+            jnp.arange(4 * n, dtype=jnp.int32) % (16 * n),
+            jax.sharding.NamedSharding(mesh, P("dp")))
+
+        def emb_step(t, m, i):
+            rows = emb.lookup(t, i)
+            return emb.apply_sgd(t, m, i, 2.0 * rows, lr=0.1,
+                                 momentum=0.9)
+        with mesh:
+            txt = jax.jit(emb_step).lower(table, mom,
+                                          ids).compile().as_text()
+        report.extend(graphcheck.check_embedding_grad(
+            txt, table_bytes=[emb.table_bytes],
+            target="sparse.ShardedEmbedding"))
+    except Exception as e:
+        print("tpulint: sparse embedding check skipped: %r" % e,
+              file=sys.stderr)
     report.extend(graphcheck.check_registry())
 
 
