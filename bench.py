@@ -281,10 +281,115 @@ def _recommender_main(as_dict=False):
     print(json.dumps(result))
 
 
+def _decode_main(as_dict=False):
+    """BENCH_MODEL=decode: interactive decode steady-state — tokens/sec/
+    chip of the paged-KV continuous-batching step (mxnet_tpu/serving/
+    decode) with every slot occupied mid-sequence, the regime a loaded
+    interactive fleet runs in.  Geometry knobs BENCH_DECODE_{LAYERS,
+    HIDDEN,HEADS,VOCAB,SEQ,SLOTS,PAGE,QUANT}; MXNET_TPU_PALLAS_DECODE
+    picks the attention backend.  The continuous-vs-static batching
+    comparison lives in tools/servebench.py --decode."""
+    layers = int(os.environ.get("BENCH_DECODE_LAYERS", "4"))
+    hidden = int(os.environ.get("BENCH_DECODE_HIDDEN", "256"))
+    heads = int(os.environ.get("BENCH_DECODE_HEADS", "8"))
+    vocab = int(os.environ.get("BENCH_DECODE_VOCAB", "2048"))
+    seq = int(os.environ.get("BENCH_DECODE_SEQ", "256"))
+    slots = int(os.environ.get("BENCH_DECODE_SLOTS", "8"))
+    page = int(os.environ.get("BENCH_DECODE_PAGE", "16"))
+    quant = os.environ.get("BENCH_DECODE_QUANT") or None
+    warmup = int(os.environ.get("BENCH_WARMUP", "5"))
+    iters = int(os.environ.get("BENCH_ITERS", "50"))
+
+    import jax
+    import mxnet_tpu  # noqa: F401
+    from mxnet_tpu.analysis.costmodel import decode_step_model
+    from mxnet_tpu.serving.decode import (DecodeConfig, DecodeProgram,
+                                          init_decode_params)
+
+    devices = jax.devices()
+    n_dev = len([d for d in devices if d.platform != "cpu"]) or 1
+    platform = devices[0].platform
+    cfg = DecodeConfig(vocab, layers, hidden, heads, seq, page_size=page,
+                       max_seqs=slots, quantize=quant)
+    prog = DecodeProgram(init_decode_params(cfg, seed=0), cfg,
+                         name="bench")
+    prog.ensure_compiled()
+    kv = prog.fresh_cache()
+    pp = cfg.pages_per_seq
+    table = np.zeros((slots, pp), np.int32)
+    for s in range(slots):
+        table[s] = 1 + s * pp + np.arange(pp)
+    rs = np.random.RandomState(0)
+    # steady state: every slot mid-sequence (half the context cached)
+    base = seq // 2
+    toks = rs.randint(0, vocab, slots).astype(np.int32)
+    t_host = 0.0
+
+    def one(kv, pos):
+        positions = np.full(slots, pos, np.int32)
+        nxt, _lg, kv = prog.step(
+            kv, toks, positions, positions + 1,
+            table[np.arange(slots), pos // page],
+            np.full(slots, pos % page, np.int32), table)
+        return nxt, kv
+    pos = base
+    for _ in range(warmup):
+        nxt, kv = one(kv, pos)
+        pos += 1
+    jax.block_until_ready(nxt)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        nxt, kv = one(kv, pos)
+        pos += 1
+    jax.block_until_ready(nxt)
+    dt = time.perf_counter() - t0
+    tok_s = slots * iters / dt / n_dev
+    model = decode_step_model(
+        layers, hidden, vocab, slots, slots * base,
+        quant_bits={"int8": 8, "int4": 4}.get(quant, 32))
+    result = {
+        "metric": "decode_tokens_per_sec_per_chip",
+        "value": round(tok_s, 2),
+        "unit": "tokens/sec/chip (L%d H%d heads%d V%d T%d S%d page%d%s, "
+                "%d %s dev%s)" % (layers, hidden, heads, vocab, seq,
+                                  slots, page,
+                                  " %s" % quant if quant else "",
+                                  n_dev, platform,
+                                  "s" if n_dev > 1 else ""),
+        "vs_baseline": None,
+        "decode": {
+            "step_ms": round(dt / iters * 1e3, 4),
+            "cached_tokens": slots * base,
+            "quantize": quant,
+            "compiles": prog.trace_count,
+            "model_hbm_bytes_per_step": int(model["hbm_bytes"]),
+            "model_weight_bytes": int(model["weight_bytes"]),
+        },
+    }
+    # the toy decode program's jit time deliberately does NOT ride the
+    # phases block: phases.compile_seconds is the GATED trainer-compile
+    # series, and a different program class would poison its trajectory
+    try:
+        from mxnet_tpu.telemetry import tracing as _tracing
+        cs = _tracing.compile_summary()
+        if cs["count"]:
+            result["decode"]["compile_seconds"] = cs["total_seconds"]
+    except Exception:
+        pass
+    if as_dict:
+        return result
+    print(json.dumps(result))
+
+
 def main():
     model = os.environ.get("BENCH_MODEL", "resnet50")
     if model == "transformer":
         result = _transformer_main(as_dict=True)
+        _maybe_ledger(result)
+        print(json.dumps(result))
+        return
+    if model == "decode":
+        result = _decode_main(as_dict=True)
         _maybe_ledger(result)
         print(json.dumps(result))
         return

@@ -66,6 +66,14 @@ GC402     registry-dynamic-gap     warning   registered op schema declares a
                                              dynamic_params mechanism
 GC403     unhashable-attr          error     op attrs that cannot be hashed
                                              into a jit cache key
+GC307     decode-retrace           warning   a decode-shaped program (single-
+                                             query attention + in-place cache
+                                             write) whose trace CHANGES across
+                                             step / sequence-length / batch-
+                                             membership changes — the
+                                             recompile-per-token trap: every
+                                             generated token pays a fresh XLA
+                                             compile
 GC501     hbm-over-capacity        error     predicted peak HBM (costmodel
                                              state/batch accounting +
                                              ``memory_analysis`` temp bytes)
@@ -96,7 +104,8 @@ except ImportError:                     # older: the classic namespace
 __all__ = ["CollectiveEvent", "collect_collectives", "check_jaxpr",
            "check_fn", "check_symbol", "check_registry",
            "check_replication", "check_capacity", "check_overlap",
-           "check_embedding_grad", "check_trainer", "check_executor",
+           "check_embedding_grad", "check_decode_retrace",
+           "is_decode_shaped", "check_trainer", "check_executor",
            "PER_STEP_ATTRS", "COLLECTIVE_PRIMS"]
 
 # every collective primitive we track (axis_index is deliberately absent:
@@ -768,6 +777,106 @@ def check_embedding_grad(hlo_text: str, table_bytes=None, target: str = "",
                      "MXNET_TPU_GC306_MIN_MB",
             extra={"payload_bytes": int(payload), "instruction": ins.name,
                    "table_bytes": table_bytes})
+    return rep
+
+
+_CACHE_WRITE_PRIMS = frozenset({"scatter", "dynamic_update_slice",
+                                "dynamic-update-slice", "concatenate"})
+
+
+def is_decode_shaped(jaxpr_like) -> bool:
+    """Heuristic decode signature: the program writes in place into a
+    cache-like buffer (scatter / dynamic_update_slice) AND contracts a
+    query against an operand at least an order of magnitude larger (the
+    single-query-vs-cached-K/V shape of decode attention)."""
+    has_write = False
+    has_sq_attn = False
+    for _path, jaxpr in _walk_jaxprs(jaxpr_like):
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            if name in _CACHE_WRITE_PRIMS:
+                has_write = True
+            elif name == "dot_general" and len(eqn.invars) >= 2:
+                sizes = []
+                for v in eqn.invars[:2]:
+                    aval = getattr(v, "aval", None)
+                    n = 1
+                    for d in getattr(aval, "shape", ()) or ():
+                        n *= int(d)
+                    sizes.append(n)
+                if min(sizes) and max(sizes) >= 16 * min(sizes):
+                    has_sq_attn = True
+    return has_write and has_sq_attn
+
+
+def check_decode_retrace(step_fn, args_a, args_b,
+                         target: str = "") -> Report:
+    """GC307: the recompile-per-token trap.
+
+    ``args_a`` / ``args_b`` are two example argument tuples for the SAME
+    decode step at different generation states (another token position,
+    another sequence length, another batch membership).  A correctly
+    built step (fixed cache shapes, position/length as traced DATA)
+    traces to the identical jaxpr for both; a step that bakes either
+    into the trace — python-int positions as static args, a cache that
+    grows by concatenation, per-length padding — produces different
+    avals or different jaxprs, which at serving time means one fresh XLA
+    compile per generated token.  Only decode-shaped programs
+    (:func:`is_decode_shaped`) are judged; anything else passes
+    silently so the rule can sit on generic entry points."""
+    rep = Report("graphcheck", target or "decode")
+    try:
+        closed_a = jax.make_jaxpr(step_fn)(*args_a)
+    except TypeError as e:
+        # the step coerces a traced value to a host int (int(pos),
+        # shape arithmetic from the position, ...) — under jit that
+        # value is a STATIC cache key and every new position recompiles
+        rep.add(
+            "GC307", "warning",
+            "decode step cannot trace with its generation state held "
+            "abstract (%s): a step/position/length is consumed as a "
+            "host value, so under jit it becomes a static cache key "
+            "and every generated token compiles a fresh program"
+            % (str(e).splitlines()[0][:160],),
+            location=target,
+            fix_hint="pass step/position/length as traced int32 arrays "
+                     "and index with lax.dynamic_update_slice / "
+                     "gather, never int(pos) or pos-derived shapes")
+        return rep
+    avals_a = [str(v.aval) for v in closed_a.jaxpr.invars]
+    if not is_decode_shaped(closed_a):
+        return rep
+    closed_b = jax.make_jaxpr(step_fn)(*args_b)
+    avals_b = [str(v.aval) for v in closed_b.jaxpr.invars]
+    if avals_a != avals_b:
+        changed = [i for i, (a, b) in enumerate(zip(avals_a, avals_b))
+                   if a != b][:4]
+        rep.add(
+            "GC307", "warning",
+            "decode step input SHAPES change with generation state "
+            "(args %s: %s -> %s): every step/sequence-length change "
+            "recompiles the program — one fresh XLA compile per "
+            "generated token"
+            % (changed,
+               [avals_a[i] for i in changed],
+               [avals_b[i] for i in changed]),
+            location=target,
+            fix_hint="hold K/V in a fixed page pool indexed by a page "
+                     "table (serving/decode.PagedKVCache layout) and "
+                     "mask by seq_len instead of slicing to it",
+            extra={"changed_args": changed})
+        return rep
+    if str(closed_a) != str(closed_b):
+        rep.add(
+            "GC307", "warning",
+            "decode step traces DIFFERENTLY at two generation states "
+            "with identical input shapes: a step/position/length is "
+            "baked into the trace as a constant, so every token change "
+            "misses the jit cache and recompiles",
+            location=target,
+            fix_hint="pass the changing value as a traced int32 array "
+                     "argument (it must appear in the jaxpr as an input, "
+                     "not a literal)")
     return rep
 
 

@@ -22,6 +22,13 @@ the header, which is possible because the compiled signature is always
 this through MXPredCreateFromServed (capi.py pred_create_served), so a C
 consumer can run a trained model from the artifact alone.
 
+The interactive-decode deploy unit is the sibling
+``serving/decode.DecodeProgram`` artifact: same container format and
+device-fingerprint convention, but weights-only (optionally int8/int4
+quantized) — its donated-KV step program cannot ride the serialized-
+executable path (see mxnet_tpu/compile/cache.donation_safe) and
+re-jits once at load instead.
+
 Caveat (inherent to XLA AOT): the artifact is compiled for a specific
 device kind + topology.  ``export_compiled`` records ``platform``,
 ``device_kind`` and ``device_count`` in the container header and

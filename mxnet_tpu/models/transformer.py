@@ -65,3 +65,30 @@ def get_symbol(vocab_size=1000, seq_len=32, num_layers=2, hidden=64,
     logits = sym.Reshape(logits, shape=(-1, vocab_size))
     label_f = sym.Reshape(label, shape=(-1,))
     return sym.SoftmaxOutput(logits, label_f, name="softmax")
+
+
+def get_decode_step(arg_params, vocab_size=1000, seq_len=32, num_layers=2,
+                    hidden=64, heads=4, *, page_size=None, max_seqs=None,
+                    quantize=None, mesh=None, eos_id=None, name="decode"):
+    """Incremental-decode entry point sharing weights with the training
+    graph — the serving-side twin of :func:`get_symbol`.
+
+    ``arg_params`` is a trained module's parameter dict under the
+    training names (``l0_q_weight`` etc., exactly what
+    ``Module.get_params()`` / ``ShardedTrainer`` hand back); the
+    returned :class:`~mxnet_tpu.serving.decode.DecodeProgram` runs one
+    token per occupied slot per call against a paged KV cache, compiled
+    ONCE — instead of forcing callers to re-trace the full-sequence
+    forward per generated token.  ``seq_len`` bounds prompt+generation;
+    ``quantize`` (``"int8"``/``"int4"``) selects weight-only quantized
+    matmuls; ``mesh`` (e.g. ``{"tp": 2}``) exports tensor-parallel.
+    Feed it to :class:`~mxnet_tpu.serving.decode.DecodeEngine` for
+    continuous token-level batching."""
+    from ..serving.decode import DecodeConfig, DecodeProgram
+    config = DecodeConfig(vocab_size, num_layers, hidden, heads, seq_len,
+                          page_size=page_size, max_seqs=max_seqs,
+                          quantize=quantize, eos_id=eos_id)
+    params = {k: (v.asnumpy() if hasattr(v, "asnumpy") else v)
+              for k, v in dict(arg_params).items()
+              if k not in ("data", "softmax_label")}
+    return DecodeProgram(params, config, mesh=mesh, name=name)

@@ -39,6 +39,7 @@ Knobs (docs/observability.md):
 """
 from __future__ import annotations
 
+import functools
 import json
 import os
 import threading
@@ -47,7 +48,7 @@ from typing import Callable, Dict, Iterable, Optional, Sequence, Tuple
 
 __all__ = ["flash_blocks", "autotune", "tune_flash", "lookup", "record",
            "cache_path", "invalidate", "device_kind",
-           "DEFAULT_FLASH_BLOCKS"]
+           "DEFAULT_FLASH_BLOCKS", "decode_backend", "tune_decode"]
 
 # static fallbacks when the cache has no entry: the hand-picked r4
 # forward blocks, and symmetric 128s for the backward (two operand tiles
@@ -319,3 +320,74 @@ def tune_flash(q, k, v, causal: bool = True, kinds=("fwd", "bwd"),
             _flash_candidates("bwd", Tq, Tk, D),
             timed(bwd), default=DEFAULT_FLASH_BLOCKS["bwd"], force=force)
     return results
+
+
+# ---------------------------------------------------------------------------
+# paged decode attention backend
+# ---------------------------------------------------------------------------
+#
+# The decode kernel's block size IS the KV page (one physical page per
+# sequential grid step), so the tunable is which FORMULATION wins for a
+# given decode geometry: the Pallas paged walk (HBM traffic ∝ cached
+# tokens; TPU) or the XLA gather+softmax (what GSPMD can shard; wins on
+# CPU and for tiny pools where gather overhead is noise).
+
+def _decode_sig(S: int, H: int, D: int, page: int, dtype) -> Tuple:
+    return (int(S), int(H), int(D), int(page), str(dtype))
+
+
+def decode_backend(S: int, H: int, D: int, page: int,
+                   dtype: str = "") -> str:
+    """``"pallas"`` or ``"xla"`` for this decode-attention geometry:
+    the cache's measured winner, else pallas on TPU / XLA elsewhere.
+    Read-only — called from the kernel wrapper at trace time."""
+    hit = lookup("decode_attn", _decode_sig(S, H, D, page, dtype))
+    if hit is not None:
+        return str(hit["config"])
+    try:
+        import jax
+        platform = jax.devices()[0].platform
+    except Exception:
+        platform = "cpu"
+    return "pallas" if platform == "tpu" else "xla"
+
+
+def tune_decode(q, k_pages, v_pages, page_table, seq_lens,
+                iters: int = 20, force: bool = False) -> str:
+    """Measure both decode-attention formulations on these exact
+    operands and persist the winner (keyed by slots × heads × head_dim ×
+    page × dtype).  Candidate compilation goes through the persistent
+    executable cache (``lower=`` write-through), so a re-tune on a
+    relaunched host compiles nothing it already built.  Returns the
+    winning backend name."""
+    import jax
+    from . import pallas_kernels as pk
+    S, H, D = q.shape
+    page = k_pages.shape[2]
+
+    def build(backend):
+        return jax.jit(functools.partial(
+            pk.decode_attention, use_pallas=(backend == "pallas")))
+
+    def lower(backend):
+        return build(backend).lower(q, k_pages, v_pages, page_table,
+                                    seq_lens)
+
+    def measure(backend, compiled=None):
+        from .. import telemetry as _tel
+        fn = compiled if compiled is not None else build(backend)
+        out = fn(q, k_pages, v_pages, page_table, seq_lens)
+        jax.block_until_ready(out)
+        with _tel.span("autotune/measure", cat="autotune",
+                       timed=True) as sp:
+            for _ in range(iters):
+                out = fn(q, k_pages, v_pages, page_table, seq_lens)
+            jax.block_until_ready(out)
+        return sp.duration / iters
+
+    winner = autotune(
+        "decode_attn", _decode_sig(S, H, D, page, q.dtype),
+        ["xla", "pallas"], measure,
+        default=decode_backend(S, H, D, page, str(q.dtype)),
+        force=force, lower=lower)
+    return str(winner)

@@ -34,7 +34,8 @@ if _enable_x64 is None:   # pragma: no cover - version-dependent
     from jax.experimental import enable_x64 as _enable_x64
 
 __all__ = ["two_bit_compress", "fused_attention", "fused_attention_fwd",
-           "fused_attention_bwd", "pallas_available"]
+           "fused_attention_bwd", "pallas_available", "decode_attention",
+           "quantize_weight", "quant_matmul"]
 
 
 def _interpret(*arrays) -> bool:
@@ -494,3 +495,295 @@ def fused_attention_bwd(q, k, v, out, lse, do, causal=False, scale=None,
         return x.reshape(B, H, T, D).transpose(0, 2, 1, 3)
 
     return unflat(dq, Tq), unflat(dk, Tk), unflat(dv, Tk)
+
+
+# ---------------------------------------------------------------------------
+# paged single-query decode attention
+# ---------------------------------------------------------------------------
+#
+# The serving decode path (mxnet_tpu/serving/decode.py) holds K/V in a
+# fixed PAGE POOL of shape (P, H, page, D): physical pages handed out by
+# a host-side allocator, one logical sequence = a per-slot row of page
+# ids.  Decode attention is then ONE query token per slot against that
+# pool.  The Pallas kernel walks a sequence's pages directly via
+# scalar-prefetched page-table indices (the PR-14 PrefetchScalarGridSpec
+# technique): grid (slot, logical_page), each step DMAs exactly one
+# (H, page, D) physical page — the pool never materializes per-sequence,
+# so HBM traffic is O(tokens_cached · D), not O(slots · max_seq · D).
+# The online-softmax state (running max / sum / accumulator) is the same
+# logsumexp machinery as the flash kernels above, carried across the
+# sequential page axis in VMEM scratch.
+
+def _decode_attn_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                        acc_ref, m_ref, l_ref, *, page, n_pages, scale):
+    s = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, jnp.float32(_NEG_BIG))
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # a page with no valid token (beyond this slot's cached length) is
+    # skipped entirely — the DMA still happened (the index map runs for
+    # every grid cell; unused table entries point at the trash page) but
+    # no FLOPs or state updates are spent on it
+    live = j * page < len_ref[s]
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)            # (H, D)
+        k = k_ref[0].astype(jnp.float32)            # (H, page, D)
+        v = v_ref[0].astype(jnp.float32)
+        s_hp = jax.lax.dot_general(
+            k, q, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale      # (H, page)
+        pos = j * page + jax.lax.broadcasted_iota(jnp.int32, s_hp.shape, 1)
+        s_hp = jnp.where(pos < len_ref[s], s_hp, jnp.float32(_NEG_BIG))
+        m_prev = m_ref[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s_hp, axis=-1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s_hp - m_new)                   # (H, page)
+        l_ref[:] = jnp.broadcast_to(
+            l_ref[:, 0:1] * corr + jnp.sum(p, axis=-1, keepdims=True),
+            l_ref.shape)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)     # (H, D)
+        acc_ref[:] = acc_ref[:] * corr + pv
+
+    @pl.when(j == n_pages - 1)
+    def _finish():
+        o_ref[0] = (acc_ref[:] /
+                    jnp.maximum(l_ref[:, 0:1],
+                                jnp.float32(1e-37))).astype(o_ref.dtype)
+
+
+def _decode_attn_pallas(q, k_pages, v_pages, page_table, seq_lens, scale,
+                        interpret):
+    S, H, D = q.shape
+    P, _, page, _ = k_pages.shape
+    n_pages = page_table.shape[1]
+    kern = functools.partial(_decode_attn_kernel, page=page,
+                             n_pages=n_pages, scale=scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, n_pages),
+        in_specs=[
+            pl.BlockSpec((1, H, D), lambda s, j, pt, ln: (s, 0, 0)),
+            pl.BlockSpec((1, H, page, D),
+                         lambda s, j, pt, ln: (pt[s, j], 0, 0, 0)),
+            pl.BlockSpec((1, H, page, D),
+                         lambda s, j, pt, ln: (pt[s, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, H, D), lambda s, j, pt, ln: (s, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, D), jnp.float32),        # acc
+            pltpu.VMEM((H, 128), jnp.float32),      # running max
+            pltpu.VMEM((H, 128), jnp.float32),      # running sum
+        ],
+    )
+    with _enable_x64(False):
+        return pl.pallas_call(
+            kern, grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((S, H, D), q.dtype),
+            interpret=interpret,
+        )(page_table.astype(jnp.int32), seq_lens.astype(jnp.int32),
+          q, k_pages, v_pages)
+
+
+def _decode_attn_xla(q, k_pages, v_pages, page_table, seq_lens, scale):
+    """XLA formulation: gather the slots' pages, mask, one softmax.  It
+    materializes (S, max_pages·page, H·D) per call — fine on CPU and the
+    form GSPMD can shard over a tp axis (pallas_call is a partitioning
+    black box; the tp serving export always uses this path)."""
+    S, H, D = q.shape
+    page = k_pages.shape[2]
+    n_pages = page_table.shape[1]
+    T = n_pages * page
+    # (S, n_pages, H, page, D) -> (S, H, T, D)
+    k = k_pages[page_table].transpose(0, 2, 1, 3, 4).reshape(S, H, T, D)
+    v = v_pages[page_table].transpose(0, 2, 1, 3, 4).reshape(S, H, T, D)
+    s_sht = jnp.einsum("shd,shtd->sht", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * scale
+    pos = jnp.arange(T, dtype=jnp.int32)[None, None, :]
+    s_sht = jnp.where(pos < seq_lens[:, None, None].astype(jnp.int32),
+                      s_sht, jnp.float32(_NEG_BIG))
+    p = jax.nn.softmax(s_sht, axis=-1)
+    out = jnp.einsum("sht,shtd->shd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def decode_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
+                     page_table: jax.Array, seq_lens: jax.Array,
+                     scale=None, use_pallas=None) -> jax.Array:
+    """Single-query flash attention against a paged KV cache.
+
+    ``q``: (S, H, D) — one query token per decode slot; ``k_pages`` /
+    ``v_pages``: (P, H, page, D) physical page pools; ``page_table``:
+    (S, max_pages) int32 physical page id per (slot, logical page) —
+    every entry must be a VALID pool index (unused entries point at the
+    allocator's trash page); ``seq_lens``: (S,) int32 cached tokens per
+    slot (0 = inactive slot, output is garbage-but-finite).  Returns
+    (S, H, D).
+
+    ``use_pallas``: None consults ``MXNET_TPU_PALLAS_DECODE``
+    (``1``/``0``/``auto``; auto = the ops/autotune cache's measured
+    winner, falling back to pallas on TPU and XLA elsewhere)."""
+    S, H, D = q.shape
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(D))
+    if use_pallas is None:
+        knob = os.environ.get("MXNET_TPU_PALLAS_DECODE", "auto")
+        if knob in ("0", "1"):
+            use_pallas = knob == "1"
+        else:
+            from . import autotune as _autotune
+            use_pallas = _autotune.decode_backend(
+                S, H, D, k_pages.shape[2], str(q.dtype)) == "pallas"
+    if not use_pallas:
+        return _decode_attn_xla(q, k_pages, v_pages, page_table, seq_lens,
+                                float(scale))
+    return _decode_attn_pallas(q, k_pages, v_pages, page_table, seq_lens,
+                               float(scale),
+                               _interpret(q, k_pages, v_pages))
+
+
+# ---------------------------------------------------------------------------
+# weight-only quantized matmul (int8 / packed int4, per-channel scales)
+# ---------------------------------------------------------------------------
+#
+# The decode hot loop is weights-bandwidth-bound: every token re-reads
+# every matmul weight once.  Weight-only quantization (the
+# two_bit_compress kernel above is the in-repo template for fused
+# quantize/dequantize passes) cuts that HBM traffic 4x (int8) / 8x
+# (int4) with dequantization FUSED into the matmul kernel — the f32
+# weights never exist in HBM.  Scales are per output channel, the
+# granularity at which FC weights are row-scaled (y = x @ W.T).
+
+_QMAX = {8: 127, 4: 7}
+
+
+def quantize_weight(w, bits: int = 8):
+    """Quantize an FC weight (N, K) -> (qw, scales) with per-output-
+    channel (per-row) scales.  int8: ``qw`` is (N, K) int8.  int4:
+    ``qw`` is (N, K//2) uint8 with two nibbles per byte (K padded to
+    even; low nibble = even k, high nibble = odd k), values in [-7, 7].
+    Dequantization is ``w ≈ qw * scales[:, None]``."""
+    if bits not in _QMAX:
+        raise ValueError("quantize_weight: bits must be 8 or 4, got %r"
+                         % (bits,))
+    w = np.asarray(w, np.float32)
+    if w.ndim != 2:
+        raise ValueError("quantize_weight wants a 2-D FC weight, got %s"
+                         % (w.shape,))
+    qmax = _QMAX[bits]
+    scales = np.max(np.abs(w), axis=1) / qmax
+    scales = np.where(scales == 0, 1.0, scales).astype(np.float32)
+    q = np.clip(np.rint(w / scales[:, None]), -qmax, qmax)
+    if bits == 8:
+        return q.astype(np.int8), scales
+    if w.shape[1] % 2:
+        q = np.concatenate([q, np.zeros((w.shape[0], 1), q.dtype)], axis=1)
+    lo = q[:, 0::2].astype(np.int64) & 0xF
+    hi = q[:, 1::2].astype(np.int64) & 0xF
+    return ((hi << 4) | lo).astype(np.uint8), scales
+
+
+def _unpack_int4(packed):
+    """(N, K//2) uint8 -> (N, K) f32 in [-7, 7] (sign-extended nibbles)."""
+    p = packed.astype(jnp.int32)
+    lo = p & 0xF
+    hi = (p >> 4) & 0xF
+    both = jnp.stack([lo, hi], axis=-1).reshape(p.shape[0], -1)
+    return jnp.where(both > 7, both - 16, both).astype(jnp.float32)
+
+
+def _quant_matmul_kernel(x_ref, qw_ref, sc_ref, o_ref, acc_ref, *,
+                         bits, nk):
+    """One (M, bn) output tile: the k-axis is the sequential grid
+    dimension; each step dequantizes ONE (bn, bk) weight tile in VMEM
+    (int4: unpacked from (bn, bk//2) nibbles) and accumulates
+    x_tile @ w_tile.T in f32 scratch — the f32 weight tile exists only
+    on-chip, never in HBM."""
+    ki = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    x = x_ref[:].astype(jnp.float32)                 # (M, bk)
+    if bits == 4:
+        w = _unpack_int4(qw_ref[:])                  # (bn, bk)
+    else:
+        w = qw_ref[:].astype(jnp.float32)
+    acc_ref[:] = acc_ref[:] + jax.lax.dot_general(
+        x, w, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)          # (M, bn)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        o_ref[:] = (acc_ref[:] * sc_ref[:].reshape(1, -1)
+                    ).astype(o_ref.dtype)
+
+
+def _quant_matmul_xla(x, qw, scales, bits):
+    if bits == 4:
+        w = _unpack_int4(qw)
+    else:
+        w = qw.astype(jnp.float32)
+    w = w * scales[:, None]
+    return jax.lax.dot_general(
+        x.astype(jnp.float32), w, (((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+def quant_matmul(x: jax.Array, qw: jax.Array, scales: jax.Array,
+                 bits: int = 8, block_n: int = 256, block_k: int = 512,
+                 use_pallas=None) -> jax.Array:
+    """``x @ dequant(qw).T`` with per-channel scales (see
+    :func:`quantize_weight`).  ``x``: (..., K); returns (..., N).
+
+    ``use_pallas``: None consults ``MXNET_TPU_PALLAS_QUANT`` (``1`` /
+    ``0``; default: pallas on TPU, XLA elsewhere — the XLA form is what
+    GSPMD shards for tensor-parallel serving)."""
+    if use_pallas is None:
+        knob = os.environ.get("MXNET_TPU_PALLAS_QUANT", "")
+        if knob in ("0", "1"):
+            use_pallas = knob == "1"
+        else:
+            use_pallas = not _interpret(x, qw)
+    N = qw.shape[0]
+    K = x.shape[-1]
+    if not use_pallas:
+        return _quant_matmul_xla(x, qw, scales, bits)
+    lead = x.shape[:-1]
+    x2 = x.reshape(-1, K)
+    M = x2.shape[0]
+    bn = min(block_n, N)
+    while N % bn:
+        bn //= 2
+    bk = min(block_k, K)
+    while K % bk:
+        bk //= 2
+    nk = K // bk
+    kern = functools.partial(_quant_matmul_kernel, bits=bits, nk=nk)
+    # int4 tiles address the PACKED byte axis (two k per byte)
+    kdiv = 2 if bits == 4 else 1
+    with _enable_x64(False):
+        out = pl.pallas_call(
+            kern,
+            grid=(N // bn, nk),
+            in_specs=[
+                pl.BlockSpec((M, bk), lambda n, k_: (0, k_)),
+                pl.BlockSpec((bn, bk // kdiv), lambda n, k_: (n, k_)),
+                pl.BlockSpec((bn,), lambda n, k_: (n,)),
+            ],
+            out_specs=pl.BlockSpec((M, bn), lambda n, k_: (0, n)),
+            out_shape=jax.ShapeDtypeStruct((M, N), x.dtype),
+            scratch_shapes=[pltpu.VMEM((M, bn), jnp.float32)],
+            interpret=_interpret(x, qw),
+        )(x2, qw, scales)
+    return out.reshape(lead + (N,))

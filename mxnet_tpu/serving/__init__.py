@@ -57,6 +57,8 @@ from .request import Request
 from .runtime import ServingRuntime
 from .router import FleetRouter, TenantPolicy
 from .fleet import ServingFleet
+from .decode import (DecodeConfig, DecodeEngine, DecodeProgram,
+                     DecodeRequest, PagePool, init_decode_params)
 
 __all__ = [
     "ServingRuntime", "Request", "AdmissionQueue", "CircuitBreaker",
@@ -65,5 +67,7 @@ __all__ = [
     "ExecFailed", "SwapFailed", "TopologyMismatch", "QuotaExceeded",
     "ReplicaUnavailable", "Cancelled",
     "ServingFleet", "FleetRouter", "TenantPolicy",
+    "DecodeConfig", "DecodeEngine", "DecodeProgram", "DecodeRequest",
+    "PagePool", "init_decode_params",
     "normalize_inputs", "collect_batch", "pack", "unpack",
 ]

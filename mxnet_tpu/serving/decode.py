@@ -1,0 +1,1078 @@
+"""Interactive decode engine: paged KV cache + continuous token-level
+batching over one compiled step program.
+
+The batch-scoring runtime (:mod:`runtime`) packs whole requests into one
+fixed ``fwd(params, inputs)`` dispatch; transformer *generation* under
+that model re-runs full prefill per token — O(T²) work per sequence and
+a fresh XLA program per (batch, length) shape.  This module is the
+interactive half the TensorFlow system paper calls the core serving
+split (PAPERS.md): a decode loop whose per-token step
+
+* keeps K/V in a **paged cache**: one fixed physical page pool
+  ``(L, 2, P, H, page, D)`` plus per-slot page tables, so cache shapes
+  NEVER change — the step program compiles exactly once, whatever
+  sequence lengths come and go (the recompile-per-token trap is
+  graphcheck rule GC307);
+* writes the new token's K/V **in place** (donated pool, scatter at
+  ``(page, offset)`` from the page table) and attends with the Pallas
+  single-query flash kernel (:func:`~mxnet_tpu.ops.pallas_kernels
+  .decode_attention`) walking the slot's pages via scalar-prefetched
+  indices — or the XLA gather formulation, which is also what GSPMD
+  shards for tensor-parallel serving (``MXNET_TPU_PALLAS_DECODE``);
+* runs **continuous token-level batching** (:class:`DecodeEngine`):
+  a scheduler admits and retires sequences per STEP, so requests join
+  and leave the running batch mid-generation — slot allocation from the
+  page pool, prefill chunked into the running batch one token per step,
+  admission-queue priorities/eviction and deadlines preserved (a
+  retired or evicted sequence can never late-OK: the Request future is
+  one-shot);
+* optionally serves **weight-only quantized** matmuls (int8 / packed
+  int4, per-channel scales, dequantization fused in the kernel —
+  :func:`~mxnet_tpu.ops.pallas_kernels.quant_matmul`), selected at
+  export time;
+* exports with **NamedSharding over the unified mesh** (PR-10 placement
+  grammar): ``mesh={"tp": k}`` shards attention heads, FFN hidden and
+  the KV pool over ``tp`` so a model bigger than one device's budget
+  serves from a tp slice — the per-axis collective audit
+  (:func:`decode_tp_model_bytes`) proves the step moves only the
+  analytic activation-reduction bytes.
+
+Env knobs (docs/deploy.md "Interactive decode"):
+
+=====================================  ==================================
+``MXNET_TPU_DECODE_SLOTS``             decode batch width S (8)
+``MXNET_TPU_DECODE_PAGE``              KV page size, tokens (64)
+``MXNET_TPU_DECODE_PAGES``             physical pages in the pool
+                                       (0 = full residency:
+                                       1 + S·pages_per_seq)
+``MXNET_TPU_DECODE_MAX_NEW``           default max new tokens (128)
+``MXNET_TPU_PALLAS_DECODE``            decode-attention backend:
+                                       ``1`` pallas / ``0`` xla /
+                                       ``auto`` (autotune cache, else
+                                       pallas on TPU)
+=====================================  ==================================
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import telemetry
+from ..base import MXNetError
+from ..resilience import chaos
+from ..resilience.container import read_container, write_container
+from .errors import (DeadlineExceeded, ExecFailed, Overloaded,
+                     ServingError, SwapFailed, TopologyMismatch)
+from .request import Request
+from .runtime import ServingRuntime, _env_int
+
+__all__ = ["DecodeConfig", "PagePool", "DecodeProgram", "DecodeRequest",
+           "DecodeEngine", "init_decode_params", "decode_tp_model_bytes"]
+
+_MAGIC = "mxnet_tpu-decode-v1"
+
+# weights the quantized export rewrites (per layer + the head); LN affine
+# params, biases and embeddings stay f32 — they are O(hidden), noise next
+# to the O(hidden²)/O(V·hidden) matmul weights the quantization targets
+_QUANT_SUFFIXES = ("q", "k", "v", "proj", "ff1", "ff2")
+
+
+class DecodeConfig:
+    """Static geometry of one decode deployment — everything the step
+    program's shapes depend on, so two programs with equal configs are
+    swap-compatible."""
+
+    __slots__ = ("vocab_size", "num_layers", "hidden", "heads",
+                 "max_seq_len", "page_size", "max_seqs", "quantize",
+                 "eos_id", "forward_len")
+
+    def __init__(self, vocab_size, num_layers, hidden, heads,
+                 max_seq_len, page_size=None, max_seqs=None,
+                 quantize=None, eos_id=None, forward_len=None):
+        self.vocab_size = int(vocab_size)
+        self.num_layers = int(num_layers)
+        self.hidden = int(hidden)
+        self.heads = int(heads)
+        if self.hidden % self.heads:
+            raise MXNetError("hidden %d not divisible by heads %d"
+                             % (self.hidden, self.heads))
+        self.max_seq_len = int(max_seq_len)
+        self.page_size = int(page_size if page_size is not None
+                             else _env_int("MXNET_TPU_DECODE_PAGE", 64))
+        self.max_seqs = int(max_seqs if max_seqs is not None
+                            else _env_int("MXNET_TPU_DECODE_SLOTS", 8))
+        if quantize not in (None, "int8", "int4"):
+            raise MXNetError("quantize must be None/'int8'/'int4', got %r"
+                             % (quantize,))
+        self.quantize = quantize
+        self.eos_id = None if eos_id is None else int(eos_id)
+        # the fixed prompt width of the batch `forward` surface (canary
+        # runs, fleet batch mode) — independent of max_seq_len
+        self.forward_len = int(forward_len if forward_len is not None
+                               else min(8, self.max_seq_len))
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden // self.heads
+
+    @property
+    def pages_per_seq(self) -> int:
+        return -(-self.max_seq_len // self.page_size)
+
+    def pool_pages(self) -> int:
+        """Physical pages in the pool: page 0 is the allocator's trash
+        page (inactive slots write there, nothing reads it), the rest
+        serve sequences.  Default = full residency for max_seqs."""
+        n = _env_int("MXNET_TPU_DECODE_PAGES", 0)
+        return int(n) if n > 0 else 1 + self.max_seqs * self.pages_per_seq
+
+    def to_meta(self) -> dict:
+        return {k: getattr(self, k) for k in self.__slots__}
+
+    @classmethod
+    def from_meta(cls, meta) -> "DecodeConfig":
+        return cls(**{k: meta.get(k) for k in cls.__slots__})
+
+    def same_geometry(self, other) -> bool:
+        return all(getattr(self, k) == getattr(other, k)
+                   for k in self.__slots__ if k != "quantize")
+
+    def describe(self) -> str:
+        return ("L%d H%d heads%d V%d T%d page%d S%d%s"
+                % (self.num_layers, self.hidden, self.heads,
+                   self.vocab_size, self.max_seq_len, self.page_size,
+                   self.max_seqs,
+                   " %s" % self.quantize if self.quantize else ""))
+
+
+class PagePool:
+    """Host-side physical-page allocator over the fixed device pool.
+
+    Page 0 is reserved as the trash page: inactive slots scatter their
+    (masked, never-read) K/V writes there, so the step program needs no
+    control flow for slot liveness."""
+
+    def __init__(self, num_pages: int):
+        if num_pages < 2:
+            raise MXNetError("page pool needs >= 2 pages, got %d"
+                             % num_pages)
+        self.num_pages = int(num_pages)
+        self._free: List[int] = list(range(1, self.num_pages))
+        self._lock = threading.Lock()
+
+    @property
+    def available(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """``n`` pages or None (never a partial grant)."""
+        with self._lock:
+            if n > len(self._free):
+                return None
+            pages, self._free = self._free[:n], self._free[n:]
+            return pages
+
+    def free(self, pages: Sequence[int]):
+        with self._lock:
+            self._free.extend(int(p) for p in pages)
+
+
+def init_decode_params(config: DecodeConfig, seed: int = 0,
+                       scale: float = 0.02) -> Dict[str, np.ndarray]:
+    """Random parameters with the TRAINING graph's names and layouts
+    (models/transformer.get_symbol) — the decode program consumes a
+    trained module's ``arg_params`` directly; this helper only exists
+    for tests and benches that have no trained model at hand."""
+    rs = np.random.RandomState(seed)
+    h, v, t = config.hidden, config.vocab_size, config.max_seq_len
+
+    def w(*shape):
+        return (rs.randn(*shape) * scale).astype(np.float32)
+
+    params = {"tok_embed_weight": w(v, h), "pos_embed": w(t, h),
+              "ln_f_gamma": np.ones(h, np.float32),
+              "ln_f_beta": np.zeros(h, np.float32),
+              "head_weight": w(v, h), "head_bias": np.zeros(v, np.float32)}
+    for i in range(config.num_layers):
+        p = "l%d_" % i
+        for nm, shape in (("q", (h, h)), ("k", (h, h)), ("v", (h, h)),
+                          ("proj", (h, h)), ("ff1", (4 * h, h)),
+                          ("ff2", (h, 4 * h))):
+            params[p + nm + "_weight"] = w(*shape)
+            params[p + nm + "_bias"] = np.zeros(shape[0], np.float32)
+        for ln in ("ln1", "ln2"):
+            params[p + ln + "_gamma"] = np.ones(h, np.float32)
+            params[p + ln + "_beta"] = np.zeros(h, np.float32)
+    return params
+
+
+def decode_tp_model_bytes(config: DecodeConfig, tp: int,
+                          itemsize: int = 4) -> dict:
+    """Analytic per-step collective payloads of the tp-sharded decode
+    step (the audit-side model a test holds the lowered HLO against):
+    Megatron-style head/FFN sharding leaves TWO partial-sum reductions
+    per layer — the attention projection and the FFN down-projection —
+    each of the (S, hidden) activation, and the row-sharded vocab head
+    gathers the (S, vocab) logits back whole (a vocab the tp degree
+    does not divide keeps a replicated head per the placement degrade
+    rule, and the gather disappears).  Nothing else may move: weights
+    and KV pages stay resident in their shards."""
+    S, h = config.max_seqs, config.hidden
+    out = {"all-reduce": 2 * config.num_layers * S * h * itemsize}
+    if tp > 1 and config.vocab_size % tp == 0:
+        out["all-gather"] = S * config.vocab_size * itemsize
+    return out
+
+
+def _quantize_params(params, config: DecodeConfig):
+    """Rewrite the matmul weights to (int payload, per-channel scales)
+    pairs; everything else passes through."""
+    from ..ops import pallas_kernels as pk
+    bits = 8 if config.quantize == "int8" else 4
+    names = set()
+    for i in range(config.num_layers):
+        for s in _QUANT_SUFFIXES:
+            names.add("l%d_%s_weight" % (i, s))
+    names.add("head_weight")
+    out = {}
+    for k, v in params.items():
+        if k in names:
+            q, sc = pk.quantize_weight(np.asarray(v), bits)
+            out[k + "#q"] = q
+            out[k + "#scale"] = sc
+        else:
+            out[k] = np.asarray(v, np.float32)
+    return out
+
+
+def _build_mesh(mesh):
+    """None | MeshSpec | {"tp": k} axes dict -> MeshSpec or None."""
+    if mesh is None:
+        return None
+    if hasattr(mesh, "mesh"):
+        return mesh
+    from ..parallel.mesh import MeshSpec
+    return MeshSpec.build(dict(mesh))
+
+
+class DecodeProgram:
+    """One compiled decode step + its weights + cache geometry.
+
+    ``params``: the training graph's ``arg_params`` (name -> array,
+    models/transformer naming).  ``mesh``: None, a MeshSpec, or an axes
+    dict like ``{"tp": 2}`` — params and the KV pool are placed with
+    ``NamedSharding`` over the unified mesh and the step runs under
+    GSPMD (attention heads / FFN hidden / KV pool sharded over ``tp``).
+    ``quantize`` (or ``config.quantize``): int8/int4 weight-only
+    quantized matmuls, fixed at construction = "selected at export".
+    """
+
+    def __init__(self, params: Dict, config: DecodeConfig, *, mesh=None,
+                 quantize=None, name="decode"):
+        import jax
+
+        if quantize is not None:
+            config = DecodeConfig(**dict(config.to_meta(),
+                                         quantize=quantize))
+        self.config = config
+        self.name = name
+        self.spec = _build_mesh(mesh)
+        if self.spec is not None and config.heads % max(
+                1, self.spec.axis_size("tp")):
+            raise MXNetError("heads %d not divisible by tp=%d"
+                             % (config.heads, self.spec.axis_size("tp")))
+        host = {k: np.asarray(v) for k, v in params.items()}
+        self._check_params(host)
+        if config.quantize and not any("#q" in k for k in host):
+            host = _quantize_params(host, config)
+        self._params = {k: self._place_param(k, v) for k, v in host.items()}
+        telemetry.memory.tag(list(self._params.values()), "served",
+                             label="DecodeProgram(%s)" % name)
+        self.trace_count = 0          # bumps INSIDE the traced step: the
+        # compile-once oracle (a retrace is a bug, not a slow path)
+        self._jit_step = self._make_jit_step()
+        self._compiled = False
+        self._compile_lock = threading.Lock()
+        # generic program surface (schema checks, canary, fleet batch
+        # mode): one fixed (S, forward_len) token matrix in, next-token
+        # ids out
+        S = config.max_seqs
+        self.input_names = ["tokens"]
+        self.input_shapes = {"tokens": (S, config.forward_len)}
+        self.input_dtypes = {"tokens": np.dtype(np.int32)}
+        self.output_shapes = [(S, 1)]
+
+    # -- construction helpers ---------------------------------------------
+    def _check_params(self, host):
+        need = {"tok_embed_weight", "pos_embed", "ln_f_gamma",
+                "ln_f_beta", "head_weight", "head_bias"}
+        for i in range(self.config.num_layers):
+            p = "l%d_" % i
+            for nm in _QUANT_SUFFIXES:
+                need.add(p + nm + "_weight")
+                need.add(p + nm + "_bias")
+            for ln in ("ln1", "ln2"):
+                need.add(p + ln + "_gamma")
+                need.add(p + ln + "_beta")
+        have = {k.split("#")[0] for k in host}
+        missing = sorted(need - have)
+        if missing:
+            raise MXNetError("decode params missing %s (training-graph "
+                             "names, models/transformer.get_symbol)"
+                             % missing[:6])
+
+    def _param_pspec(self, key):
+        """PartitionSpec of one parameter under the tp recipe."""
+        from jax.sharding import PartitionSpec as P
+        base = key.split("#")[0]
+        leaf = base.split("_", 1)[-1] if base.startswith("l") else base
+        if base.startswith("l"):
+            nm = base.split("_")[1]
+            if nm in ("q", "k", "v", "ff1"):
+                # row-parallel: output features sharded (= heads for
+                # q/k/v since heads are contiguous head_dim blocks)
+                if key.endswith("#scale") or leaf.endswith("bias"):
+                    return P("tp")
+                return P("tp", None)
+            if nm in ("proj", "ff2"):
+                # column-parallel: contraction dim sharded, partial sums
+                # reduce across tp
+                if key.endswith("#scale") or leaf.endswith("bias"):
+                    return P()
+                return P(None, "tp")
+            return P()                      # layernorm affine
+        if base == "head_weight" and not key.endswith("#scale"):
+            return P("tp", None)            # vocab rows sharded
+        # head bias/scales stay replicated: sharding them makes XLA
+        # all-gather bias and product separately (two gathers where the
+        # analytic model budgets one)
+        return P()                          # embeddings, final LN, head
+
+    def _place_param(self, key, value):
+        import jax
+        if self.spec is None:
+            return jax.device_put(value)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        spec = self._param_pspec(key)
+        # a dim the recipe would shard but the axis does not divide
+        # degrades to replicated (e.g. an odd vocab head on tp2) — the
+        # analytic model (decode_tp_model_bytes) mirrors this rule
+        for dim, axis in enumerate(spec):
+            if axis and np.asarray(value).shape[dim] % max(
+                    1, self.spec.axis_size(axis)):
+                spec = P()
+                break
+        return jax.device_put(value,
+                              NamedSharding(self.spec.mesh, spec))
+
+    def kv_sharding(self):
+        if self.spec is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return NamedSharding(self.spec.mesh,
+                             P(None, None, None, "tp", None, None))
+
+    def fresh_cache(self):
+        """Zeroed page pool ``(L, 2, P, H, page, D)`` on device (tp:
+        sharded over heads).  The engine owns exactly one and threads it
+        through every step (donated)."""
+        import jax
+        import jax.numpy as jnp
+        c = self.config
+        shape = (c.num_layers, 2, c.pool_pages(), c.heads, c.page_size,
+                 c.head_dim)
+        z = jnp.zeros(shape, jnp.float32)
+        kv = jax.device_put(z, self.kv_sharding()) \
+            if self.spec is not None else jax.device_put(z)
+        telemetry.memory.tag(kv, "kv_cache",
+                             label="DecodeProgram(%s).kv" % self.name)
+        return kv
+
+    @property
+    def cache_bytes(self) -> int:
+        c = self.config
+        return (c.num_layers * 2 * c.pool_pages() * c.heads *
+                c.page_size * c.head_dim * 4)
+
+    # -- the step program --------------------------------------------------
+    def _make_step_fn(self, count=True):
+        import jax
+        import jax.numpy as jnp
+        c = self.config
+        H, Dh = c.heads, c.head_dim
+        bits = 8 if c.quantize == "int8" else 4
+        # under GSPMD the pallas kernels are partitioning black boxes:
+        # the tp export always uses the XLA formulations (sharded by the
+        # partitioner); single-device follows the knob/autotune cache
+        sharded = self.spec is not None
+        from ..ops import pallas_kernels as pk
+
+        def lin(p, x, name):
+            wq = p.get(name + "_weight#q")
+            if wq is not None:
+                y = pk.quant_matmul(x, wq, p[name + "_weight#scale"],
+                                    bits,
+                                    use_pallas=False if sharded else None)
+            else:
+                y = x @ p[name + "_weight"].T
+            return y + p[name + "_bias"]
+
+        def ln(p, x, name):
+            x32 = x.astype(jnp.float32)
+            mean = jnp.mean(x32, axis=-1, keepdims=True)
+            var = jnp.var(x32, axis=-1, keepdims=True)
+            inv = jax.lax.rsqrt(var + 1e-5)
+            return (x32 - mean) * inv * p[name + "_gamma"] \
+                + p[name + "_beta"]
+
+        def step(params, kv, tokens, positions, seq_lens, phys, off,
+                 page_table):
+            # ONE trace, ever: shapes are fixed by the config, token
+            # positions/lengths/page indices are all data (GC307)
+            if count:
+                self.trace_count += 1
+            S = c.max_seqs
+            x = params["tok_embed_weight"][tokens] \
+                + params["pos_embed"][positions]          # (S, hidden)
+            for i in range(c.num_layers):
+                pfx = "l%d_" % i
+                a = ln(params, x, pfx + "ln1")
+                q = lin(params, a, pfx + "q").reshape(S, H, Dh)
+                k = lin(params, a, pfx + "k").reshape(S, H, Dh)
+                v = lin(params, a, pfx + "v").reshape(S, H, Dh)
+                # in-place paged write: scatter this token's K/V into
+                # (physical page, offset) per slot — donated pool, so
+                # XLA updates in place and shapes never change
+                kv = kv.at[i, 0, phys, :, off, :].set(
+                    k.astype(kv.dtype))
+                kv = kv.at[i, 1, phys, :, off, :].set(
+                    v.astype(kv.dtype))
+                att = pk.decode_attention(
+                    q, kv[i, 0], kv[i, 1], page_table, seq_lens,
+                    use_pallas=False if sharded else None)
+                att = lin(params, att.reshape(S, c.hidden), pfx + "proj")
+                x = x + att
+                f = ln(params, x, pfx + "ln2")
+                f = lin(params, f, pfx + "ff1")
+                f = jax.nn.gelu(f, approximate=False)
+                f = lin(params, f, pfx + "ff2")
+                x = x + f
+            x = ln(params, x, "ln_f")
+            logits = lin(params, x, "head")               # (S, vocab)
+            if sharded:
+                # the row-sharded vocab head leaves logits tp-sharded;
+                # gather them INSIDE the program (this is the one
+                # all-gather the analytic model budgets) so sampling and
+                # the host fetch see replicated values
+                from jax.sharding import NamedSharding, PartitionSpec
+                logits = jax.lax.with_sharding_constraint(
+                    logits, NamedSharding(self.spec.mesh,
+                                          PartitionSpec()))
+            next_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return next_tok, logits, kv
+
+        return step
+
+    def _make_jit_step(self):
+        import jax
+        return jax.jit(self._make_step_fn(), donate_argnums=(1,))
+
+    def _zero_step_args(self):
+        c = self.config
+        S = c.max_seqs
+        i32 = np.int32
+        return (np.zeros(S, i32), np.zeros(S, i32), np.zeros(S, i32),
+                np.zeros(S, i32), np.zeros(S, i32),
+                np.zeros((S, c.pages_per_seq), i32))
+
+    def step(self, kv, tokens, positions, seq_lens, phys, off,
+             page_table):
+        """One decode step for every slot; returns ``(next_tokens,
+        logits, kv')``.  ``kv`` is DONATED — the caller must thread the
+        returned pool into the next call."""
+        self.ensure_compiled()
+        return self._jit_step(self._params, kv, tokens, positions,
+                              seq_lens, phys, off, page_table)
+
+    def ensure_compiled(self):
+        """Compile the step once, visibly: the first build rides a
+        ``compile/decode_step`` span + :func:`telemetry.tracing
+        .note_compile`, so 'zero compiles after warmup' is provable from
+        the same ``compile/*`` span family the trainer and the elastic
+        drills use."""
+        if self._compiled:
+            return
+        with self._compile_lock:
+            if self._compiled:
+                return
+            kv = self.fresh_cache()
+            with telemetry.span("compile/decode_step", cat="compile",
+                                metric="compile.seconds", timed=True,
+                                program=self.name) as sp:
+                out = self._jit_step(self._params, kv,
+                                     *self._zero_step_args())
+            import jax
+            jax.block_until_ready(out[0])
+            telemetry.tracing.note_compile("decode_step", sp.duration,
+                                           program=self.name,
+                                           config=self.config.describe())
+            self._compiled = True
+
+    def lowered_step_text(self) -> str:
+        """Optimized HLO of the step program (collective audits, GC307
+        companions)."""
+        import jax
+        lowered = jax.jit(self._make_step_fn(count=False)).lower(
+            self._params, self.fresh_cache(), *self._zero_step_args())
+        return lowered.compile().as_text()
+
+    # -- generic batch surface (canary, fleet batch mode) ------------------
+    def forward(self, tokens):
+        """Fixed-shape batch surface: prefill each row of ``tokens``
+        ((S, forward_len) int32) through the step program on a scratch
+        cache and return the next-token ids ``(S, 1)``.  This is the
+        swap-canary / ServingRuntime-compatible face of the program; the
+        interactive path is :class:`DecodeEngine`."""
+        c = self.config
+        toks = np.asarray(tokens, np.int32).reshape(c.max_seqs,
+                                                    c.forward_len)
+        S = c.max_seqs
+        pages_needed = -(-c.forward_len // c.page_size)
+        if 1 + S * pages_needed > c.pool_pages():
+            raise ServingError("forward_len %d needs %d pages > pool %d"
+                               % (c.forward_len, S * pages_needed,
+                                  c.pool_pages()))
+        table = np.zeros((S, c.pages_per_seq), np.int32)
+        for s in range(S):
+            table[s, :pages_needed] = 1 + s * pages_needed \
+                + np.arange(pages_needed)
+        kv = self.fresh_cache()
+        nxt = None
+        for t in range(c.forward_len):
+            pos = np.full(S, t, np.int32)
+            nxt, _logits, kv = self.step(
+                kv, toks[:, t], pos, pos + 1,
+                table[np.arange(S), t // c.page_size],
+                np.full(S, t % c.page_size, np.int32), table)
+        return [np.asarray(nxt).reshape(S, 1)]
+
+    # -- export / load ------------------------------------------------------
+    def export(self, path) -> str:
+        """Write the per-topology deploy artifact: weights (quantized
+        payloads included), config, and the device fingerprint + mesh
+        axes it was built for.  No executable blob and no pickle — the
+        loader re-jits through the one-compile step path (XLA:CPU
+        executables with donated inputs do not survive serialization;
+        see mxnet_tpu/compile/cache.donation_safe)."""
+        from ..deploy import _current_topology, device_fingerprint
+        platform, kind, count = _current_topology()
+        meta = {
+            "magic": _MAGIC,
+            "config": self.config.to_meta(),
+            "platform": platform, "device_kind": kind,
+            "device_count": count,
+            "topologies": {device_fingerprint(): "params"},
+            "mesh_axes": (dict(self.spec.mesh.shape)
+                          if self.spec is not None else None),
+            "param_names": sorted(self._params),
+        }
+        arrays = {"param/%s" % k: np.asarray(v)
+                  for k, v in self._params.items()}
+        write_container(path, arrays=arrays, meta=meta, blobs={})
+        return path
+
+    @classmethod
+    def load(cls, path, mesh="artifact", name=None):
+        """Load an exported decode artifact.  ``mesh="artifact"``
+        re-forms the mesh axes recorded at export (requiring the same
+        device count on this host — typed :class:`TopologyMismatch`
+        otherwise); pass an explicit mesh/axes dict or None to override.
+        """
+        arrays, meta, _blobs = read_container(path)
+        if meta.get("magic") != _MAGIC:
+            raise MXNetError("%s is not a decode artifact (magic %r)"
+                             % (path, meta.get("magic")))
+        config = DecodeConfig.from_meta(meta["config"])
+        axes = meta.get("mesh_axes")
+        if mesh == "artifact":
+            mesh = axes
+        if mesh:
+            import jax
+            need = 1
+            for v in dict(mesh).values():
+                need *= int(v)
+            have = len(jax.devices())
+            if need > have:
+                raise TopologyMismatch(
+                    "artifact was exported for mesh %s (%d devices) but "
+                    "this process sees %d" % (dict(mesh), need, have))
+        params = {k[len("param/"):]: v for k, v in arrays.items()
+                  if k.startswith("param/")}
+        prog = cls(params, config, mesh=mesh,
+                   name=name or os.path.basename(os.fspath(path)))
+        telemetry.count("deploy.loads")
+        return prog
+
+
+class DecodeRequest(Request):
+    """One generation request: a prompt, a token budget, the shared
+    deadline/priority semantics, and a one-shot future delivering the
+    generated ids."""
+
+    __slots__ = ("prompt", "max_new", "generated", "tenant")
+
+    def __init__(self, prompt, max_new, priority=0, deadline=None,
+                 seq=-1):
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ServingError("empty prompt")
+        super().__init__({"tokens": prompt}, 1, priority=priority,
+                         deadline=deadline, seq=seq)
+        self.prompt = prompt
+        self.max_new = int(max_new)
+        self.generated: List[int] = []
+        self.tenant = None
+
+    @property
+    def n_prompt(self) -> int:
+        return int(self.prompt.size)
+
+
+class _Slot:
+    """Host-side state of one occupied decode slot."""
+
+    __slots__ = ("req", "pages", "pos")
+
+    def __init__(self, req: DecodeRequest, pages: List[int]):
+        self.req = req
+        self.pages = pages
+        self.pos = 0              # tokens fed so far (prompt + generated)
+
+
+class DecodeEngine(ServingRuntime):
+    """Continuous token-level batching inside the serving runtime.
+
+    The worker loop is a per-STEP scheduler instead of the batch
+    packer: every iteration it retires finished/expired/cancelled
+    sequences (freeing their pages), admits queued requests into free
+    slots (allocating pages up front so a running sequence can never
+    starve mid-generation; a higher-priority arrival may EVICT the
+    cheapest running sequence when the pool is exhausted), then runs ONE
+    decode step for all occupied slots — prefill is chunked into the
+    running batch one token per step, so a long prompt never stalls
+    other tenants' token cadence.  Admission, breaker, watchdog-armed
+    dispatch, and the one-shot Request future (no late OKs, ever) are
+    inherited from :class:`ServingRuntime`."""
+
+    def __init__(self, program, *, max_new_default=None, **kw):
+        prog = self._load_program(program)
+        if not isinstance(prog, DecodeProgram):
+            raise ServingError("DecodeEngine needs a DecodeProgram, got %r"
+                               % (type(prog).__name__,))
+        c = prog.config
+        self._slots: List[Optional[_Slot]] = [None] * c.max_seqs
+        self._pool = PagePool(c.pool_pages())
+        self._kv = None
+        self._table = np.zeros((c.max_seqs, c.pages_per_seq), np.int32)
+        self._max_new_default = int(
+            max_new_default if max_new_default is not None
+            else _env_int("MXNET_TPU_DECODE_MAX_NEW", 128))
+        self._occ_hist = telemetry.Histogram(
+            "decode.occupancy", registered=False, always=True)
+        kw.setdefault("name", "decode")
+        super().__init__(prog, **kw)
+        # compile BEFORE serving (one visible compile/decode_step span;
+        # the loop itself never compiles — GC307's invariant) and, under
+        # MXNET_TPU_PREFLIGHT=1, statically prove it
+        prog.ensure_compiled()
+        self._maybe_preflight(prog)
+        self._kv = prog.fresh_cache()
+
+    # -- admission ----------------------------------------------------------
+    def submit(self, tokens=None, *, max_new_tokens=None, priority=0,
+               deadline=None, **_ignored) -> DecodeRequest:
+        """Admit one generation request; returns its
+        :class:`DecodeRequest` future (``result()`` -> ``[ids]``)."""
+        if self._stop:
+            raise ServingError("engine is closed")
+        c = self._program.config
+        prompt = np.asarray(tokens, np.int32).reshape(-1)
+        max_new = int(max_new_tokens if max_new_tokens is not None
+                      else self._max_new_default)
+        if max_new < 1:
+            raise ServingError("max_new_tokens must be >= 1, got %d"
+                               % max_new)
+        if prompt.size + max_new > c.max_seq_len:
+            raise ServingError(
+                "prompt %d + max_new %d exceeds max_seq_len %d"
+                % (prompt.size, max_new, c.max_seq_len))
+        with self._lock:
+            self._counters["submitted"] += 1
+            self._seq += 1
+            seq = self._seq
+        if not self._breaker.admit_ok():
+            with self._lock:
+                self._counters["shed_circuit"] += 1
+            telemetry.count("serve.shed", cause="circuit")
+            from .errors import CircuitOpen
+            raise CircuitOpen("circuit open; shedding until the %.1fs "
+                              "cooldown probe succeeds"
+                              % self._breaker.cooldown)
+        rel = self._default_deadline if deadline is None else deadline
+        abs_deadline = (time.monotonic() + rel
+                        if rel is not None and rel > 0 else None)
+        req = DecodeRequest(prompt, max_new, priority=priority,
+                            deadline=abs_deadline, seq=seq)
+        self._queue.offer(req)
+        with self._lock:
+            self._counters["admitted"] += 1
+        return req
+
+    def generate(self, tokens, *, max_new_tokens=None, priority=0,
+                 deadline=None) -> np.ndarray:
+        """Synchronous submit + wait; returns the generated ids."""
+        req = self.submit(tokens, max_new_tokens=max_new_tokens,
+                          priority=priority, deadline=deadline)
+        wait = None if req.deadline is None else req.remaining() + 5.0
+        return req.result(timeout=wait)[0]
+
+    # -- scheduler ----------------------------------------------------------
+    def _active(self) -> List[int]:
+        return [i for i, s in enumerate(self._slots) if s is not None]
+
+    def _pages_for(self, req: DecodeRequest) -> int:
+        c = self._program.config
+        return -(-(req.n_prompt + req.max_new) // c.page_size)
+
+    def _release_slot(self, idx: int):
+        slot = self._slots[idx]
+        if slot is None:
+            return
+        self._slots[idx] = None
+        self._table[idx, :] = 0
+        self._pool.free(slot.pages)
+
+    def _retire(self, idx: int, error: Optional[BaseException] = None):
+        """Retire one slot: settle its future exactly once (the loser of
+        the race is a no-op — a retired or evicted sequence can never
+        late-OK), free its pages."""
+        slot = self._slots[idx]
+        if slot is None:
+            return
+        req = slot.req
+        self._release_slot(idx)
+        now = time.monotonic()
+        req.t_exec_done = now
+        delivered = False
+        if error is not None:
+            req._fail(error)
+        else:
+            delivered = req._deliver(
+                [np.asarray(req.generated, np.int32)])
+        with self._lock:
+            self._counters["retired"] += 1
+            if delivered:
+                self._counters["completed"] += 1
+        if delivered and req.latency is not None:
+            self._lat_hist.observe(req.latency)
+        telemetry.count("serve.requests",
+                        outcome="ok" if delivered else "late")
+
+    def _sweep_slots(self):
+        """Pre-step pass: drop sequences that are already settled (a
+        fleet hedge won elsewhere / caller cancelled) or past deadline."""
+        for i in self._active():
+            req = self._slots[i].req
+            if req.done:
+                self._release_slot(i)
+                with self._lock:
+                    self._counters["retired"] += 1
+            elif req.expired():
+                self._retire(i, DeadlineExceeded(
+                    "deadline passed after %d/%d tokens"
+                    % (len(req.generated), req.max_new)))
+
+    def _admit_one(self, req: DecodeRequest) -> bool:
+        """Place ``req`` in a free slot, evicting strictly-cheaper
+        running sequences while slot or page pressure demands it (same
+        victim order as the admission queue: lowest priority, then
+        oldest; the victim's future settles with a typed
+        :class:`Overloaded` NOW, so it can never late-OK).  False ->
+        caller re-queues the arrival."""
+        need = self._pages_for(req)
+
+        def cheapest_victim():
+            cands = [i for i in self._active()
+                     if self._slots[i].req.priority < req.priority]
+            if not cands:
+                return None
+            return min(cands, key=lambda i: (self._slots[i].req.priority,
+                                             self._slots[i].req
+                                             .enqueued_at))
+
+        pages = None
+        while True:
+            free = [i for i, s in enumerate(self._slots) if s is None]
+            if free:
+                pages = self._pool.alloc(need)
+                if pages is not None:
+                    break
+            v = cheapest_victim()
+            if v is None:
+                return False
+            self._retire(v, Overloaded(
+                "evicted mid-generation by a priority-%d arrival "
+                "(decode %s pressure)" % (req.priority,
+                                          "page" if free else "slot")))
+            with self._lock:
+                self._counters["evicted_slots"] += 1
+            telemetry.count("serve.shed", cause="evicted")
+        idx = free[0]
+        slot = _Slot(req, pages)
+        self._slots[idx] = slot
+        self._table[idx, :] = 0
+        self._table[idx, :len(pages)] = pages
+        req.t_dispatched = time.monotonic()
+        with self._lock:
+            self._counters["admitted_slots"] += 1
+        return True
+
+    def _admit_from_queue(self):
+        # the queue head gets an admission attempt EVERY step, even with
+        # all slots occupied — that is the preemption window where a
+        # high-priority arrival may evict a cheaper running sequence
+        while True:
+            req = self._queue.pop_live(timeout=0)
+            if req is None:
+                return
+            if req.done:
+                continue
+            if not self._admit_one(req):
+                self._queue.push_front(req)
+                return
+
+    def _run(self):
+        while not self._stop:
+            try:
+                self._sweep_slots()
+                self._admit_from_queue()
+                active = self._active()
+                if not active:
+                    req = self._queue.pop_live(timeout=0.05)
+                    if req is not None:
+                        self._queue.push_front(req)
+                    continue
+                if not self._breaker.dispatch_ok():
+                    time.sleep(0.02)
+                    continue
+                self._engine_step(active)
+            except Exception:
+                if not self._stop:
+                    raise
+                return
+
+    def _engine_step(self, active: List[int]):
+        c = self._program.config
+        S = c.max_seqs
+        tokens = np.zeros(S, np.int32)
+        positions = np.zeros(S, np.int32)
+        seq_lens = np.zeros(S, np.int32)
+        phys = np.zeros(S, np.int32)      # inactive -> trash page 0
+        off = np.zeros(S, np.int32)
+        for i in active:
+            slot = self._slots[i]
+            req = slot.req
+            tokens[i] = (req.prompt[slot.pos] if slot.pos < req.n_prompt
+                         else req.generated[-1])
+            positions[i] = slot.pos
+            seq_lens[i] = slot.pos + 1
+            phys[i] = slot.pages[slot.pos // c.page_size]
+            off[i] = slot.pos % c.page_size
+        with self._lock:
+            self._batch_seq += 1
+            seq = self._batch_seq
+            prog = self._program
+        armed = (contextlib.nullcontext()
+                 if self._exec_timeout is None else
+                 self._ensure_watchdog().watch(
+                     "%s.step" % self._name, kind="step", step=seq,
+                     timeout=self._exec_timeout))
+        try:
+            with armed, telemetry.memory.oom_guard(
+                    "%s.step" % self._name, step=seq), telemetry.span(
+                    "serve/decode_step", cat="serve", timed=True,
+                    batch=seq, slots=len(active)) as sp:
+                chaos.maybe_exec_error(seq)
+                chaos.maybe_slow_exec(seq)
+                chaos.maybe_replica_crash(seq)
+                chaos.maybe_hedge_lag(seq)
+                next_tok, _logits, kv = prog.step(
+                    self._kv, tokens, positions, seq_lens, phys, off,
+                    self._table)
+                next_np = np.asarray(next_tok)
+        except Exception as e:
+            # the pool was DONATED into a step that died: state is
+            # unknown, so fail every running sequence (typed) and start
+            # from a fresh pool — degraded, never wrong
+            self._breaker.record_failure()
+            with self._lock:
+                self._counters["exec_failures"] += 1
+            telemetry.count("serve.exec_failures")
+            err = ExecFailed("decode step failed: %r" % (e,))
+            for i in list(active):
+                req = self._slots[i].req if self._slots[i] else None
+                if req is not None and req.expired():
+                    self._retire(i, DeadlineExceeded(
+                        "deadline passed while the step was failing"))
+                else:
+                    self._retire(i, err)
+            self._kv = prog.fresh_cache()
+            return
+        self._kv = kv
+        self._breaker.record_success()
+        step_time = sp.duration
+        n_prefill = n_decode = 0
+        for i in active:
+            slot = self._slots[i]
+            if slot is None:
+                continue
+            req = slot.req
+            slot.pos += 1
+            if slot.pos < req.n_prompt:
+                n_prefill += 1
+                continue
+            n_decode += 1
+            tok = int(next_np[i])
+            req.generated.append(tok)
+            done = (len(req.generated) >= req.max_new
+                    or (c.eos_id is not None and tok == c.eos_id)
+                    or slot.pos >= c.max_seq_len)
+            if done:
+                self._retire(i)
+        with self._lock:
+            self._exec_ewma = (step_time if self._exec_ewma == 0.0 else
+                               0.8 * self._exec_ewma + 0.2 * step_time)
+            self._counters["steps"] += 1
+            self._counters["tokens_prefilled"] += n_prefill
+            self._counters["tokens_decoded"] += n_decode
+        self._exec_hist.observe(step_time)
+        self._occ_hist.observe(len(active) / float(S))
+        telemetry.count("decode.tokens", float(n_decode), kind="decode")
+        if n_prefill:
+            telemetry.count("decode.tokens", float(n_prefill),
+                            kind="prefill")
+        telemetry.window_tick()
+        telemetry.memory.note_step(seq)
+
+    # -- swap / stats --------------------------------------------------------
+    def _validate_swap(self, source, canary_inputs=None):
+        new = super()._validate_swap(source, canary_inputs)
+        if not isinstance(new, DecodeProgram):
+            with self._lock:
+                self._counters["swap_failures"] += 1
+            raise SwapFailed("decode engine can only swap to a "
+                             "DecodeProgram, got %r"
+                             % (type(new).__name__,))
+        if not new.config.same_geometry(self._program.config):
+            with self._lock:
+                self._counters["swap_failures"] += 1
+            raise SwapFailed(
+                "decode geometry mismatch: %s != %s (the KV pool and "
+                "running sequences carry over only across same-geometry "
+                "swaps)" % (new.config.describe(),
+                            self._program.config.describe()))
+        new.ensure_compiled()     # the warm half: compile OUTSIDE the flip
+        return new
+
+    @staticmethod
+    def _load_program(source):
+        if isinstance(source, DecodeProgram):
+            return source
+        if hasattr(source, "forward") and hasattr(source, "input_names"):
+            return source
+        return DecodeProgram.load(os.fspath(source))
+
+    def _maybe_preflight(self, prog):
+        """GC307 pre-flight (MXNET_TPU_PREFLIGHT=1): prove statically
+        that the step traces identically across positions and batch
+        membership, report into the standard forensics dir.  Degrades to
+        a log line on failure — preflight must never break serving."""
+        from ..analysis import preflight as _preflight
+        if not _preflight.enabled():
+            return
+        import logging
+        try:
+            rep = decode_retrace_report(prog)
+            _preflight.write_report(rep, "decode")
+            if rep.findings:
+                logging.warning(
+                    "decode preflight: %d finding(s):\n%s",
+                    len(rep.findings),
+                    "\n".join("  [%s] %s" % (f.rule, f.message)
+                              for f in rep.findings))
+        except Exception:
+            logging.exception("decode preflight failed (continuing)")
+
+    def stats(self) -> dict:
+        out = super().stats()
+        c = self._program.config
+        occ = self._occ_hist.summary()
+        with self._lock:
+            counters = dict(self._counters)
+        steps = max(counters.get("steps", 0), 1)
+        out["decode"] = {
+            "slots": c.max_seqs,
+            "active_slots": len(self._active()),
+            "pages_free": self._pool.available,
+            "pages_total": self._pool.num_pages - 1,
+            "occupancy_mean": round(occ["mean"] or 0.0, 4)
+            if occ["count"] else 0.0,
+            "tokens_decoded": counters.get("tokens_decoded", 0),
+            "tokens_prefilled": counters.get("tokens_prefilled", 0),
+            "tokens_per_step": round(
+                counters.get("tokens_decoded", 0) / steps, 3),
+            "compiles": self._program.trace_count,
+            "quantize": c.quantize,
+        }
+        step_s = self._exec_hist.summary()
+        if step_s["count"]:
+            ps = self._exec_hist.percentiles((0.50, 0.99))
+            out["decode"]["token_step_s"] = {
+                "p50": round(ps[0.50], 6), "p99": round(ps[0.99], 6)}
+        return out
+
+    def close(self):
+        super().close()
+        for i in self._active():
+            self._retire(i, ServingError("engine closed mid-generation"))
+
+
+def decode_retrace_report(prog: DecodeProgram):
+    """GC307 over a DecodeProgram: trace the step at two different
+    token positions / batch memberships and hand both traces to
+    :func:`~mxnet_tpu.analysis.graphcheck.check_decode_retrace` — a
+    program that bakes either into the trace recompiles per token."""
+    from ..analysis import graphcheck
+    c = prog.config
+    S = c.max_seqs
+
+    def args_at(pos, n_active):
+        i32 = np.int32
+        active = np.zeros(S, i32)
+        active[:n_active] = 1
+        positions = np.full(S, pos, i32) * active
+        return (prog._params, prog.fresh_cache(), np.zeros(S, i32),
+                positions, positions + active,
+                np.ones(S, i32) * active, positions % c.page_size,
+                np.ones((S, c.pages_per_seq), i32))
+
+    return graphcheck.check_decode_retrace(
+        prog._make_step_fn(count=False), args_at(1, S),
+        args_at(2, max(1, S - 1)),
+        target="DecodeProgram(%s)" % prog.name)

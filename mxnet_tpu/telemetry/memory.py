@@ -80,7 +80,7 @@ __all__ = ["enabled", "tag", "release", "live_buffers", "top_buffers",
            "oom_guard", "write_oom_postmortem", "reset", "TAGS"]
 
 TAGS = ("params", "optimizer", "activations", "batch", "served",
-        "checkpoint", "embedding", "untagged")
+        "checkpoint", "embedding", "kv_cache", "untagged")
 
 _UNSET = object()
 _ENV_GATE = _UNSET          # None -> defer to telemetry arm state

@@ -201,6 +201,159 @@ def run_open(rt, prog, args, collector, stop_at, priorities, tenants=None):
             collector.record_error(e)
 
 
+def _main_decode(args):
+    """--decode: drive the interactive decode engine (mxnet_tpu/serving/
+    decode) with an open-loop stream of MIXED-length generation requests
+    and report what an interactive-serving operator watches: tokens/sec/
+    chip, per-token p50/p99, batch occupancy — plus the continuous-vs-
+    static batching comparison on the SAME job list and step program
+    (static = classic close-the-batch-and-run-to-the-longest; the
+    wasted idle slots are exactly what token-level admission wins back).
+    """
+    import numpy as np
+    import jax
+    from mxnet_tpu.serving.decode import (DecodeConfig, DecodeEngine,
+                                          DecodeProgram,
+                                          init_decode_params)
+
+    cfg = DecodeConfig(args.decode_vocab, args.decode_layers,
+                       args.decode_hidden, args.decode_heads,
+                       args.decode_seq, page_size=args.decode_page,
+                       max_seqs=args.decode_slots,
+                       quantize=args.decode_quant or None)
+    prog = DecodeProgram(init_decode_params(cfg, seed=0), cfg,
+                         name="servebench-decode")
+    prog.ensure_compiled()
+    n_dev = len([d for d in jax.devices()
+                 if d.platform != "cpu"]) or 1
+    rs = np.random.RandomState(0)
+    plens = [int(x) for x in args.decode_prompts.split(",")]
+    nnews = [int(x) for x in args.decode_new.split(",")]
+    jobs = [(rs.randint(0, cfg.vocab_size, plens[i % len(plens)])
+             .astype(np.int32), nnews[i % len(nnews)])
+            for i in range(args.requests)]
+
+    # -- static batching baseline: batches of S close, run to the
+    # longest member, next batch starts only when the previous finishes
+    S = cfg.max_seqs
+    pp = cfg.pages_per_seq
+    table = np.zeros((S, pp), np.int32)
+    for s in range(S):
+        table[s] = 1 + s * pp + np.arange(pp)
+    kv = prog.fresh_cache()
+    static_tokens = 0
+    static_steps = 0
+    static_lat = []
+    t_static0 = time.monotonic()
+    for g0 in range(0, len(jobs), S):
+        group = jobs[g0:g0 + S]
+        total = [len(p) + n for p, n in group]
+        steps = max(total) - 1            # last token needs no write+step
+        gen = [[] for _ in group]
+        for t in range(steps + 1):
+            toks = np.zeros(S, np.int32)
+            for i, (p, _n) in enumerate(group):
+                toks[i] = p[t] if t < len(p) else (
+                    gen[i][-1] if gen[i] else 0)
+            pos = np.full(S, t, np.int32)
+            nxt, _lg, kv = prog.step(
+                kv, toks, pos, pos + 1,
+                table[np.arange(S), t // cfg.page_size],
+                np.full(S, t % cfg.page_size, np.int32), table)
+            nxt = np.asarray(nxt)
+            static_steps += 1
+            for i, (p, n) in enumerate(group):
+                if t >= len(p) - 1 and len(gen[i]) < n:
+                    gen[i].append(int(nxt[i]))
+        now = time.monotonic()
+        for i, (p, n) in enumerate(group):
+            static_tokens += len(gen[i])
+            static_lat.append(now - t_static0)    # group completion
+        kv = prog.fresh_cache()                   # next batch, fresh pool
+    static_wall = time.monotonic() - t_static0
+    static_occ = static_tokens / max(static_steps * S, 1)
+
+    # -- continuous batching: the same jobs through the engine
+    from mxnet_tpu import telemetry
+    eng = DecodeEngine(prog, default_deadline=args.deadline
+                       if args.deadline > 0 else None,
+                       queue_depth=max(64, len(jobs)))
+    lat_hist = telemetry.Histogram("servebench.decode_latency",
+                                   registered=False, always=True)
+    t_cont0 = time.monotonic()
+    reqs = [eng.submit(p, max_new_tokens=n) for p, n in jobs]
+    cont_tokens = 0
+    errors = {}
+    for r in reqs:
+        try:
+            out = r.result(timeout=120.0)
+            cont_tokens += int(out[0].size)
+            lat_hist.observe(r.latency)
+        except Exception as e:
+            errors[type(e).__name__] = errors.get(type(e).__name__, 0) + 1
+    cont_wall = time.monotonic() - t_cont0
+    stats = eng.stats()
+    eng.close()
+
+    d = stats["decode"]
+    report = {
+        "mode": "decode",
+        "requests": len(jobs),
+        "slots": S,
+        "geometry": "L%d H%d heads%d V%d T%d page%d%s" % (
+            cfg.num_layers, cfg.hidden, cfg.heads, cfg.vocab_size,
+            cfg.max_seq_len, cfg.page_size,
+            " %s" % cfg.quantize if cfg.quantize else ""),
+        "continuous": {
+            "wall_s": round(cont_wall, 3),
+            "tokens": cont_tokens,
+            "tokens_per_sec_per_chip": round(
+                cont_tokens / cont_wall / n_dev, 1),
+            "occupancy_mean": d["occupancy_mean"],
+            "latency": _percentiles(lat_hist),
+            "errors": errors,
+        },
+        "static": {
+            "wall_s": round(static_wall, 3),
+            "tokens": static_tokens,
+            "tokens_per_sec_per_chip": round(
+                static_tokens / static_wall / n_dev, 1),
+            "occupancy_mean": round(static_occ, 4),
+            "latency": {"p50_ms": round(
+                1e3 * statistics.median(static_lat), 3),
+                "p99_ms": round(1e3 * sorted(static_lat)[
+                    max(0, int(0.99 * len(static_lat)) - 1)], 3)},
+        },
+        "per_token_step": d.get("token_step_s", {}),
+        "compiles": d["compiles"],
+        "decode_stats": d,
+    }
+    report["continuous_vs_static"] = round(
+        report["continuous"]["tokens_per_sec_per_chip"] /
+        max(report["static"]["tokens_per_sec_per_chip"], 1e-9), 3)
+    if args.json:
+        json.dump(report, sys.stdout, indent=2, sort_keys=True)
+        print()
+        return 0
+    print("servebench --decode: %d mixed-length requests over %d slots "
+          "(%s)" % (len(jobs), S, report["geometry"]))
+    print("  %-12s %10s %14s %10s %10s %10s" %
+          ("batching", "wall s", "tokens/s/chip", "occupancy",
+           "p50 ms", "p99 ms"))
+    for name in ("continuous", "static"):
+        r = report[name]
+        lat = r["latency"]
+        print("  %-12s %10.3f %14.1f %10.3f %10s %10s"
+              % (name, r["wall_s"], r["tokens_per_sec_per_chip"],
+                 r["occupancy_mean"], lat.get("p50_ms", "-"),
+                 lat.get("p99_ms", "-")))
+    print("  continuous / static throughput: %.2fx  (compiles: %d)"
+          % (report["continuous_vs_static"], report["compiles"]))
+    if errors:
+        print("  errors          %s" % errors)
+    return 0
+
+
 def _main_fleet(args):
     """--replicas N: drive a replicated ServingFleet and report the
     fleet-level view (percentiles, per-replica share, shed-by-cause,
@@ -359,7 +512,29 @@ def main(argv=None):
                     help="fleet mode: tenant names cycled per request")
     ap.add_argument("--tenant-rate", type=float, default=None,
                     help="fleet mode: per-tenant token-bucket rate")
+    ap.add_argument("--decode", action="store_true",
+                    help="decode mode: mixed-length generation streams "
+                         "through the continuous-batching engine, with "
+                         "a continuous-vs-static comparison table")
+    ap.add_argument("--requests", type=int, default=24,
+                    help="decode mode: number of generation requests")
+    ap.add_argument("--decode-prompts", default="4,12,24",
+                    help="decode mode: prompt lengths, cycled")
+    ap.add_argument("--decode-new", default="4,16,8",
+                    help="decode mode: max new tokens, cycled")
+    ap.add_argument("--decode-layers", type=int, default=2)
+    ap.add_argument("--decode-hidden", type=int, default=64)
+    ap.add_argument("--decode-heads", type=int, default=4)
+    ap.add_argument("--decode-vocab", type=int, default=256)
+    ap.add_argument("--decode-seq", type=int, default=64)
+    ap.add_argument("--decode-page", type=int, default=8)
+    ap.add_argument("--decode-slots", type=int, default=4)
+    ap.add_argument("--decode-quant", default="",
+                    help="decode mode: int8/int4 weight-only quantized "
+                         "matmuls")
     args = ap.parse_args(argv)
+    if args.decode:
+        return _main_decode(args)
     if args.replicas:
         return _main_fleet(args)
     if args.kill_after is not None or args.tenants or args.tenant_rate:

@@ -145,6 +145,21 @@ def _graphcheck_builtin(report):
     except Exception as e:
         print("tpulint: sparse embedding check skipped: %r" % e,
               file=sys.stderr)
+
+    # interactive decode step: the paged-KV step must trace identically
+    # across token positions and batch membership (GC307 — the
+    # recompile-per-token trap)
+    try:
+        from mxnet_tpu.serving.decode import (DecodeConfig, DecodeProgram,
+                                              decode_retrace_report,
+                                              init_decode_params)
+        dcfg = DecodeConfig(32, 1, 16, 2, 16, page_size=4, max_seqs=2)
+        dprog = DecodeProgram(init_decode_params(dcfg, seed=0), dcfg,
+                              name="tpulint")
+        report.extend(decode_retrace_report(dprog))
+    except Exception as e:
+        print("tpulint: decode retrace check skipped: %r" % e,
+              file=sys.stderr)
     report.extend(graphcheck.check_registry())
 
 
