@@ -27,6 +27,12 @@ GC104     ppermute-bad-perm        error     ppermute perm that is not a
 GC105     axis-groups-asymmetric   error     axis_index_groups that do not
                                              partition the axis into equal
                                              disjoint groups
+GC106     collective-in-async-     error     collective primitive inside a
+          step                               program contracted to be
+                                             collective-free (the dist_async
+                                             PS worker step: nothing in it may
+                                             put a peer on this rank's
+                                             critical path)
 GC201     replicated-large-array   warning   large state fully replicated on a
                                              model-parallel mesh
 GC202     missing-donation         warning   grad/optimizer buffers not donated
@@ -102,7 +108,8 @@ except ImportError:                     # older: the classic namespace
     from jax import core as _core
 
 __all__ = ["CollectiveEvent", "collect_collectives", "check_jaxpr",
-           "check_fn", "check_symbol", "check_registry",
+           "check_fn", "check_collective_free", "check_symbol",
+           "check_registry",
            "check_replication", "check_capacity", "check_overlap",
            "check_embedding_grad", "check_decode_retrace",
            "is_decode_shaped", "check_trainer", "check_executor",
@@ -452,6 +459,30 @@ def check_fn(fn, *example_args, mesh=None, target: str = "",
     closed = jax.make_jaxpr(fn)(*example_args, **example_kwargs)
     return check_jaxpr(closed, mesh=mesh,
                        target=target or getattr(fn, "__name__", "fn"))
+
+
+def check_collective_free(fn_or_jaxpr, *example_args,
+                          target: str = "") -> Report:
+    """GC106 over a program CONTRACTED to contain no collectives — the
+    dist_async PS worker step (kvstore/worker.py): a worker's compute
+    between pull and push must depend only on its own weights and batch,
+    so a collective primitive anywhere in its trace is an error (a
+    straggler peer would re-enter this rank's critical path, which is
+    exactly what the async lane exists to prevent)."""
+    if isinstance(fn_or_jaxpr, _JAXPR_TYPES):
+        closed = fn_or_jaxpr
+    else:
+        closed = jax.make_jaxpr(fn_or_jaxpr)(*example_args)
+        target = target or getattr(fn_or_jaxpr, "__name__", "fn")
+    rep = Report("graphcheck", target)
+    for ev in collect_collectives(closed):
+        rep.add("GC106", "error",
+                "collective `%s` over axes %s in a collective-free "
+                "contract program" % (ev.prim, list(ev.axes)),
+                location=ev.source or ev.path,
+                fix_hint="move the collective out of the async worker "
+                         "step, or run this program on the sync lane")
+    return rep
 
 
 # ---------------------------------------------------------------------------

@@ -10,11 +10,19 @@ TPU-native mapping (SURVEY.md §5.8):
   The NCCL ring / CUDA P2P machinery is replaced by one jitted sum: device
   copies are summed on the lead device (XLA issues the transfers; on a mesh
   this is an ICI all-reduce via parallel.allreduce when arrays are sharded).
-* 'dist_sync' / 'dist_device_sync' / 'dist_async' — multi-host: instead of a
-  ZMQ parameter server, every host enters the same psum over the global mesh
+* 'dist_sync' / 'dist_device_sync' — multi-host: instead of a ZMQ
+  parameter server, every host enters the same psum over the global mesh
   (jax.distributed runtime is the tracker/Postoffice analog).  The PS-style
   API (push/pull/updater, rank, barrier) is preserved exactly, so
   Module/Gluon drive it unchanged.
+* 'dist_async' — TWO lanes.  With ``MXNET_TPU_KV_DIR`` armed, a real
+  parameter server (this package's server.py/client.py: plain worker
+  processes over the serving wire framing, bounded staleness via
+  ``MXNET_TPU_STALENESS_BOUND``, no jax gang — the ps-lite
+  kvstore_dist_server reproduction, see docs/robustness.md "The async
+  lane").  Otherwise the collectives-backed local-update + periodic
+  averaging store below (an in-mesh gang with bounded weight
+  divergence).
 * Gradient compression keeps its API; over ICI it's a no-op win, so set_
   gradient_compression records config and (2bit) applies error-feedback
   quantisation before the reduce to preserve semantics for tests.
@@ -29,10 +37,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .base import MXNetError
-from .ndarray.ndarray import NDArray, array as nd_array, zeros as nd_zeros
-from .ndarray.sparse import RowSparseNDArray
-from .ops.pallas_kernels import two_bit_compress
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, array as nd_array, zeros as nd_zeros
+from ..ndarray.sparse import RowSparseNDArray
+from ..ops.pallas_kernels import two_bit_compress
 
 __all__ = ["KVStore", "create"]
 
@@ -93,7 +101,7 @@ class KVStore:
     def push(self, key, value, priority=0):
         """Reduce value(s) into the store; run updater if set (reference
         KVStoreLocal::PushImpl kvstore_local.h:159)."""
-        from . import profiler
+        from .. import profiler
         with profiler.Scope("kvstore_push", cat="kvstore"):
             self._push(key, value, priority)
 
@@ -134,7 +142,7 @@ class KVStore:
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         """Broadcast the stored value to each out array, keeping each on its
         own device (the Comm::Broadcast analog, comm.h)."""
-        from . import profiler
+        from .. import profiler
         with profiler.Scope("kvstore_pull", cat="kvstore"):
             self._pull(key, out, priority, ignore_sparse)
 
@@ -200,7 +208,7 @@ class KVStore:
     def set_optimizer(self, optimizer):
         """On dist stores the reference pickles the optimizer to servers
         (kvstore.py:435-476); here the 'server' is this process."""
-        from .optimizer import Updater
+        from ..optimizer import Updater
         self._optimizer = optimizer
         self._updater = Updater(optimizer)
 
@@ -263,7 +271,7 @@ class KVStore:
         elif isinstance(vlist[0], RowSparseNDArray):
             # sparse reduce stays sparse: union of row ids, duplicates
             # summed (reference Comm row_sparse reduce) — never densified
-            from .ndarray.sparse import merge_row_sparse
+            from ..ndarray.sparse import merge_row_sparse
             return merge_row_sparse(vlist)
         else:
             lead = vlist[0]._handle
@@ -311,7 +319,7 @@ class KVStoreTPUDist(KVStore):
 
     def __init__(self, kv_type="dist_sync"):
         super().__init__(kv_type)
-        from .parallel import topology
+        from ..parallel import topology
         self._topo = topology()
 
     @property
@@ -323,7 +331,7 @@ class KVStoreTPUDist(KVStore):
         return self._topo.process_count
 
     def barrier(self):
-        from .parallel import barrier as _barrier
+        from ..parallel import barrier as _barrier
         _barrier()
 
     def num_dead_node(self, node_id=0, timeout_sec=60):
@@ -343,7 +351,7 @@ class KVStoreTPUDist(KVStore):
            semantics this API had in the reference."""
         if self.num_workers <= 1:
             return 0
-        from .resilience import watchdog as _wd
+        from ..resilience import watchdog as _wd
         try:
             from jax._src import distributed
             client = getattr(distributed.global_state, "client", None)
@@ -365,17 +373,17 @@ class KVStoreTPUDist(KVStore):
         return coordinator_dead + _wd.lane().num_dead(timeout_sec)
 
     def _reduce(self, k, vlist):
-        from .parallel.audit import record_collective
-        from .resilience import watchdog as _wd
+        from ..parallel.audit import record_collective
+        from ..resilience import watchdog as _wd
         merged = super()._reduce(k, vlist)
         if self.num_workers > 1:
             with _wd.watch("KVStoreTPUDist._reduce(%s)" % k,
                            kind="collective"):
                 if isinstance(merged, RowSparseNDArray):
-                    from .parallel import allreduce_row_sparse
+                    from ..parallel import allreduce_row_sparse
                     merged = allreduce_row_sparse(merged)
                 else:
-                    from .parallel import allreduce_array
+                    from ..parallel import allreduce_array
                     merged._handle = allreduce_array(merged._handle)
             record_collective("all-reduce", "KVStoreTPUDist._reduce(%s)" % k,
                               bytes=int(getattr(
@@ -430,12 +438,12 @@ class KVStoreTPUDistAsync(KVStoreTPUDist):
                 self._average_key(k)
 
     def _average_key(self, k):
-        from .parallel import allreduce_array
+        from ..parallel import allreduce_array
         stored = self._store[k]
         if isinstance(stored, RowSparseNDArray):
             # union-sum, then divide each row by HOW MANY ranks hold it
             # (a row on k<N ranks averaged over N would shrink by k/N)
-            from .parallel import allreduce_row_sparse
+            from ..parallel import allreduce_row_sparse
             avg = allreduce_row_sparse(stored)
             ones = jnp.zeros((stored.shape[0],), jnp.float32)
             ones = ones.at[jnp.asarray(stored._indices)].set(1.0)
@@ -474,6 +482,16 @@ def create(name="local") -> KVStore:
                 "local_allreduce_device", "device", "nccl", "tpu"):
         return KVStore(name)
     if name == "dist_async":
+        # two async lanes: with MXNET_TPU_KV_DIR armed, a REAL parameter
+        # server (kvstore/server.py + kvstore/client.py — plain worker
+        # processes, no jax gang, bounded staleness); without it, the
+        # collectives-backed local-update + periodic-averaging store
+        # (jax.distributed gang, the pre-PS behaviour, kept for in-mesh
+        # dist_async users)
+        from .protocol import kv_dir
+        if kv_dir():
+            from .client import KVStorePS
+            return _create_dist(KVStorePS, name)
         return _create_dist(KVStoreTPUDistAsync, name)
     if name.startswith("dist"):
         return _create_dist(KVStoreTPUDist, name)
@@ -481,8 +499,8 @@ def create(name="local") -> KVStore:
 
 
 def _create_dist(cls, name):
-    from .resilience import chaos
-    from .resilience.retry import call_with_retry
+    from ..resilience import chaos
+    from ..resilience.retry import call_with_retry
 
     def make():
         chaos.maybe_io_error("kvstore %s creation" % name)

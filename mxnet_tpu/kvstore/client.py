@@ -1,0 +1,388 @@
+"""Worker side of the dist_async parameter-server lane.
+
+:class:`PSClient` is the transport: one socket to the server, wire.py
+framing, and retry/backoff + re-resolve-and-reconnect around every
+request — a server SIGKILL mid-request surfaces here as a
+``ConnectionError``, the client re-reads the published endpoint (the
+supervisor's relaunch binds a fresh port) and re-sends.  Push retries
+are safe because the server dedups on (worker, version); pulls are
+idempotent by nature.
+
+:class:`KVStorePS` is the ``KVStore`` subclass ``create("dist_async")``
+returns when ``MXNET_TPU_KV_DIR`` is armed: the reference's
+``kvstore_dist.h`` worker — push sends the locally-reduced gradient,
+pull fetches the server's current weights, ``row_sparse_pull`` is a true
+``PullRowSparse`` (only the deduplicated touched rows cross the wire),
+and there is NO global barrier anywhere in the step path.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from . import protocol
+from .. import KVStore  # re-exported by the package __init__
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from ..ndarray.sparse import RowSparseNDArray
+from ..serving.wire import recv_msg, send_msg
+
+__all__ = ["PSClient", "KVStorePS", "worker_rank", "worker_world"]
+
+
+def worker_rank() -> int:
+    for var in ("MXNET_TPU_KV_RANK", "DMLC_WORKER_ID"):
+        v = os.environ.get(var, "").strip()
+        if v.lstrip("-").isdigit():
+            return int(v)
+    return 0
+
+
+def worker_world() -> int:
+    for var in ("MXNET_TPU_KV_WORLD", "DMLC_NUM_WORKER"):
+        v = os.environ.get(var, "").strip()
+        if v.isdigit():
+            return int(v)
+    return 1
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ[name])
+    except (KeyError, ValueError):
+        return float(default)
+
+
+class PSClient:
+    """One worker's link to the KV server.  Thread-compatible (a lock
+    serialises requests); a blocked pull (SSP gate) therefore blocks
+    only this worker — exactly the semantics the async lane wants."""
+
+    def __init__(self, kv_dir: str, rank: Optional[int] = None,
+                 connect_timeout: Optional[float] = None):
+        self.dir = os.fspath(kv_dir)
+        self.rank = worker_rank() if rank is None else int(rank)
+        self._timeout = connect_timeout if connect_timeout is not None \
+            else _env_float("MXNET_TPU_KV_CONNECT_TIMEOUT", 30.0)
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+        self.server_epoch: Optional[int] = None
+        self.staleness_bound: Optional[int] = None
+        # per-key last push version the SERVER acknowledged for this
+        # worker — refreshed from the register reply so a restarted
+        # worker resumes its version sequence instead of colliding with
+        # the dedup table
+        self.applied: Dict[str, int] = {}
+        # payload-byte ledger per op, both directions — the audit that
+        # proves PullRowSparse moves O(touched rows), not O(table)
+        self.op_bytes: Dict[str, int] = {}
+
+    # -- transport ---------------------------------------------------------
+
+    def _connect_once(self):
+        host, port, epoch = protocol.resolve_endpoint(self.dir,
+                                                      self._timeout)
+        sock = socket.create_connection((host, port), timeout=None)
+        try:
+            send_msg(sock, {"op": "register", "worker": self.rank})
+            reply, _ = recv_msg(sock)
+        except BaseException:
+            sock.close()
+            raise
+        if not reply.get("ok"):
+            sock.close()
+            raise ConnectionError("kvstore register rejected: %s"
+                                  % reply.get("error"))
+        self._sock = sock
+        self.server_epoch = int(reply.get("epoch", epoch))
+        self.staleness_bound = reply.get("staleness_bound")
+        self.applied.update({str(k): int(v) for k, v in
+                             (reply.get("applied") or {}).items()})
+
+    def _close(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def call(self, header: dict, arrays: Optional[dict] = None,
+             op_tag: Optional[str] = None):
+        """One request/reply with retry/backoff; reconnects (and
+        re-resolves the endpoint — the relaunched server's port differs)
+        on any transport or framing error.  In-band request errors (a
+        reply with ``ok=False``) raise :class:`MXNetError` and are NOT
+        retried — those are semantic, not transient."""
+        from ..resilience import chaos
+        from ..resilience.retry import call_with_retry
+        arrays = arrays or {}
+        op = op_tag or str(header.get("op"))
+
+        def roundtrip():
+            chaos.maybe_io_error("kvstore %s" % op)
+            with self._lock:
+                if self._sock is None:
+                    self._connect_once()
+                try:
+                    send_msg(self._sock, header, arrays)
+                    reply, out = recv_msg(self._sock)
+                except (ConnectionError, OSError):
+                    self._close()
+                    raise
+            return reply, out
+
+        reply, out = call_with_retry(
+            roundtrip, exceptions=(ConnectionError, OSError),
+            max_tries=int(os.environ.get("MXNET_TPU_KV_RETRY_MAX", "10")),
+            backoff=_env_float("MXNET_TPU_KV_RETRY_BACKOFF", 0.1),
+            timeout=_env_float("MXNET_TPU_KV_RETRY_TIMEOUT", 60.0),
+            desc="kvstore %s" % op)
+        if not reply.get("ok"):
+            raise MXNetError("kvstore %s failed: %s"
+                             % (op, reply.get("error")))
+        payload = sum(int(a.nbytes) for a in arrays.values()) + \
+            sum(int(a.nbytes) for a in out.values())
+        self.op_bytes[op] = self.op_bytes.get(op, 0) + payload
+        return reply, out
+
+    def close(self):
+        with self._lock:
+            self._close()
+
+    def ensure_registered(self):
+        """Idempotent connect+register (with retry/backoff): guarantees
+        ``applied`` reflects the server's dedup table BEFORE a push
+        version is assigned — a restarted worker must resume its version
+        sequence, not restart it from 1 and have every push deduped away
+        (the no-silent-loss half of exactly-once)."""
+        if self._sock is None:
+            self.call({"op": "ping"})
+
+    # -- ops ---------------------------------------------------------------
+
+    def init(self, key, value: np.ndarray):
+        return self.call({"op": "init", "key": str(key),
+                          "worker": self.rank},
+                         {"value": np.asarray(value)})[0]
+
+    def push(self, key, grad: np.ndarray) -> dict:
+        key = str(key)
+        self.ensure_registered()
+        version = protocol.next_version(self.applied.get(key, 0))
+        reply, _ = self.call({"op": "push", "key": key,
+                              "worker": self.rank, "version": version},
+                             {"grad": np.asarray(grad)})
+        self.applied[key] = version
+        return reply
+
+    def push_sparse(self, key, data: np.ndarray,
+                    indices: np.ndarray) -> dict:
+        """Row-sparse push: duplicate row ids are summed CLIENT-side (the
+        sparse plane's dedup discipline) so only unique touched rows
+        cross the wire and the server's lazy update sees each row once."""
+        key = str(key)
+        self.ensure_registered()
+        ids = np.asarray(indices, np.int64)
+        data = np.asarray(data)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        if uniq.size != ids.size:
+            merged = np.zeros((uniq.size,) + data.shape[1:], data.dtype)
+            np.add.at(merged, inv, data)
+            data, ids = merged, uniq
+        else:
+            order = np.argsort(ids, kind="stable")
+            data, ids = data[order], ids[order]
+        version = protocol.next_version(self.applied.get(key, 0))
+        reply, _ = self.call({"op": "push", "key": key,
+                              "worker": self.rank, "version": version,
+                              "sparse": True},
+                             {"data": data, "indices": ids})
+        self.applied[key] = version
+        return reply
+
+    def pull(self, key):
+        reply, out = self.call({"op": "pull", "key": str(key),
+                                "worker": self.rank})
+        return out["value"], reply
+
+    def pull_rows(self, key, row_ids: np.ndarray):
+        """PullRowSparse: request unique ids, receive only those rows."""
+        ids = np.unique(np.asarray(row_ids, np.int64))
+        reply, out = self.call({"op": "pull_rows", "key": str(key),
+                                "worker": self.rank},
+                               {"ids": ids}, op_tag="pull_rows")
+        return out["data"], out["indices"], reply
+
+    def set_optimizer(self, name: str, params: dict):
+        return self.call({"op": "set_optimizer", "name": name,
+                          "params": params})[0]
+
+    def barrier(self, seq: int):
+        return self.call({"op": "barrier", "worker": self.rank,
+                          "seq": int(seq)})[0]
+
+    def stats(self) -> dict:
+        return self.call({"op": "stats"})[0]
+
+    def server_checkpoint(self) -> str:
+        return self.call({"op": "checkpoint"})[0]["path"]
+
+    def shutdown(self):
+        try:
+            return self.call({"op": "shutdown"})[0]
+        finally:
+            self.close()
+
+
+def _optimizer_config(optimizer) -> dict:
+    """JSON config for the server-side rebuild — the pickle-free stand-in
+    for the reference's optimizer serialisation (kvstore.py:435).  Only
+    scalar hyper-parameters travel; callables (lr schedulers, custom
+    updaters) cannot cross this wire by design."""
+    params = {"learning_rate": optimizer.lr, "wd": optimizer.wd,
+              "rescale_grad": optimizer.rescale_grad,
+              "clip_gradient": optimizer.clip_gradient}
+    skip = {"lr", "wd", "rescale_grad", "clip_gradient", "num_update",
+            "begin_num_update", "multi_precision"}
+    for k, v in vars(optimizer).items():
+        if k.startswith("_") or k in skip:
+            continue
+        if isinstance(v, (bool, int, float, str)):
+            params[k] = v
+    params = {k: v for k, v in params.items() if v is not None}
+    return {"name": type(optimizer).__name__.lower(), "params": params}
+
+
+class KVStorePS(KVStore):
+    """``dist_async`` over a real parameter server (armed by
+    ``MXNET_TPU_KV_DIR``).  Workers are plain processes — rank/world come
+    from ``MXNET_TPU_KV_RANK``/``DMLC_WORKER_ID`` env, NOT from a jax
+    gang — and every cross-worker byte goes through the server."""
+
+    def __init__(self, kv_type="dist_async", kv_dir=None, rank=None):
+        super().__init__(kv_type)
+        d = kv_dir or protocol.kv_dir()
+        if not d:
+            raise MXNetError("KVStorePS needs MXNET_TPU_KV_DIR")
+        self.client = PSClient(d, rank=rank)
+        self._world = worker_world()
+        self._barrier_seq = 0
+
+    @property
+    def rank(self):
+        return self.client.rank
+
+    @property
+    def num_workers(self):
+        return self._world
+
+    def barrier(self):
+        self._barrier_seq += 1
+        self.client.barrier(self._barrier_seq)
+
+    def num_dead_node(self, node_id=0, timeout_sec=60):
+        try:
+            alive = len(self.client.stats().get("alive", []))
+            return max(0, self._world - alive)
+        except (MXNetError, OSError):
+            return self._world     # server unreachable: everyone is dark
+
+    # -- kv ops ------------------------------------------------------------
+
+    def init(self, key, value):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            if isinstance(v, RowSparseNDArray):
+                v = NDArray(v.todense()._handle) \
+                    if hasattr(v, "todense") else v
+            self.client.init(k, v.asnumpy())
+
+    def _push(self, key, value, priority=0):
+        keys, values = self._normalize_push(key, value)
+        for k, vlist in zip(keys, values):
+            # local device-copy reduce (and 2bit compression when armed)
+            # happens here; only ONE merged gradient crosses the wire
+            merged = KVStore._reduce(self, k, vlist)
+            if isinstance(merged, RowSparseNDArray):
+                self.client.push_sparse(k, np.asarray(merged._data),
+                                        np.asarray(merged._indices))
+            else:
+                self.client.push(k, merged.asnumpy())
+
+    def _pull(self, key, out=None, priority=0, ignore_sparse=True):
+        import jax
+        keys, outs = self._normalize_push(key, out)
+        for k, olist in zip(keys, outs):
+            value, _ = self.client.pull(k)
+            handle = None
+            for o in olist:
+                if handle is None:
+                    import jax.numpy as jnp
+                    handle = jnp.asarray(value)
+                dev = list(o._handle.devices())[0] \
+                    if o._handle is not None else None
+                if dev is not None and dev not in handle.devices():
+                    o._handle = jax.device_put(handle, dev)
+                else:
+                    o._handle = handle
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """True PullRowSparse against the server: ids are deduplicated
+        client-side, only the touched rows come back."""
+        import jax.numpy as jnp
+        assert out is not None and row_ids is not None
+        keys, outs = self._normalize_push(key, out)
+        rids = row_ids if isinstance(row_ids, list) else [row_ids]
+        flat = [(k, o) for k, olist in zip(keys, outs) for o in olist]
+        if len(rids) == 1:
+            pair_rids = rids * len(flat)
+        elif len(rids) == len(flat):
+            pair_rids = rids
+        elif len(rids) == len(keys):
+            pair_rids = [rids[i] for i, (k, olist) in
+                         enumerate(zip(keys, outs)) for _ in olist]
+        else:
+            raise MXNetError("row_sparse_pull: %d row_ids for %d outs"
+                             % (len(rids), len(flat)))
+        for (k, o), rid in zip(flat, pair_rids):
+            ids = rid.asnumpy().astype(np.int64) \
+                if isinstance(rid, NDArray) else np.asarray(rid, np.int64)
+            data, indices, reply = self.client.pull_rows(k, ids)
+            shape = tuple(reply["shape"])
+            if isinstance(o, RowSparseNDArray):
+                o._data = jnp.asarray(data)
+                o._indices = jnp.asarray(indices)
+                o._shape = shape
+                o._dense_cache = None
+            else:
+                idx = jnp.asarray(indices, jnp.int32)
+                o._handle = jnp.zeros(shape, data.dtype).at[idx].set(
+                    jnp.asarray(data))
+
+    # -- optimizer ---------------------------------------------------------
+
+    def set_optimizer(self, optimizer):
+        """Updates run ON THE SERVER (update_on_kvstore contract): only
+        the JSON hyper-parameter config travels."""
+        cfg = _optimizer_config(optimizer)
+        self.client.set_optimizer(cfg["name"], cfg["params"])
+        # no local updater: _push must send RAW grads, not updates
+        self._optimizer = optimizer
+        self._updater = None
+
+    def set_updater(self, updater):
+        raise MXNetError(
+            "dist_async (PS lane) cannot ship a callable updater to the "
+            "server — use set_optimizer (JSON config crosses the wire)")
+
+    def sync_weights(self):
+        """No-op: the server's table IS the shared state."""
+
+    def close(self):
+        self.client.close()

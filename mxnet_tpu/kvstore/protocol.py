@@ -1,0 +1,156 @@
+"""Shared protocol pieces of the parameter-server ("async") KVStore lane.
+
+The dist_async lane is three kinds of plain OS processes — a KV server
+(`python -m mxnet_tpu.kvstore.server`, supervised by the serving plane's
+:class:`~mxnet_tpu.serving.fleet.ReplicaSupervisor`) and N workers —
+that deliberately form NO jax gang: a worker that dies, hangs, or lags
+costs only its own contribution, never a collective.  Everything they
+share rides two substrates this module wraps:
+
+* **discovery** — the server publishes its ``host:port`` under one key in
+  a :class:`~mxnet_tpu.resilience.watchdog.FileKVClient` directory
+  (``MXNET_TPU_KV_DIR``); workers resolve it with retry, and re-resolve
+  after any connection error because a relaunched server binds a fresh
+  ephemeral port.  The publication carries a monotonically increasing
+  ``epoch`` so drills can assert "the supervisor relaunched the server".
+* **the event log** — one append-only JSONL file
+  (``kvstore-events.jsonl``) that every lane process writes via a single
+  O_APPEND write per event (atomic for these line sizes on POSIX), so
+  ``tools/postmortem.py --kvstore`` can render the merged server/worker
+  timeline: push/pull/staleness-wait/evict/relaunch.
+
+Version arithmetic: per-(worker, key) push versions and the derived
+staleness clocks are unsigned counters modulo ``2**32`` (ps-lite's
+timestamp width).  :func:`clock_lag` is the ONLY comparison anyone does
+on them — signed distance on the wrapped circle — so a counter crossing
+the wrap boundary never reads as "4 billion versions stale".
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional, Tuple
+
+__all__ = ["CLOCK_WRAP", "clock_lag", "next_version", "kv_dir",
+           "SERVER_KEY", "EVENTS_FILE", "publish_endpoint",
+           "resolve_endpoint", "log_event", "read_events", "events_path"]
+
+SERVER_KEY = "mxt_kv/server"
+EVENTS_FILE = "kvstore-events.jsonl"
+
+# ps-lite timestamps are int32/uint32-ish; staleness accounting must
+# survive the wrap (satellite: version-wraparound edge case)
+CLOCK_WRAP = 1 << 32
+
+
+def clock_lag(ahead: int, behind: int) -> int:
+    """Signed distance ``ahead - behind`` on the mod-2**32 version circle
+    (positive: ``ahead`` is newer).  The only legal way to compare two
+    push versions/clocks — a plain ``-`` breaks at the wrap boundary."""
+    d = (int(ahead) - int(behind)) % CLOCK_WRAP
+    if d >= CLOCK_WRAP // 2:
+        d -= CLOCK_WRAP
+    return d
+
+
+def next_version(v: int) -> int:
+    return (int(v) + 1) % CLOCK_WRAP
+
+
+def kv_dir() -> Optional[str]:
+    """The lane's coordination directory (``MXNET_TPU_KV_DIR``), or None
+    when the PS lane is not armed."""
+    d = os.environ.get("MXNET_TPU_KV_DIR", "").strip()
+    return d or None
+
+
+# ---------------------------------------------------------------------------
+# server discovery over the FileKVClient substrate
+# ---------------------------------------------------------------------------
+
+def _client(directory: str):
+    from ..resilience.watchdog import FileKVClient
+    return FileKVClient(directory)
+
+
+def publish_endpoint(directory: str, host: str, port: int) -> int:
+    """Advertise the server endpoint; returns the new epoch (previous
+    epoch + 1, so every (re)launch is countable by drills)."""
+    kv = _client(directory)
+    epoch = 0
+    try:
+        epoch = int(json.loads(kv.key_value_get(SERVER_KEY))["epoch"])
+    except (KeyError, ValueError, TypeError):
+        pass
+    epoch += 1
+    kv.key_value_set(SERVER_KEY, json.dumps(
+        {"host": host, "port": int(port), "pid": os.getpid(),
+         "epoch": epoch, "time": time.time()}))
+    return epoch
+
+
+def resolve_endpoint(directory: str,
+                     timeout: float = 30.0) -> Tuple[str, int, int]:
+    """Resolve ``(host, port, epoch)``, polling until the server has
+    published (it may still be relaunching after a SIGKILL).  Raises
+    ``ConnectionError`` after ``timeout`` so the caller's retry/backoff
+    machinery owns the give-up policy."""
+    kv = _client(directory)
+    deadline = time.monotonic() + float(timeout)
+    while True:
+        try:
+            info = json.loads(kv.key_value_get(SERVER_KEY))
+            return str(info["host"]), int(info["port"]), int(info["epoch"])
+        except (KeyError, ValueError, TypeError):
+            if time.monotonic() >= deadline:
+                raise ConnectionError(
+                    "no kvstore server published under %s within %.0fs"
+                    % (directory, timeout))
+            time.sleep(0.05)
+
+
+# ---------------------------------------------------------------------------
+# merged event log (server + workers), postmortem --kvstore's input
+# ---------------------------------------------------------------------------
+
+def events_path(directory: str) -> str:
+    return os.path.join(os.fspath(directory), EVENTS_FILE)
+
+
+def log_event(directory: Optional[str], event: str, **fields):
+    """Append one event line; one O_APPEND write, never raises (the lane
+    must not die because forensics hiccuped)."""
+    if not directory:
+        return
+    rec = {"time": time.time(), "event": event, "pid": os.getpid()}
+    rec.update(fields)
+    try:
+        line = json.dumps(rec, default=repr) + "\n"
+        fd = os.open(events_path(directory),
+                     os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        try:
+            os.write(fd, line.encode("utf-8"))
+        finally:
+            os.close(fd)
+    except OSError:
+        pass
+
+
+def read_events(target: str):
+    """Parse events from a kv dir or a direct path to the JSONL file;
+    skips torn/corrupt lines (a SIGKILL can land mid-append)."""
+    path = target
+    if os.path.isdir(target):
+        path = events_path(target)
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue
+    except OSError:
+        pass
+    return out

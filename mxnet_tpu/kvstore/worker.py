@@ -1,0 +1,70 @@
+"""The async worker's compute step — the program a PS worker runs
+between pull and push.
+
+The defining static property of the async lane is that this program
+contains NO collectives and no barrier: a worker's step depends only on
+its own pulled weights and its own batch, so a straggler (or a corpse)
+cannot appear in anyone else's critical path.  ``tpulint --graphcheck``
+traces :func:`make_worker_step` and holds it to exactly that — any
+collective in the async step graph is a lint failure, the same way the
+hierarchical all-reduce program is held to its two-tier shape.
+
+The toy model (:func:`toy_init` / :func:`toy_batch`) is the shared
+fixture of the 4-worker drills in tests/test_ps_drills.py: a convex
+least-squares fit whose loss floor is known, so "converges within a
+bounded gap of sync" is a checkable number, not a vibe.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import numpy as np
+
+__all__ = ["make_worker_step", "toy_init", "toy_batch", "TOY_DIM"]
+
+TOY_DIM = 8
+_TOY_TRUTH_SEED = 7
+
+
+def toy_init(dim: int = TOY_DIM) -> np.ndarray:
+    """Deterministic initial weights (all workers must init the server
+    with the same value — init is first-writer-wins)."""
+    return np.zeros((dim,), np.float32)
+
+
+def _truth(dim: int) -> np.ndarray:
+    rng = np.random.RandomState(_TOY_TRUTH_SEED)
+    return rng.uniform(-1.0, 1.0, size=(dim,)).astype(np.float32)
+
+
+def toy_batch(rank: int, step: int, batch_size: int = 16,
+              dim: int = TOY_DIM) -> Tuple[np.ndarray, np.ndarray]:
+    """One worker's (x, y) batch: noisy linear observations of a fixed
+    ground truth.  Seeded by (rank, step) so every run is replayable and
+    every worker sees DIFFERENT data — the async gradients genuinely
+    disagree, which is what staleness must survive."""
+    rng = np.random.RandomState((rank * 100003 + step) % (1 << 31))
+    x = rng.normal(size=(batch_size, dim)).astype(np.float32)
+    noise = rng.normal(scale=0.01, size=(batch_size,)).astype(np.float32)
+    y = x @ _truth(dim) + noise
+    return x, y
+
+
+def make_worker_step(dim: int = TOY_DIM):
+    """jitted ``step(w, x, y) -> (loss, grad)`` for the least-squares
+    toy: value_and_grad of ``0.5 * mean((x@w - y)^2)``.  Pure local
+    compute — the graphcheck contract is that this graph stays
+    collective-free."""
+    import jax
+    import jax.numpy as jnp
+
+    def loss_fn(w, x, y):
+        err = x @ w - y
+        return 0.5 * jnp.mean(err * err)
+
+    @partial(jax.jit)
+    def step(w, x, y):
+        return jax.value_and_grad(loss_fn)(w, x, y)
+
+    return step

@@ -19,6 +19,9 @@ Modules:
 * audit.py — collective accounting: per-kind AND per-axis payload bytes
   from compiled HLO, with fused all-reduce+slice classified as the
   reduce-scatter it is on the wire
+* hierarchy.py — the two-tier (in-island fast / cross-island slow)
+  hierarchical all-reduce for multi-pod shapes, audited per tier against
+  ``audit.hierarchical_allreduce_model_bytes``
 """
 from __future__ import annotations
 

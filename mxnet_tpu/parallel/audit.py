@@ -373,6 +373,41 @@ def zero_update_model_bytes(shardable_bytes, residual_bytes, dp):
             "all-reduce": residual_bytes}
 
 
+def hierarchical_allreduce_model_bytes(payload_bytes, islands, per_island,
+                                       elem_bytes=4):
+    """Analytic per-device collective payloads of the two-tier
+    hierarchical all-reduce (parallel/hierarchy.py) of a ``payload``-byte
+    tensor on an ``islands`` x ``per_island`` mesh, in the payload
+    conventions of :func:`collective_accounting`:
+
+    * ``reduce-scatter`` — in-island (fast tier), payload = the 1/k
+      output shard;
+    * ``all-reduce`` — cross-island (slow tier), on that 1/k shard;
+    * ``all-gather`` — in-island (fast tier), payload = the gathered
+      full tensor.
+
+    Plus the two wire numbers the "≪ flat ring" claim is audited with:
+    ``slow_wire`` — per-designated-rank bytes crossing the slow tier
+    (ring all-reduce of the shard over the m islands) — and
+    ``flat_wire`` — what a flat ring over all m*k devices would push
+    through its slow-tier crossing links (2(N-1)/N * payload, since a
+    flat ring's full per-link traffic rides every link, slow ones
+    included)."""
+    m = max(1, islands)
+    k = max(1, per_island)
+    # the scatter pads in ELEMENTS to a multiple of k, so the shard is
+    # ceil(elems/k) elements, not ceil(bytes/k) bytes
+    elems = -(-payload_bytes // elem_bytes)
+    shard = -(-elems // k) * elem_bytes
+    return {
+        "reduce-scatter": shard,
+        "all-reduce": shard,
+        "all-gather": shard * k,
+        "slow_wire": ring_allreduce_wire_bytes(shard, m),
+        "flat_wire": ring_allreduce_wire_bytes(payload_bytes, m * k),
+    }
+
+
 def grad_payload_bytes(params, grad_dtype_bytes=4):
     """Analytic dp all-reduce payload: every gradient, in f32."""
     total = 0
@@ -385,7 +420,7 @@ def grad_payload_bytes(params, grad_dtype_bytes=4):
 
 
 def audit_report(tag, hlo_text, n_devices, params=None, ring_n=None,
-                 mesh=None, zero_model=None):
+                 mesh=None, zero_model=None, hier_model=None):
     """Format (and return) one accounting line comparing HLO collective
     payloads with the analytic ring model.
 
@@ -398,6 +433,9 @@ def audit_report(tag, hlo_text, n_devices, params=None, ring_n=None,
     attributed from replica groups).  ``zero_model`` — the dict from
     :func:`zero_update_model_bytes` — swaps the plain grad-payload
     comparison for the ZeRO reduce-scatter + all-gather model.
+    ``hier_model`` — from :func:`hierarchical_allreduce_model_bytes` —
+    appends the two-tier comparison: per-kind measured/model payloads
+    plus the slow-tier wire bytes against the flat-ring baseline.
     """
     ring_n = ring_n or n_devices
     acct = collective_accounting(hlo_text, mesh=mesh)
@@ -431,6 +469,21 @@ def audit_report(tag, hlo_text, n_devices, params=None, ring_n=None,
                     zero_model.get("all-gather", 0) / 1e6,
                     zero_model.get("all-reduce", 0) / 1e6,
                     measured / model if model else float("nan")))
+    if hier_model is not None:
+        kinds = ("reduce-scatter", "all-reduce", "all-gather")
+        model = sum(hier_model.get(kd, 0) for kd in kinds)
+        measured = sum(acct.get(kd, {}).get("bytes", 0) for kd in kinds)
+        slow, flat = hier_model.get("slow_wire", 0), \
+            hier_model.get("flat_wire", 0)
+        text += (" | analytic 2-tier payload RS %.2f + slowAR %.2f + AG "
+                 "%.2f MB (measured/model = %.2f); slow-tier wire %.2f MB"
+                 "/rank vs %.2f MB flat ring (%.1fx less)"
+                 % (hier_model.get("reduce-scatter", 0) / 1e6,
+                    hier_model.get("all-reduce", 0) / 1e6,
+                    hier_model.get("all-gather", 0) / 1e6,
+                    measured / model if model else float("nan"),
+                    slow / 1e6, flat / 1e6,
+                    flat / slow if slow else float("nan")))
     elif params is not None:
         model = grad_payload_bytes(params)
         measured = acct.get("all-reduce", {}).get("bytes", 0)

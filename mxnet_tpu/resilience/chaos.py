@@ -64,6 +64,13 @@ or the ``MXNET_TPU_CHAOS`` env var (whole-run drills), a comma list of
 ``@step`` fires when the consumer's step counter hits that value;
 ``xcount`` fires on the next ``count`` opportunities (default 1).
 
+``MXNET_TPU_CHAOS_RANKS`` (comma list of worker ranks) pins armed
+faults to specific workers: multi-process drills export the same
+``MXNET_TPU_CHAOS`` everywhere and the fault still fires on exactly one
+deterministic rank (resolved from ``MXNET_TPU_CHAOS_RANK`` /
+``MXNET_TPU_KV_RANK`` / ``DMLC_WORKER_ID`` env, falling back to an
+already-initialised jax.distributed process index).
+
 The hot-path cost when no fault is armed is one falsy check.
 """
 from __future__ import annotations
@@ -98,6 +105,49 @@ class _Fault:
 
 _FAULTS: List[_Fault] = []
 _ENV_PARSED = False
+_RANKS_GATE: Optional[bool] = None     # cached MXNET_TPU_CHAOS_RANKS verdict
+
+
+def _current_rank() -> Optional[int]:
+    """This process's worker rank, resolved WITHOUT initialising jax:
+    env first (the PS/launcher protocol), then an already-initialised
+    jax.distributed client.  None when the process has no rank."""
+    for var in ("MXNET_TPU_CHAOS_RANK", "MXNET_TPU_KV_RANK",
+                "DMLC_WORKER_ID"):
+        v = os.environ.get(var, "").strip()
+        if v.lstrip("-").isdigit():
+            return int(v)
+    import sys
+    if "jax" in sys.modules:
+        try:
+            from jax._src import distributed
+            if getattr(distributed.global_state, "client", None) is not None:
+                return int(distributed.global_state.process_id)
+        except Exception:
+            pass
+    return None
+
+
+def _ranks_allow() -> bool:
+    """With ``MXNET_TPU_CHAOS_RANKS`` set (comma list of worker ranks),
+    faults fire ONLY on those ranks — so a straggler/crash drill pins its
+    fault to one deterministic worker instead of wherever the env
+    happens to land.  A process with no resolvable rank never fires."""
+    global _RANKS_GATE
+    if _RANKS_GATE is not None:
+        return _RANKS_GATE
+    spec = os.environ.get("MXNET_TPU_CHAOS_RANKS", "").strip()
+    if not spec:
+        _RANKS_GATE = True
+        return True
+    try:
+        ranks = {int(t) for t in spec.split(",") if t.strip()}
+    except ValueError:
+        _RANKS_GATE = True
+        return True
+    r = _current_rank()
+    _RANKS_GATE = r is not None and r in ranks
+    return _RANKS_GATE
 
 
 def _parse_env():
@@ -124,9 +174,10 @@ def _parse_env():
 
 def reset():
     """Drop every armed fault (tests) and re-read the env next time."""
-    global _ENV_PARSED
+    global _ENV_PARSED, _RANKS_GATE
     del _FAULTS[:]
     _ENV_PARSED = False
+    _RANKS_GATE = None
 
 
 def active() -> bool:
@@ -163,6 +214,8 @@ def fire(kind: str, step: Optional[int] = None) -> Optional[dict]:
     if not _FAULTS and _ENV_PARSED:
         return None
     _parse_env()
+    if _FAULTS and not _ranks_allow():
+        return None
     for f in _FAULTS:
         if f.kind != kind or f.remaining <= 0:
             continue

@@ -102,11 +102,30 @@ class FileKVClient:
     supervisor, and rank 0 of a gang must never be a single point of
     failure for serving), and a file per key survives any member being
     SIGKILLed mid-write because every set is write-tmp-then-rename.
+
+    Concurrent-writer hardening (it now backs the serving fleet, elastic
+    consensus AND the dist_async PS substrate, with many writers racing
+    on shared keys):
+
+    * writes go tmp → flush → **fsync** → rename, with a per-(pid,
+      thread, counter) tmp name, so two threads of one process can't
+      collide on the tmp file and a crash mid-write never leaves a
+      half-written VALUE under the key — only old-or-new;
+    * values carry a length-prefixed frame (``MXKV1 <len>\\n<value>``) so
+      a reader on a filesystem without atomic-rename visibility (NFS
+      close-to-open, partial page reads) can DETECT a torn read and
+      retry it instead of handing garbage to the consensus layer;
+      unframed files (foreign writers) still read as-is.
     """
+
+    _VALUE_MAGIC = "MXKV1 "
+    _READ_TRIES = 5
 
     def __init__(self, root: str):
         self.root = os.fspath(root)
         os.makedirs(self.root, exist_ok=True)
+        self._tmp_counter = 0
+        self._tmp_lock = threading.Lock()
 
     def _path(self, key: str) -> str:
         from urllib.parse import quote
@@ -117,17 +136,54 @@ class FileKVClient:
         if not allow_overwrite and os.path.exists(path):
             raise ValueError("key %r exists and allow_overwrite=False"
                              % key)
-        tmp = "%s.tmp.%d" % (path, os.getpid())
-        with open(tmp, "w") as f:
-            f.write(str(value))
-        os.replace(tmp, path)
+        with self._tmp_lock:
+            self._tmp_counter += 1
+            n = self._tmp_counter
+        tmp = "%s.tmp.%d.%d.%d" % (path, os.getpid(),
+                                   threading.get_ident(), n)
+        payload = str(value)
+        framed = "%s%d\n%s" % (self._VALUE_MAGIC,
+                               len(payload.encode("utf-8")), payload)
+        try:
+            with open(tmp, "w") as f:
+                f.write(framed)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def _decode(self, text):
+        """Returns the framed value, or raises ValueError on a torn/
+        partial read; unframed (legacy/foreign) content passes through."""
+        if not text.startswith(self._VALUE_MAGIC):
+            return text
+        head, sep, body = text[len(self._VALUE_MAGIC):].partition("\n")
+        if not sep or not head.isdigit():
+            raise ValueError("torn frame header")
+        want = int(head)
+        got = len(body.encode("utf-8"))
+        if got != want:
+            raise ValueError("torn value: %d/%d bytes" % (got, want))
+        return body
 
     def key_value_get(self, key):
-        try:
-            with open(self._path(key)) as f:
-                return f.read()
-        except OSError:
-            raise KeyError(key)
+        path = self._path(key)
+        for attempt in range(self._READ_TRIES):
+            try:
+                with open(path) as f:
+                    return self._decode(f.read())
+            except FileNotFoundError:
+                raise KeyError(key)
+            except (OSError, ValueError):
+                # partial read mid-replace (non-POSIX rename visibility)
+                # or transient IO: brief retry, then treat as missing
+                time.sleep(0.005 * (attempt + 1))
+        raise KeyError(key)
 
     def key_value_dir_get(self, prefix):
         from urllib.parse import quote, unquote
@@ -138,15 +194,21 @@ class FileKVClient:
         except OSError:
             return out
         for name in sorted(names):
-            if name.endswith(".tmp.%d" % os.getpid()) or ".tmp." in name:
+            if ".tmp." in name:
                 continue
             if not name.startswith(q):
                 continue
-            try:
-                with open(os.path.join(self.root, name)) as f:
-                    out.append((unquote(name), f.read()))
-            except OSError:
-                continue        # deleted between listdir and open
+            path = os.path.join(self.root, name)
+            for attempt in range(self._READ_TRIES):
+                try:
+                    with open(path) as f:
+                        out.append((unquote(name), self._decode(f.read())))
+                    break
+                except FileNotFoundError:
+                    break       # deleted between listdir and open
+                except (OSError, ValueError):
+                    time.sleep(0.005 * (attempt + 1))
+            # a persistently torn entry is skipped, not surfaced
         return out
 
     def key_value_delete(self, key):

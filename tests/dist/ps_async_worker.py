@@ -1,0 +1,89 @@
+"""One dist_async PS worker for the drills in tests/test_ps_drills.py.
+
+A plain OS process — NOT a jax gang member: rank/world and the server
+location come from env (``MXNET_TPU_KV_DIR`` / ``MXNET_TPU_KV_RANK``),
+and the loop is pull -> local collective-free step -> push, with no
+barrier anywhere.  Chaos hooks fire INSIDE the step region so the drills
+can pin a persistent straggler (``hedge_lag`` + ``MXNET_TPU_CHAOS_RANKS``)
+or a kill -9 (``replica_crash@step``) to one deterministic worker while
+every process runs this same script with the same ``MXNET_TPU_CHAOS``.
+
+Env knobs: ``PS_STEPS`` (fixed step count, default 40) or ``PS_SECONDS``
+(time-boxed run — the throughput drills), ``PS_LR`` (default 0.1).
+
+Prints exactly one ``PSWORKER rank=R steps=N eval_loss=L OK`` line on
+success; a SIGKILLed worker prints nothing (that is the point).
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+from mxnet_tpu import kvstore as kvs  # noqa: E402
+from mxnet_tpu.kvstore.worker import (  # noqa: E402
+    TOY_DIM, make_worker_step, toy_batch, toy_init)
+from mxnet_tpu.ndarray.ndarray import array as nd_array  # noqa: E402
+from mxnet_tpu.optimizer import Optimizer  # noqa: E402
+from mxnet_tpu.resilience import chaos  # noqa: E402
+
+
+def main():
+    rank = int(os.environ.get("MXNET_TPU_KV_RANK", "0"))
+    lr = float(os.environ.get("PS_LR", "0.1"))
+    seconds = os.environ.get("PS_SECONDS", "").strip()
+    max_steps = int(os.environ.get("PS_STEPS", "40"))
+
+    kv = kvs.create("dist_async")
+    assert type(kv).__name__ == "KVStorePS", type(kv)
+    kv.init("w", nd_array(toy_init()))
+    kv.set_optimizer(Optimizer.create_optimizer("sgd", learning_rate=lr))
+    if os.environ.get("PS_BARRIER"):
+        # throughput drills: a coordination barrier (init sync point —
+        # NOT part of the step path) puts every worker on the same start
+        # line, so step counts measure the lane, not process launch skew
+        kv.barrier()
+    # the clock starts after the common start line
+    deadline = (time.monotonic() + float(seconds)) if seconds else None
+
+    step_fn = make_worker_step(TOY_DIM)
+    out = nd_array(toy_init())
+    steps = 0
+    while True:
+        if deadline is not None:
+            if time.monotonic() >= deadline:
+                break
+        elif steps >= max_steps:
+            break
+        kv.pull("w", out=out)              # the SSP gate lives here
+        x, y = toy_batch(rank, steps)
+        chaos.maybe_replica_crash(steps)   # kill -9 drill injection
+        _, grad = step_fn(out._handle, x, y)
+        kv.push("w", nd_array(np.asarray(grad)))
+        steps += 1
+        # straggler drill injection: the lag lands AFTER the push so the
+        # straggler is in the SSP clock set from its first round — the
+        # drill measures the lane under a slow worker, not the window
+        # before the server has ever heard from it
+        chaos.maybe_hedge_lag(steps)
+
+    # eval on a batch NO worker trained on, with the weights of the last
+    # pull — no extra pull here, so nobody re-enters the SSP gate after
+    # peers have exited
+    xe, ye = toy_batch(999, 0, batch_size=256)
+    w = np.asarray(out.asnumpy())
+    err = xe @ w - ye
+    eval_loss = float(0.5 * np.mean(err * err))
+    assert np.isfinite(w).all(), "non-finite weights pulled"
+    kv.close()
+    print("PSWORKER rank=%d steps=%d eval_loss=%.6f OK"
+          % (rank, steps, eval_loss), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -433,7 +433,14 @@ def test_decode_autotune_record_and_read(tmp_path, monkeypatch):
         autotune.invalidate()
 
 
+@pytest.mark.slow
 def test_servebench_decode_smoke(capsys):
+    # @slow per the PR-16 tier-1 re-profile: the continuous-vs-static
+    # occupancy comparison depends on open-loop arrival timing, and on
+    # the loaded 1-core rig arrivals bunch up enough for static batching
+    # to tie (observed 0.671 vs 0.700 under a full-suite run); the
+    # compile-once invariant it also guards stays in tier-1 via
+    # test_engine_continuous_batching_parity_and_compile_once
     sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
                                     "tools"))
     import servebench

@@ -4,6 +4,13 @@
 
 Each case forks 4 real processes that initialise jax.distributed over a
 gloo CPU backend and must all exit 0.
+
+The 19-49 s drills (elastic resize/notice, hang watchdog, async train)
+are @slow per the PR-16 tier-1 re-profile: 4-proc gangs on the 1-core
+rig are both the slowest and the most load-fragile cases; the default
+selection keeps sync kvstore, mlp train, and the elastic full-restart
+path, and every @slow drill's machinery retains fast unit coverage
+(test_elastic.py, test_watchdog.py, test_kvstore_ps.py).
 """
 import os
 import subprocess
@@ -79,6 +86,7 @@ def _run_elastic(mode, tmp_path, final_world, timeout=420):
     return out
 
 
+@pytest.mark.slow
 def test_dist_elastic_resize_4proc(tmp_path):
     """THE elastic acceptance drill (ROADMAP item 5): rank 1 is
     hard-preempted mid-epoch; the 3 survivors agree on membership over
@@ -145,6 +153,7 @@ def test_dist_elastic_resize_4proc(tmp_path):
     assert "CACHE" in r.stdout       # entry/quarantine stats rendered
 
 
+@pytest.mark.slow
 def test_dist_elastic_notice_4proc(tmp_path):
     """The graceful path: rank 1 gets a preemption NOTICE (chaos
     preempt_notice with a grace window), checkpoints-then-exits cleanly
@@ -165,6 +174,7 @@ def test_dist_elastic_notice_4proc(tmp_path):
     assert out.count("WARM compile by_result=") == 3, out[-1500:]
 
 
+@pytest.mark.slow
 def test_dist_async_train_4proc():
     """Module.fit with kvstore('dist_async') over 4 ranks stepping at
     different speeds: no deadlock, per-rank convergence, identical params
@@ -172,6 +182,7 @@ def test_dist_async_train_4proc():
     _run_dist("dist_async_train.py")
 
 
+@pytest.mark.slow
 def test_dist_hang_watchdog_4proc(tmp_path):
     """Silent-hang e2e drill (ISSUE 2 acceptance): rank 1 stalls inside
     the fit step; the watchdog fires within its deadline, dumps stacks +

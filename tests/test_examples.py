@@ -10,10 +10,16 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # heavyweight scripts (tier-1 runs `-m 'not slow'` under a time budget;
-# each subsystem keeps a faster sibling in the default selection — e.g.
-# detection still runs train_frcnn_toy)
+# the PR-16 re-profile on the 1-core rig added the 8-20 s scripts below —
+# their model families keep symbol/module coverage in test_model_symbols
+# and ~19 faster example scripts stay in the default selection)
 _SLOW = {"detection/train_ssd_toy.py", "captcha/ocr_ctc.py",
-         "capsnet/capsnet_digits.py"}
+         "capsnet/capsnet_digits.py",
+         "deep_embedded_clustering/dec_digits.py",
+         "fcn_xs/fcn_segmentation.py",
+         "detection/train_frcnn_toy.py",
+         "gan/dcgan.py",
+         "reinforcement_learning/dqn_gridworld.py"}
 
 EXAMPLES = [
     ("image_classification/train_mlp.py", "train_mlp example OK"),

@@ -1,0 +1,170 @@
+"""Two-tier hierarchical collectives (parallel/hierarchy.py): the
+2-island x 4 dryrun of the ISSUE-19 acceptance bar — numerics match a
+flat psum, the compiled program's per-tier payloads are attributed to
+the right mesh axis and equal ``hierarchical_allreduce_model_bytes``
+exactly, and the slow-tier wire bytes come out far below the flat-ring
+baseline."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mxnet_tpu.analysis import graphcheck
+from mxnet_tpu.parallel import audit, hierarchy
+from mxnet_tpu.parallel.mesh import MeshSpec
+
+ISLANDS, PER_ISLAND = 2, 4
+WORLD = ISLANDS * PER_ISLAND
+
+
+def _need_devices(n):
+    if len(jax.devices()) < n:
+        pytest.skip("needs %d devices" % n)
+
+
+def _mesh():
+    return MeshSpec.build({"island": ISLANDS, "dp": PER_ISLAND}).mesh
+
+
+def _stacked(n_elems, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.normal(size=(WORLD, n_elems)).astype(np.float32)
+
+
+def test_hierarchical_matches_flat_psum():
+    _need_devices(WORLD)
+    mesh = _mesh()
+    stacked = _stacked(64)
+    hier = np.asarray(hierarchy.hierarchical_allreduce(
+        jnp.asarray(stacked), mesh))
+    flat = np.asarray(hierarchy.flat_allreduce(jnp.asarray(stacked), mesh))
+    expect = stacked.sum(axis=0)
+    assert np.allclose(hier, expect, atol=1e-5)
+    assert np.allclose(hier, flat, atol=1e-5)
+    # every row carries the same global sum
+    assert np.allclose(hier, hier[0], atol=0)
+
+
+def test_hierarchical_pads_non_divisible():
+    _need_devices(WORLD)
+    mesh = _mesh()
+    stacked = _stacked(13, seed=3)    # 13 % 4 != 0 -> zero-pad path
+    out = np.asarray(hierarchy.hierarchical_allreduce(
+        jnp.asarray(stacked), mesh))
+    assert out.shape == stacked.shape
+    assert np.allclose(out, stacked.sum(axis=0), atol=1e-5)
+
+
+def test_two_tier_payloads_match_model_per_axis():
+    """The audit bar: compiled HLO must contain exactly one
+    reduce-scatter on the fast axis, one all-reduce on the slow axis and
+    one all-gather on the fast axis, each with the analytic payload."""
+    _need_devices(WORLD)
+    mesh = _mesh()
+    n = 64
+    f = jax.jit(functools.partial(hierarchy.hierarchical_allreduce,
+                                  mesh=mesh))
+    hlo = f.lower(jax.ShapeDtypeStruct((WORLD, n), jnp.float32)) \
+        .compile().as_text()
+    acct = audit.collective_accounting(hlo, mesh=mesh)
+    model = audit.hierarchical_allreduce_model_bytes(
+        n * 4, ISLANDS, PER_ISLAND)
+
+    for kind, axis in (("reduce-scatter", "dp"), ("all-reduce", "island"),
+                       ("all-gather", "dp")):
+        assert kind in acct, (kind, sorted(acct))
+        info = acct[kind]
+        assert info["bytes"] == model[kind], (kind, info, model)
+        # the whole payload of this kind is attributed to ONE tier
+        assert set(info["by_axis"]) == {axis}, (kind, info["by_axis"])
+        assert info["by_axis"][axis]["bytes"] == model[kind]
+
+
+def test_slow_tier_wire_far_below_flat_ring():
+    payload = 10 * 1024 * 1024
+    model = audit.hierarchical_allreduce_model_bytes(
+        payload, ISLANDS, PER_ISLAND)
+    # slow tier moves a ring all-reduce of the 1/k shard over m islands
+    assert model["slow_wire"] == audit.ring_allreduce_wire_bytes(
+        payload // PER_ISLAND, ISLANDS)
+    assert model["flat_wire"] == audit.ring_allreduce_wire_bytes(
+        payload, WORLD)
+    # the "<< flat ring" acceptance clause, with margin: 7x at 2x4
+    assert model["flat_wire"] >= 4 * model["slow_wire"], model
+
+
+def test_model_unit_values():
+    m = audit.hierarchical_allreduce_model_bytes(256, 2, 4)
+    assert m == {"reduce-scatter": 64, "all-reduce": 64,
+                 "all-gather": 256,
+                 "slow_wire": audit.ring_allreduce_wire_bytes(64, 2),
+                 "flat_wire": audit.ring_allreduce_wire_bytes(256, 8)}
+    # degenerate single-island mesh: nothing crosses a slow tier
+    m1 = audit.hierarchical_allreduce_model_bytes(256, 1, 4)
+    assert m1["slow_wire"] == 0
+    # ceil-division when the payload does not divide the island
+    mp = audit.hierarchical_allreduce_model_bytes(52, 2, 4)   # 13 f32
+    assert mp["reduce-scatter"] == 16                          # 4-elem shard
+
+
+def test_audit_report_hier_line():
+    _need_devices(WORLD)
+    mesh = _mesh()
+    n = 64
+    f = jax.jit(functools.partial(hierarchy.hierarchical_allreduce,
+                                  mesh=mesh))
+    hlo = f.lower(jax.ShapeDtypeStruct((WORLD, n), jnp.float32)) \
+        .compile().as_text()
+    model = audit.hierarchical_allreduce_model_bytes(
+        n * 4, ISLANDS, PER_ISLAND)
+    text, _ = audit.audit_report("hier-dryrun", hlo, WORLD,
+                                 ring_n=ISLANDS, mesh=mesh,
+                                 hier_model=model)
+    assert "analytic 2-tier payload" in text
+    assert "measured/model = 1.00" in text
+    assert "by-axis" in text and "island" in text
+    assert "flat ring" in text
+
+
+def test_graphcheck_clean_and_worker_step_collective_free():
+    _need_devices(WORLD)
+    mesh = _mesh()
+
+    def run(st):
+        return hierarchy.hierarchical_allreduce(st, mesh)
+    rep = graphcheck.check_fn(
+        run, jax.ShapeDtypeStruct((WORLD, 16), jnp.float32), mesh=mesh,
+        target="parallel.hierarchical_allreduce")
+    assert rep.errors() == [], [f.to_dict() for f in rep.errors()]
+
+    # the async worker step honours the collective-free contract...
+    from mxnet_tpu.kvstore.worker import TOY_DIM, make_worker_step
+    step = make_worker_step(TOY_DIM)
+    w = jax.ShapeDtypeStruct((TOY_DIM,), jnp.float32)
+    x = jax.ShapeDtypeStruct((16, TOY_DIM), jnp.float32)
+    y = jax.ShapeDtypeStruct((16,), jnp.float32)
+    rep = graphcheck.check_collective_free(step, w, x, y,
+                                           target="kvstore.worker_step")
+    assert rep.errors() == [], [f.to_dict() for f in rep.errors()]
+
+    # ...and GC106 actually fires on a program that breaks it
+    def sneaky(st):
+        return hierarchy.flat_allreduce(st, mesh)
+    rep = graphcheck.check_collective_free(
+        sneaky, jax.ShapeDtypeStruct((WORLD, 16), jnp.float32),
+        target="sneaky")
+    assert any(f.rule == "GC106" for f in rep.errors()), \
+        [f.to_dict() for f in rep.findings]
+
+
+def test_grad_allreduce_tree():
+    _need_devices(WORLD)
+    mesh = _mesh()
+    tree = {"a": jnp.asarray(_stacked(8, seed=1)),
+            "b": jnp.asarray(_stacked(24, seed=2))}
+    out = hierarchy.hierarchical_grad_allreduce(tree, mesh)
+    for k in tree:
+        assert np.allclose(np.asarray(out[k]),
+                           np.asarray(tree[k]).sum(axis=0), atol=1e-5)

@@ -1,0 +1,504 @@
+"""The dist_async parameter-server lane (mxnet_tpu/kvstore/): protocol
+arithmetic, server semantics (async apply, SSP staleness gate, duplicate
+-push idempotence, checkpoint/restore exactly-once), the PSClient
+transport (retry absorption, PullRowSparse wire accounting), the
+KVStorePS facade behind ``kvstore.create("dist_async")``, the hardened
+FileKVClient under concurrent writers, chaos rank targeting, and the
+``postmortem --kvstore`` timeline.  Everything here is in-process
+(``serve_in_thread``); the multi-process SIGKILL/straggler drills live
+in tests/test_ps_drills.py."""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.kvstore import protocol
+from mxnet_tpu.kvstore.client import KVStorePS, PSClient
+from mxnet_tpu.kvstore.server import KVServer
+from mxnet_tpu.ndarray.ndarray import array as nd_array
+from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+from mxnet_tpu.optimizer import Optimizer, Updater
+from mxnet_tpu.resilience import chaos
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WRAP = protocol.CLOCK_WRAP
+
+
+@pytest.fixture
+def lane(tmp_path):
+    """One kv dir + helpers to start in-process servers and clients,
+    with teardown that stops everything."""
+    servers, clients = [], []
+
+    def start(world=1, staleness=None, **kw):
+        s = KVServer(str(tmp_path), world=world, staleness=staleness, **kw)
+        s.serve_in_thread()
+        servers.append(s)
+        return s
+
+    def connect(rank=0):
+        c = PSClient(str(tmp_path), rank=rank, connect_timeout=10)
+        clients.append(c)
+        return c
+
+    yield SimpleNamespace(dir=str(tmp_path), start=start, connect=connect)
+    for c in clients:
+        c.close()
+    for s in servers:
+        s.stop()
+
+
+# ---------------------------------------------------------------------------
+# protocol arithmetic
+# ---------------------------------------------------------------------------
+
+def test_clock_lag_wraps():
+    assert protocol.clock_lag(5, 3) == 2
+    assert protocol.clock_lag(3, 5) == -2
+    # across the wrap boundary a "newer" counter is still newer
+    assert protocol.clock_lag(0, WRAP - 1) == 1
+    assert protocol.clock_lag(1, WRAP - 2) == 3
+    assert protocol.clock_lag(WRAP - 1, 0) == -1
+    assert protocol.next_version(WRAP - 1) == 0
+
+
+def test_endpoint_epoch_counts_relaunches(tmp_path):
+    d = str(tmp_path)
+    assert protocol.publish_endpoint(d, "127.0.0.1", 1111) == 1
+    assert protocol.publish_endpoint(d, "127.0.0.1", 2222) == 2
+    host, port, epoch = protocol.resolve_endpoint(d, timeout=2)
+    assert (host, port, epoch) == ("127.0.0.1", 2222, 2)
+
+
+def test_read_events_skips_torn_lines(tmp_path):
+    d = str(tmp_path)
+    protocol.log_event(d, "push", worker=0, key="w")
+    protocol.log_event(d, "pull", worker=2, key="w")
+    with open(protocol.events_path(d), "a") as f:
+        f.write('{"event": "push", "worker": 1, "ke')   # SIGKILL mid-append
+    evs = protocol.read_events(d)
+    assert [e["event"] for e in evs] == ["push", "pull"]
+
+
+# ---------------------------------------------------------------------------
+# server semantics
+# ---------------------------------------------------------------------------
+
+def test_push_pull_server_side_sgd_bitmatch(lane):
+    """Server-side updates run through the SAME Updater/SGD code path a
+    local kvstore uses — the pulled weights must match bit-for-bit."""
+    lane.start(world=1)
+    c = lane.connect(0)
+    w0 = np.arange(8, dtype=np.float32) / 4.0
+    c.init("w", w0)
+    c.set_optimizer("sgd", {"learning_rate": 0.5})
+    grads = [np.full(8, 0.25, np.float32), np.full(8, -0.5, np.float32)]
+    for g in grads:
+        assert c.push("w", g)["applied"] is True
+    got, reply = c.pull("w")
+    assert reply["version"] == 2
+
+    stored = nd_array(w0.copy())
+    upd = Updater(Optimizer.create_optimizer("sgd", learning_rate=0.5))
+    for g in grads:
+        upd("w", nd_array(g), stored)
+    assert np.array_equal(got, stored.asnumpy())
+
+
+def test_duplicate_push_acked_not_reapplied(lane):
+    srv = lane.start(world=1)
+    c = lane.connect(0)
+    c.init("w", np.zeros(4, np.float32))
+    g = np.ones(4, np.float32)
+    assert c.push("w", g)["applied"] is True
+    # a retransmit of the same version (retry after a lost ack)
+    reply, _ = c.call({"op": "push", "key": "w", "worker": 0,
+                       "version": 1}, {"grad": g})
+    assert reply["applied"] is False
+    value, _ = c.pull("w")
+    assert np.array_equal(value, g)          # applied exactly once
+    assert srv._stats["duplicate_pushes"] == 1
+
+
+def test_restarted_worker_resumes_version_sequence(lane):
+    """The register reply carries the worker's applied map, so a
+    restarted worker continues its push numbering instead of colliding
+    with the dedup table and silently losing gradients."""
+    lane.start(world=1)
+    c1 = lane.connect(0)
+    c1.init("w", np.zeros(4, np.float32))
+    c1.set_optimizer("sgd", {"learning_rate": 1.0})
+    c1.push("w", np.ones(4, np.float32))
+    c1.push("w", np.ones(4, np.float32))
+    c1.close()
+
+    c2 = lane.connect(0)                     # same rank, fresh process
+    r = c2.push("w", np.full(4, 2.0, np.float32))
+    assert c2.applied["w"] == 3 and r["applied"] is True
+    value, _ = c2.pull("w")
+    assert np.array_equal(value, np.full(4, -4.0, np.float32))
+
+
+def test_staleness_gate_blocks_then_releases(lane):
+    lane.start(world=2, staleness=1, pull_timeout=10.0)
+    c0, c1 = lane.connect(0), lane.connect(1)
+    c0.init("w", np.zeros(4, np.float32))
+    c0.set_optimizer("sgd", {"learning_rate": 1.0})
+    g = np.ones(4, np.float32)
+    c0.push("w", g)
+    c1.push("w", g)
+    c0.push("w", g)
+    c0.push("w", g)        # c0 at 3, c1 at 1: lag 2 > K=1
+
+    got = []
+    t = threading.Thread(target=lambda: got.append(c0.pull("w")[0]),
+                         daemon=True)
+    t.start()
+    time.sleep(0.5)
+    assert t.is_alive(), "pull should be gated at lag 2 > bound 1"
+    c1.push("w", g)        # slowest advances: lag 1 <= 1
+    t.join(8)
+    assert not t.is_alive() and got
+    assert np.array_equal(got[0], np.full(4, -5.0, np.float32))
+
+
+def test_staleness_zero_is_lockstep_sync_equivalent(lane):
+    srv = lane.start(world=2, staleness=0, pull_timeout=10.0)
+    c0, c1 = lane.connect(0), lane.connect(1)
+    w0 = np.zeros(4, np.float32)
+    c0.init("w", w0)
+    c0.set_optimizer("sgd", {"learning_rate": 1.0})
+
+    ga = np.full(4, 0.25, np.float32)
+    gb = np.full(4, 0.5, np.float32)
+    c0.push("w", ga)
+    # c0 is 1 ahead of c1 (who has pushed nothing yet but counts only
+    # once it pushes) — after c1's first push both are at 1 and anyone
+    # may pull; c0 pushing AGAIN then gates its own pull: lockstep.
+    c1.push("w", gb)
+    c0.push("w", ga)
+    gated = []
+    t = threading.Thread(target=lambda: gated.append(c0.pull("w")[0]),
+                         daemon=True)
+    t.start()
+    time.sleep(0.4)
+    assert t.is_alive(), "K=0: a worker one round ahead must wait"
+    c1.push("w", gb)
+    t.join(8)
+    assert not t.is_alive() and gated
+    # two full rounds of (ga + gb) at lr=1: exactly the sync result
+    assert np.allclose(gated[0], w0 - 2 * (ga + gb))
+    assert srv._stats["staleness_waits"] >= 1
+
+
+def test_pull_only_worker_never_blocks_nor_gates(lane):
+    lane.start(world=2, staleness=0, pull_timeout=5.0)
+    pusher, reader = lane.connect(0), lane.connect(1)
+    pusher.init("w", np.zeros(4, np.float32))
+    pusher.set_optimizer("sgd", {"learning_rate": 1.0})
+    for _ in range(5):       # a lone pusher is never gated by K
+        pusher.push("w", np.ones(4, np.float32))
+    t0 = time.monotonic()
+    value, reply = reader.pull("w")       # eval reader: no clock entry
+    assert time.monotonic() - t0 < 1.0
+    assert reply["waited_ms"] == 0.0
+    assert np.array_equal(value, np.full(4, -5.0, np.float32))
+    # and the pusher can still pull: the reader holds nobody back
+    value, _ = pusher.pull("w")
+    assert np.array_equal(value, np.full(4, -5.0, np.float32))
+
+
+def test_version_wraparound_push_and_staleness(lane):
+    """Counters live on the mod-2**32 circle: pushes crossing the wrap
+    stay 'newer', and SSP lags computed across the boundary are small
+    numbers, not ~4 billion."""
+    srv = lane.start(world=2, staleness=2, pull_timeout=10.0)
+    w0 = np.zeros(4, np.float32)
+    with srv._lock:
+        srv._values["w"] = nd_array(w0)
+        srv._versions["w"] = WRAP - 2
+        srv._applied[(0, "w")] = WRAP - 2
+        srv._applied[(1, "w")] = WRAP - 2
+    c0, c1 = lane.connect(0), lane.connect(1)
+    c0.ensure_registered()
+    assert c0.applied["w"] == WRAP - 2       # register restored the clock
+    c0.set_optimizer("sgd", {"learning_rate": 1.0})
+    g = np.ones(4, np.float32)
+    assert c0.push("w", g)["applied"] is True          # version WRAP-1
+    assert c0.push("w", g)["applied"] is True          # version 0 (wrap)
+    assert c0.applied["w"] == 0
+    assert srv._stats["duplicate_pushes"] == 0
+    # c0 (wrapped to 0) leads c1 (WRAP-2) by exactly 2 == K: no gate
+    value, reply = c0.pull("w")
+    assert reply["waited_ms"] == 0.0
+    assert np.array_equal(value, np.full(4, -2.0, np.float32))
+    # one more push puts c0 3 ahead across the boundary: gate closes
+    # (c1 must be LIVE to count in the staleness set at all)
+    c1.ensure_registered()
+    c0.push("w", g)
+    got = []
+    t = threading.Thread(target=lambda: got.append(c0.pull("w")[0]),
+                         daemon=True)
+    t.start()
+    time.sleep(0.4)
+    assert t.is_alive(), "wrap-aware lag 3 > bound 2 must gate"
+    c1.push("w", g)
+    t.join(8)
+    assert not t.is_alive() and got
+
+
+def test_server_restart_applies_each_push_exactly_once(lane):
+    """Satellite: server restart mid-stream.  A push the restored
+    checkpoint already contains is acked-not-reapplied on retry; a new
+    push after the restart is applied once — no silent loss, no
+    double-apply."""
+    srv1 = lane.start(world=1)
+    c = lane.connect(0)
+    w0 = np.full(4, 8.0, np.float32)
+    c.init("w", w0)
+    c.set_optimizer("sgd", {"learning_rate": 1.0})
+    g1 = np.full(4, 0.5, np.float32)
+    c.push("w", g1)
+    srv1.checkpoint()
+    c.close()                  # the SIGKILL drops every connection
+    srv1.stop()
+
+    srv2 = KVServer(lane.dir, world=1)
+    srv2.serve_in_thread()
+    try:
+        c2 = lane.connect(0)
+        # worker retries g1 (it never saw the ack): dedup table survived
+        reply, _ = c2.call({"op": "push", "key": "w", "worker": 0,
+                            "version": 1}, {"grad": g1})
+        assert reply["applied"] is False
+        g2 = np.full(4, 0.25, np.float32)
+        assert c2.push("w", g2)["applied"] is True
+        value, _ = c2.pull("w")
+        assert np.array_equal(value, w0 - g1 - g2)
+        assert srv2._stats["duplicate_pushes"] == 1
+        evs = [e["event"] for e in protocol.read_events(lane.dir)]
+        assert "restore" in evs and "checkpoint" in evs
+    finally:
+        srv2.stop()
+
+
+def test_pull_rows_bitmatch_and_wire_bytes(lane):
+    """True PullRowSparse: the server's sparse apply goes through the
+    SAME lazy sgd_row_sparse_update as the in-mesh sparse plane (bit
+    match), and the wire ledger scales with touched rows, not table
+    size."""
+    lane.start(world=1)
+    c = lane.connect(0)
+    rows, dim = 16, 4
+    table0 = np.arange(rows * dim, dtype=np.float32).reshape(rows, dim)
+    c.init("emb", table0)
+    c.set_optimizer("sgd", {"learning_rate": 0.25})
+    data = np.array([[1.0] * dim, [2.0] * dim, [3.0] * dim], np.float32)
+    ids = np.array([3, 7, 3], np.int64)        # duplicate id: client dedups
+    c.push_sparse("emb", data, ids)
+
+    # local mirror: identical RowSparseNDArray grad through the same
+    # Updater — touched rows only, lazy O(nnz) update
+    stored = nd_array(table0.copy())
+    upd = Updater(Optimizer.create_optimizer("sgd", learning_rate=0.25))
+    import jax.numpy as jnp
+    merged = np.array([[4.0] * dim, [2.0] * dim], np.float32)  # 3 summed
+    grad = RowSparseNDArray(jnp.asarray(merged),
+                            jnp.asarray(np.array([3, 7])), (rows, dim))
+    upd("emb", grad, stored)
+
+    full, _ = c.pull("emb")
+    assert np.array_equal(full, stored.asnumpy())
+
+    # wire accounting: ids out (int64) + rows back (f32) + indices back
+    c.op_bytes.pop("pull_rows", None)
+    data2, idx2, reply = c.pull_rows("emb", np.array([3, 7], np.int64))
+    assert list(idx2) == [3, 7] and tuple(reply["shape"]) == (rows, dim)
+    assert np.array_equal(data2, stored.asnumpy()[[3, 7]])
+    two_row_bytes = c.op_bytes["pull_rows"]
+    assert two_row_bytes == 2 * 8 + 2 * dim * 4 + 2 * 8
+    c.op_bytes.pop("pull_rows")
+    c.pull_rows("emb", np.arange(6, dtype=np.int64))
+    assert c.op_bytes["pull_rows"] == 3 * two_row_bytes   # ∝ touched rows
+    table_bytes = rows * dim * 4
+    assert two_row_bytes < table_bytes // 2
+
+
+# ---------------------------------------------------------------------------
+# KVStorePS facade (kvstore.create("dist_async") with the lane armed)
+# ---------------------------------------------------------------------------
+
+def test_create_dispatches_on_kv_dir(lane, monkeypatch):
+    from mxnet_tpu import kvstore as kvs
+    monkeypatch.delenv("MXNET_TPU_KV_DIR", raising=False)
+    kv = kvs.create("dist_async")
+    assert not isinstance(kv, KVStorePS)      # in-mesh async lane
+    lane.start(world=1)
+    monkeypatch.setenv("MXNET_TPU_KV_DIR", lane.dir)
+    monkeypatch.setenv("MXNET_TPU_KV_RANK", "0")
+    monkeypatch.setenv("MXNET_TPU_KV_WORLD", "1")
+    kv = kvs.create("dist_async")
+    try:
+        assert isinstance(kv, KVStorePS)
+        assert kv.rank == 0 and kv.num_workers == 1
+    finally:
+        kv.close()
+
+
+def test_kvstore_ps_end_to_end(lane, monkeypatch):
+    import jax.numpy as jnp
+    from mxnet_tpu import kvstore as kvs
+    lane.start(world=1)
+    monkeypatch.setenv("MXNET_TPU_KV_DIR", lane.dir)
+    monkeypatch.setenv("MXNET_TPU_KV_RANK", "0")
+    monkeypatch.setenv("MXNET_TPU_KV_WORLD", "1")
+    kv = kvs.create("dist_async")
+    try:
+        w0 = np.linspace(0, 1, 8).astype(np.float32)
+        kv.init("w", nd_array(w0))
+        opt = Optimizer.create_optimizer("sgd", learning_rate=0.5)
+        kv.set_optimizer(opt)
+        with pytest.raises(MXNetError):
+            kv.set_updater(lambda k, g, w: None)     # callables don't travel
+        kv.push("w", nd_array(np.full(8, 0.5, np.float32)))
+        out = nd_array(np.zeros(8, np.float32))
+        kv.pull("w", out=out)
+        assert np.allclose(out.asnumpy(), w0 - 0.25)
+
+        # row_sparse_pull into a RowSparseNDArray out
+        table = np.ones((8, 2), np.float32)
+        kv.init("emb", nd_array(table))
+        o = RowSparseNDArray(jnp.zeros((1, 2)), jnp.zeros((1,), jnp.int32),
+                             (8, 2))
+        kv.row_sparse_pull("emb", out=o,
+                           row_ids=nd_array(np.array([5, 1, 5],
+                                                     np.float32)))
+        assert list(np.asarray(o._indices)) == [1, 5]
+        assert np.array_equal(np.asarray(o._data), table[[1, 5]])
+        kv.barrier()
+        assert kv.num_dead_node() == 0
+    finally:
+        kv.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos: retry absorption + rank targeting
+# ---------------------------------------------------------------------------
+
+def test_io_error_absorbed_by_retry(lane, monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_KV_RETRY_BACKOFF", "0.01")
+    lane.start(world=1)
+    c = lane.connect(0)
+    c.init("w", np.ones(2, np.float32))
+    chaos.reset()
+    with chaos.inject("io_error"):
+        value, reply = c.pull("w")        # first attempt raises, retried
+    assert reply["ok"] and np.array_equal(value, np.ones(2, np.float32))
+    chaos.reset()
+
+
+def test_chaos_ranks_pins_faults(monkeypatch):
+    # this process is rank 1; the fault is pinned to rank 2 -> no fire
+    monkeypatch.setenv("MXNET_TPU_CHAOS_RANK", "1")
+    monkeypatch.setenv("MXNET_TPU_CHAOS_RANKS", "2")
+    chaos.reset()
+    with chaos.inject("io_error"):
+        assert chaos.fire("io_error") is None
+    # pinned set includes rank 1 -> fires
+    monkeypatch.setenv("MXNET_TPU_CHAOS_RANKS", "2,1")
+    chaos.reset()
+    with chaos.inject("io_error"):
+        assert chaos.fire("io_error") is not None
+    # no resolvable rank at all -> a targeted fault never fires
+    for var in ("MXNET_TPU_CHAOS_RANK", "MXNET_TPU_KV_RANK",
+                "DMLC_WORKER_ID"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("MXNET_TPU_CHAOS_RANKS", "0")
+    chaos.reset()
+    with chaos.inject("io_error"):
+        assert chaos.fire("io_error") is None
+    # unset -> faults fire everywhere (the pre-satellite behaviour)
+    monkeypatch.delenv("MXNET_TPU_CHAOS_RANKS")
+    chaos.reset()
+    with chaos.inject("io_error"):
+        assert chaos.fire("io_error") is not None
+    chaos.reset()
+
+
+# ---------------------------------------------------------------------------
+# FileKVClient concurrent-writer stress (satellite 1)
+# ---------------------------------------------------------------------------
+
+_STRESS = r"""
+import random, sys
+sys.path.insert(0, %r)
+from mxnet_tpu.resilience.watchdog import FileKVClient
+d, wid = sys.argv[1], int(sys.argv[2])
+kv = FileKVClient(d)
+rng = random.Random(wid)
+for i in range(120):
+    n = rng.randint(0, 1500)
+    kv.key_value_set("shared", "%%d|%%s" %% (n, "x" * n))
+    try:
+        v = kv.key_value_get("shared")
+    except KeyError:
+        continue
+    head, _, tail = v.partition("|")
+    assert head.isdigit() and len(tail) == int(head), (
+        "torn value: %%r..." %% v[:40])
+print("worker %%d ok" %% wid)
+"""
+
+
+def test_filekv_multiprocess_stress(tmp_path):
+    """Many processes hammering one key: every read must be a complete,
+    framed value — never a torn or partially-flushed one."""
+    script = _STRESS % REPO
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", script, str(tmp_path), str(i)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        for i in range(4)]
+    for i, p in enumerate(procs):
+        out, _ = p.communicate(timeout=120)
+        assert p.returncode == 0, "writer %d:\n%s" % (i, out.decode())
+    from mxnet_tpu.resilience.watchdog import FileKVClient
+    v = FileKVClient(str(tmp_path)).key_value_get("shared")
+    head, _, tail = v.partition("|")
+    assert len(tail) == int(head)
+
+
+# ---------------------------------------------------------------------------
+# postmortem --kvstore timeline
+# ---------------------------------------------------------------------------
+
+def test_postmortem_kvstore_timeline(lane, capsys):
+    lane.start(world=1)
+    c = lane.connect(0)
+    c.init("w", np.zeros(4, np.float32))
+    c.push("w", np.ones(4, np.float32))
+    c.pull("w")
+    c.pull_rows("w", np.array([0, 2], np.int64))
+    c.server_checkpoint()
+    c.close()
+    time.sleep(0.3)          # let the server log the eviction
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import postmortem
+    finally:
+        sys.path.pop(0)
+    assert postmortem.main([lane.dir, "--kvstore"]) == 0
+    out = capsys.readouterr().out
+    assert "KVSTORE (dist_async PS) TIMELINE" in out
+    for ev in ("listen", "register", "push", "pull", "checkpoint",
+               "evict"):
+        assert ev in out, out
+    assert "per-worker traffic" in out

@@ -1,0 +1,209 @@
+"""The dist_async robustness drills (ISSUE 19 acceptance): real worker
+PROCESSES against a real server over the wire.
+
+* straggler survival — 4 workers, ``hedge_lag`` chaos pinned to rank 3
+  via ``MXNET_TPU_CHAOS_RANKS``; the async lane must keep the healthy
+  workers at full speed (strictly higher aggregate throughput than the
+  K=0 lockstep run under the SAME straggler) while still converging.
+* server SIGKILL — the supervised server process is killed mid-stream;
+  the supervisor relaunches it, it restores from its checkpoint, the
+  worker's retry/backoff rides out the outage, and no push is ever
+  double-applied (a retransmit of a restored version is acked-not-
+  applied).
+* worker kill -9 — a SIGKILLed worker costs exactly its own in-flight
+  contribution: the survivor completes every step, the corpse is evicted
+  from the staleness set, its applied pushes stay applied.
+"""
+import os
+import re
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu.kvstore import protocol
+from mxnet_tpu.kvstore.client import PSClient
+from mxnet_tpu.kvstore.server import KVServer, launch_server
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "dist", "ps_async_worker.py")
+
+
+def _spawn_worker(kv_dir, rank, world, extra_env=None):
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env.update({"JAX_PLATFORMS": "cpu",
+                "MXNET_TPU_KV_DIR": str(kv_dir),
+                "MXNET_TPU_KV_RANK": str(rank),
+                "MXNET_TPU_KV_WORLD": str(world)})
+    env.update(extra_env or {})
+    return subprocess.Popen([sys.executable, WORKER],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT,
+                            text=True, env=env, cwd=REPO)
+
+
+def _parse_ok(out):
+    m = re.search(r"PSWORKER rank=(\d+) steps=(\d+) "
+                  r"eval_loss=([0-9.eE+-]+) OK", out)
+    assert m, out[-2000:]
+    return int(m.group(2)), float(m.group(3))
+
+
+def _run_fleet(kv_dir, world, seconds, staleness, chaos_env):
+    """One time-boxed 4-worker run against a fresh in-process server;
+    returns {rank: (steps, eval_loss)}."""
+    srv = KVServer(str(kv_dir), world=world, staleness=staleness,
+                   ckpt_interval=0, pull_timeout=20.0)
+    srv.serve_in_thread()
+    try:
+        procs = [_spawn_worker(kv_dir, r, world,
+                               {"PS_SECONDS": str(seconds),
+                                "PS_BARRIER": "1", **chaos_env})
+                 for r in range(world)]
+        results = {}
+        for r, p in enumerate(procs):
+            out, _ = p.communicate(timeout=180)
+            assert p.returncode == 0, "rank %d:\n%s" % (r, out[-2000:])
+            results[r] = _parse_ok(out)
+        return results
+    finally:
+        srv.stop()
+
+
+@pytest.mark.slow
+def test_straggler_async_beats_lockstep(tmp_path):
+    """THE throughput acceptance: same straggler (hedge_lag 0.25s/step
+    pinned to rank 3), same wall-clock box — the async lane's aggregate
+    step count must strictly beat bounded-K=0 lockstep, the healthy
+    workers must run far ahead of the straggler, and both lanes must
+    still converge on the toy problem."""
+    chaos_env = {"MXNET_TPU_CHAOS": "hedge_lagx1000000",
+                 "MXNET_TPU_CHAOS_RANKS": "3",
+                 "MXNET_TPU_CHAOS_HEDGE_LAG_SECONDS": "0.25"}
+    seconds = 6.0
+    res_async = _run_fleet(tmp_path / "async", 4, seconds,
+                           staleness=None, chaos_env=chaos_env)
+    res_sync = _run_fleet(tmp_path / "sync", 4, seconds,
+                          staleness=0, chaos_env=chaos_env)
+
+    agg_async = sum(s for s, _ in res_async.values())
+    agg_sync = sum(s for s, _ in res_sync.values())
+    straggler = res_async[3][0]
+    healthy_min = min(res_async[r][0] for r in range(3))
+    # the straggler cannot stall the async lane...
+    assert healthy_min >= 3 * max(1, straggler), res_async
+    # ...and lockstep pays for the same straggler with aggregate
+    # throughput the async lane strictly beats
+    assert agg_async > agg_sync, (res_async, res_sync)
+    # lockstep really was lockstep: nobody ran more than a few steps
+    # ahead of the straggler (K=0 pins everyone to its pace once its
+    # first push enters the clock set)
+    spread = max(s for s, _ in res_sync.values()) - \
+        min(s for s, _ in res_sync.values())
+    assert spread <= 4, res_sync
+    # convergence within a bounded gap of sync (toy noise floor ~5e-5,
+    # init loss ~1.3)
+    loss_async = min(l for _, l in res_async.values())
+    loss_sync = min(l for _, l in res_sync.values())
+    assert loss_async < 0.02, res_async
+    assert loss_async < loss_sync + 0.02, (loss_async, loss_sync)
+
+
+def test_server_sigkill_recovery(tmp_path):
+    """SIGKILL the supervised server mid-stream: relaunch + checkpoint
+    restore + worker retry/backoff, and exactly-once across the crash —
+    a retransmit of a restored version is acked-not-applied, every
+    version the restored server counts is reflected in the weights."""
+    kv_dir = str(tmp_path)
+    sup = launch_server(kv_dir, world=1,
+                        env={"JAX_PLATFORMS": "cpu",
+                             "MXNET_TPU_KV_CKPT_INTERVAL": "5"},
+                        restart_backoff=0.2)
+    try:
+        os.environ.pop("MXNET_TPU_CHAOS", None)
+        c = PSClient(kv_dir, rank=0, connect_timeout=60)
+        w0 = np.full(8, 4.0, np.float32)
+        g = np.full(8, 0.125, np.float32)
+        c.init("w", w0)
+        c.set_optimizer("sgd", {"learning_rate": 1.0})
+        for _ in range(12):
+            c.push("w", g)
+        epoch0 = c.server_epoch
+
+        sup.kill()                 # -9: no checkpoint-on-exit, no goodbye
+        c.close()                  # the worker's socket dies with it
+
+        # the worker just keeps going: retry/backoff + re-resolve rides
+        # out the outage, the relaunched server restores from its newest
+        # checkpoint (interval 5 -> versions 1..10 are durable)
+        for _ in range(8):
+            r = c.push("w", g)
+            assert r["applied"] is True
+        assert c.server_epoch >= epoch0 + 1
+        # retransmit of a version the restored checkpoint already holds
+        reply, _ = c.call({"op": "push", "key": "w", "worker": 0,
+                           "version": 3}, {"grad": g})
+        assert reply["applied"] is False
+
+        stats = c.stats()
+        applied = dict(((w, k), v) for w, k, v in stats["applied"])
+        # the crash window (versions 11-12, acked after the last durable
+        # checkpoint) is lost; the register reply resynced the worker's
+        # counter to the restored dedup table, so those version numbers
+        # were RE-USED for the 8 post-crash gradients: 10 + 8
+        total = applied[(0, "w")]
+        assert total == 18
+        assert c.applied["w"] == 18
+        value, _ = c.pull("w")
+        assert np.isfinite(value).all()
+        # every version the server COUNTS is in the weights exactly once
+        # (constant grad: value is a pure function of the apply count);
+        # versions lost to the crash window are NOT silently half-applied
+        versions = stats["versions"]["w"]
+        assert versions == 18
+        assert np.array_equal(value, w0 - versions * g)
+
+        evs = [e["event"] for e in protocol.read_events(kv_dir)]
+        assert evs.count("listen") >= 2, evs     # relaunch re-published
+        assert "restore" in evs and "checkpoint" in evs
+        c.close()
+    finally:
+        sup.stop()
+
+
+def test_worker_kill9_costs_only_its_contribution(tmp_path):
+    """kill -9 on a worker mid-run: the survivor completes every step,
+    the corpse is evicted (it can never gate an SSP pull again), and its
+    already-applied pushes stay applied."""
+    kv_dir = str(tmp_path)
+    srv = KVServer(kv_dir, world=2, staleness=None, ckpt_interval=0)
+    srv.serve_in_thread()
+    try:
+        chaos_env = {"MXNET_TPU_CHAOS": "replica_crash@8",
+                     "MXNET_TPU_CHAOS_RANKS": "1"}
+        procs = [_spawn_worker(kv_dir, r, 2, {"PS_STEPS": "25",
+                                              **chaos_env})
+                 for r in range(2)]
+        out0, _ = procs[0].communicate(timeout=180)
+        out1, _ = procs[1].communicate(timeout=180)
+        assert procs[0].returncode == 0, out0[-2000:]
+        assert procs[1].returncode == -9, (procs[1].returncode,
+                                           out1[-2000:])
+        steps0, loss0 = _parse_ok(out0)
+        assert steps0 == 25                  # survivor lost NOTHING
+        assert "PSWORKER" not in out1        # the corpse never reported
+
+        with srv._lock:
+            applied = dict(srv._applied)
+            alive = {w for w, n in srv._alive.items() if n > 0}
+        assert applied[(0, "w")] == 25
+        # the victim pushed steps 0..7 before the kill at step 8; every
+        # one of those is still applied, nothing after
+        assert applied[(1, "w")] == 8
+        assert 1 not in alive
+        evs = [e["event"] for e in protocol.read_events(kv_dir)]
+        assert "evict" in evs
+    finally:
+        srv.stop()

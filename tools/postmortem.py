@@ -223,6 +223,80 @@ def print_fleet_timeline(target):
     return 0
 
 
+def find_kvstore_events(target):
+    """The dist_async PS lane's merged event log (kvstore-events.jsonl,
+    appended by the server and every worker via
+    mxnet_tpu/kvstore/protocol.py into the MXNET_TPU_KV_DIR)."""
+    if os.path.isfile(target):
+        if target.endswith(".jsonl"):
+            return target
+        target = os.path.dirname(os.path.abspath(target))
+    path = os.path.join(target, "kvstore-events.jsonl")
+    return path if os.path.isfile(path) else None
+
+
+def print_kvstore_timeline(target):
+    """Render the PS lane's timeline: server (re)launches with their
+    epochs, checkpoint/restore events, per-worker push/pull traffic,
+    staleness-gate waits, duplicate-push rejections and evictions — the
+    view that answers "who stalled, who died, what did the restart
+    recover" after an async-lane drill."""
+    path = find_kvstore_events(target)
+    if not path:
+        print("no kvstore-events.jsonl under %r" % target, file=sys.stderr)
+        return 1
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except ValueError:
+                print("unreadable event line: %r" % line[:80],
+                      file=sys.stderr)
+    hrule("=")
+    print("KVSTORE (dist_async PS) TIMELINE (%d event(s)): %s"
+          % (len(events), path))
+    hrule("=")
+    print("%-20s %-16s %-8s %-8s %s"
+          % ("time", "event", "pid", "worker", "detail"))
+    counts = {}
+    traffic = {}          # worker -> {"push": n, "pull": n, "bytes": n}
+    for e in events:
+        ev = e.get("event", "?")
+        counts[ev] = counts.get(ev, 0) + 1
+        w = e.get("worker")
+        if ev in ("push", "pull", "pull_rows") and w is not None:
+            t = traffic.setdefault(w, {"push": 0, "pull": 0, "bytes": 0})
+            t["push" if ev == "push" else "pull"] += 1
+            t["bytes"] += int(e.get("bytes") or 0)
+        detail = []
+        for key in ("epoch", "port", "key", "version", "applied", "lag",
+                    "bound", "rows", "waited_ms", "sparse", "seq", "path",
+                    "keys", "error", "world", "staleness_bound"):
+            if e.get(key) is not None:
+                detail.append("%s=%s" % (key, e[key]))
+        print("%-20s %-16s %-8s %-8s %s"
+              % (fmt_ts(e.get("time")), ev, e.get("pid", "-"),
+                 "-" if w is None else w, "  ".join(detail)))
+    hrule()
+    print("summary: " + "  ".join("%s=%d" % kv
+                                  for kv in sorted(counts.items())))
+    if traffic:
+        print("per-worker traffic:")
+        for w in sorted(traffic):
+            t = traffic[w]
+            print("    worker %-4s %5d push  %5d pull  %10d bytes pushed"
+                  % (w, t["push"], t["pull"], t["bytes"]))
+    relaunches = counts.get("listen", 0)
+    if relaunches > 1:
+        print("server (re)launched %d times (see listen/restore lines "
+              "for epochs + recovered keys)" % relaunches)
+    return 0
+
+
 def find_trace_sinks(target):
     if os.path.isfile(target):
         if target.endswith(".jsonl"):
@@ -327,7 +401,15 @@ def main(argv=None):
     ap.add_argument("--cache-dir", default=None,
                     help="compile-cache directory for --compile stats "
                          "(default: $MXNET_TPU_COMPILE_CACHE)")
+    ap.add_argument("--kvstore", action="store_true",
+                    help="render the dist_async parameter-server "
+                         "timeline from kvstore-events.jsonl (a kv dir "
+                         "or the file itself): launches/epochs, push/"
+                         "pull traffic, staleness waits, checkpoints, "
+                         "restores, evictions")
     args = ap.parse_args(argv)
+    if args.kvstore:
+        return print_kvstore_timeline(args.target)
     if args.elastic:
         return print_elastic_timeline(args.target)
     if args.fleet:

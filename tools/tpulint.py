@@ -160,6 +160,40 @@ def _graphcheck_builtin(report):
     except Exception as e:
         print("tpulint: decode retrace check skipped: %r" % e,
               file=sys.stderr)
+    # async PS worker step: the dist_async contract is that the worker's
+    # compute graph is collective-free — no peer in this rank's critical
+    # path (GC106), plus the standard jaxpr rules
+    try:
+        from mxnet_tpu.kvstore.worker import TOY_DIM, make_worker_step
+        wstep = make_worker_step(TOY_DIM)
+        w = jax.ShapeDtypeStruct((TOY_DIM,), jnp.float32)
+        x = jax.ShapeDtypeStruct((16, TOY_DIM), jnp.float32)
+        y = jax.ShapeDtypeStruct((16,), jnp.float32)
+        report.extend(graphcheck.check_fn(
+            wstep, w, x, y, target="kvstore.worker_step"))
+        report.extend(graphcheck.check_collective_free(
+            wstep, w, x, y, target="kvstore.worker_step"))
+    except Exception as e:
+        print("tpulint: async worker check skipped: %r" % e,
+              file=sys.stderr)
+
+    # two-tier hierarchical all-reduce: the multi-pod schedule must pass
+    # the axis/group rules on an island x dp mesh
+    try:
+        from mxnet_tpu.parallel import hierarchy
+        ii = 2 if jax.device_count() >= 2 else 1
+        kk = 2 if jax.device_count() >= 4 else 1
+        hmesh = make_mesh((ii, kk), ("island", "dp"))
+
+        def run_hier(st):
+            return hierarchy.hierarchical_allreduce(st, hmesh)
+        report.extend(graphcheck.check_fn(
+            run_hier, jax.ShapeDtypeStruct((ii * kk, 8), jnp.float32),
+            mesh=hmesh, target="parallel.hierarchical_allreduce"))
+    except Exception as e:
+        print("tpulint: hierarchical allreduce check skipped: %r" % e,
+              file=sys.stderr)
+
     report.extend(graphcheck.check_registry())
 
 
