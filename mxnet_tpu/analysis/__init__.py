@@ -13,7 +13,11 @@ Two engines over one finding/report model (``report.py``):
 
 Plus :mod:`~mxnet_tpu.analysis.costmodel`: the analytic FLOPs / byte /
 collective / roofline model over optimized HLO that the performance
-attribution plane (:mod:`mxnet_tpu.telemetry.perf`) is built on.
+attribution plane (:mod:`mxnet_tpu.telemetry.perf`) is built on, and
+:mod:`~mxnet_tpu.analysis.predict`: the calibrated prediction layer on
+top of it — persisted achievable-fraction calibration, pre-flight
+step-time/HBM/wire/throughput budgets (``tpulint --predict``), and the
+runtime conformance verdicts the attribution reports carry.
 
 Wired into ``ShardedTrainer.step`` / ``Module.bind`` as an opt-in
 pre-flight (``MXNET_TPU_PREFLIGHT=1``, see
@@ -24,7 +28,7 @@ pre-flight (``MXNET_TPU_PREFLIGHT=1``, see
 from __future__ import annotations
 
 from .report import Finding, PreflightError, Report, SEVERITIES
-from . import costmodel, graphcheck, preflight, srclint
+from . import costmodel, graphcheck, predict, preflight, srclint
 
 __all__ = ["Finding", "Report", "PreflightError", "SEVERITIES",
-           "costmodel", "graphcheck", "preflight", "srclint"]
+           "costmodel", "graphcheck", "predict", "preflight", "srclint"]

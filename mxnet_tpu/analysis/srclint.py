@@ -54,6 +54,15 @@ SL107   manual-timing-use-spans    info      host-side library function
                                              measurement is invisible to
                                              the merged trace and the
                                              metrics registry
+SL108   sync-iter-in-train-loop    warning   training loop iterating a
+                                             synchronous ``DataIter``
+                                             (``NDArrayIter``/``CSVIter``/
+                                             ...) with no
+                                             ``PrefetchingIter`` wrapper:
+                                             every batch fetch stalls the
+                                             step — the static twin of
+                                             the attribution report's
+                                             ``bound: input`` verdict
 ======  =========================  ========  ===============================
 
 **Suppression syntax** (``docs/static-analysis.md``):
@@ -82,7 +91,20 @@ RULES = {
     "SL105": ("tracer-leak-to-self", "warning"),
     "SL106": ("unarmed-collective-entry", "warning"),
     "SL107": ("manual-timing-use-spans", "info"),
+    "SL108": ("sync-iter-in-train-loop", "warning"),
 }
+
+# SL108: the repo's synchronous iterators (every .next() blocks the
+# training loop on the host fetch) vs the wrapper that overlaps them
+_SYNC_ITER_CONSTRUCTORS = frozenset({
+    "NDArrayIter", "CSVIter", "LibSVMIter", "MNISTIter", "ResizeIter",
+    "DataIter",
+})
+_PREFETCH_WRAPPERS = frozenset({"PrefetchingIter"})
+# a loop is a TRAINING loop when its body advances a model: optimizer
+# steps or the module train path (plain eval/predict sweeps are exempt —
+# their fetch stalls nothing downstream)
+_TRAIN_STEP_CALLS = frozenset({"step", "forward_backward", "update"})
 
 # bare wall/monotonic clock reads whose subtraction pattern marks a
 # hand-rolled timing measurement (SL107)
@@ -452,6 +474,48 @@ def lint_source(source: str, filename: str = "<string>",
                              "metric=...) — one measurement feeds the "
                              "trace, histograms, and post-mortems",
                     extra={"function": fn.name})
+
+    # SL108: synchronous-iterator training loops (all files — examples
+    # are exactly where the pattern ships).  Module-level scripts and
+    # host functions both scanned; eval/predict sweeps never match
+    # because their loop bodies advance no optimizer.
+    scopes = [(None, _own_body_nodes(tree))]
+    scopes += [(fn, _own_body_nodes(fn.node)) for fn in infos
+               if not fn.traced]
+    for fn, body in scopes:
+        sync_vars: Dict[str, str] = {}       # var -> constructor name
+        wrapped: Set[str] = set()
+        for node in body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call):
+                ctor = _last_segment(_dotted(node.value.func))
+                if ctor in _SYNC_ITER_CONSTRUCTORS:
+                    sync_vars[node.targets[0].id] = ctor
+                elif ctor in _PREFETCH_WRAPPERS:
+                    for a in ast.walk(node.value):
+                        if isinstance(a, ast.Name):
+                            wrapped.add(a.id)
+        if not sync_vars:
+            continue
+        for node in body:
+            if not (isinstance(node, ast.For)
+                    and isinstance(node.iter, ast.Name)
+                    and node.iter.id in sync_vars
+                    and node.iter.id not in wrapped):
+                continue
+            if not any(isinstance(sub, ast.Call)
+                       and _last_segment(_dotted(sub.func))
+                       in _TRAIN_STEP_CALLS
+                       for sub in ast.walk(node)):
+                continue
+            add("SL108", node.lineno, fn,
+                "training loop iterates synchronous %s %r directly: "
+                "every batch fetch blocks the step (the runtime twin is "
+                "the attribution report's 'bound: input' verdict)"
+                % (sync_vars[node.iter.id], node.iter.id),
+                "wrap it: it = PrefetchingIter(it) overlaps the fetch "
+                "with step compute")
 
     if in_library:
         for fn in infos:

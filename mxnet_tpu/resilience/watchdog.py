@@ -421,23 +421,54 @@ class HeartbeatLane:
         # step-TIME skew from the piggybacked telemetry digests: a rank
         # that beats on schedule but computes slowly never lags in steps
         # until it blocks everyone — p50 skew catches it while it is
-        # merely slow, not yet stuck
-        p50s = {}
+        # merely slow, not yet stuck.  Ranks whose histogram holds fewer
+        # than MXNET_TPU_SKEW_MIN_SAMPLES samples (default 3, the
+        # attribution warmup) are kept out of the skew math: a
+        # one-sample p50 early in a run is compile+warmup noise, and a
+        # late-joining rank would finger itself forever.
+        try:
+            floor = max(1, int(os.environ.get(
+                "MXNET_TPU_SKEW_MIN_SAMPLES", "3")))
+        except ValueError:
+            floor = 3
+        p50s, low_sample, conf_by_rank = {}, [], {}
         for rank, d in self.digests().items():
             if (d or {}).get("gen", 0) != gen:
                 continue        # stale-generation ghost digest
+            conf = (d or {}).get("conf")
+            if conf:
+                conf_by_rank[str(rank)] = conf
             sm = (d or {}).get("step_ms") or {}
             if sm.get("p50"):
+                n = sm.get("n")
+                if n is not None and n < floor:
+                    low_sample.append(rank)
+                    continue
                 p50s[rank] = float(sm["p50"])
-        if p50s:
-            slow = max(p50s, key=p50s.get)
-            fast = min(p50s, key=p50s.get)
-            report["step_time"] = {
-                "p50_ms": {str(r): p50s[r] for r in sorted(p50s)},
-                "slowest_rank": slow,
-                "fastest_rank": fast,
-                "skew": round(p50s[slow] / max(p50s[fast], 1e-9), 3),
-            }
+        if p50s or conf_by_rank:
+            st = {"min_samples": floor}
+            if low_sample:
+                st["low_sample_ranks"] = sorted(low_sample)
+            if p50s:
+                slow = max(p50s, key=p50s.get)
+                fast = min(p50s, key=p50s.get)
+                st.update({
+                    "p50_ms": {str(r): p50s[r] for r in sorted(p50s)},
+                    "slowest_rank": slow,
+                    "fastest_rank": fast,
+                    "skew": round(p50s[slow] / max(p50s[fast], 1e-9), 3),
+                })
+            # per-rank conformance verdicts (digest `conf` column): a
+            # rank slow against its OWN budget is fingered even when the
+            # whole fleet is uniformly slow and peer skew reads 1.0
+            if conf_by_rank:
+                st["conformance"] = conf_by_rank
+                violators = sorted(
+                    r for r, c in conf_by_rank.items()
+                    if c.get("verdict") == "VIOLATED")
+                if violators:
+                    st["budget_violators"] = violators
+            report["step_time"] = st
         return report
 
 

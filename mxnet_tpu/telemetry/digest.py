@@ -75,6 +75,16 @@ def rank_digest(step: Optional[int] = None) -> dict:
     if live or peak:
         d["mem_mb"] = {"live": round(live / 1e6, 1),
                        "peak": round(peak / 1e6, 1)}
+    # conformance column: this rank's worst measured-vs-budget outcome
+    # (predict.py), so the rank-0 fleet view can finger a rank slow
+    # against its OWN budget even when peer skew reads uniform
+    try:
+        from ..analysis import predict as _predict
+        conf = _predict.digest_column()
+        if conf:
+            d["conf"] = conf
+    except Exception:
+        pass
     counters = {}
     for name, key in _DIGEST_COUNTERS:
         total = _registry.counter_total(name)
@@ -286,20 +296,26 @@ def render_fleet(view: Optional[dict] = None) -> str:
                      % (view.get("generation"), view.get("world_size")))
     if "ranks" in view:
         lines.append("rank  gen  step   age_s   p50_ms   p95_ms   tput/s  "
-                     "live_mb  peak_mb  counters")
+                     "live_mb  peak_mb  conf        counters")
     for rank, row in sorted((view.get("ranks") or {}).items(),
                             key=lambda kv: int(kv[0])):
         d = row.get("digest") or {}
         sm = d.get("step_ms") or {}
         mm = d.get("mem_mb") or {}
+        conf = d.get("conf") or {}
+        conf_cell = "-"
+        if conf:
+            # e.g. VIOL x1.80 — worst metric's measured/budget ratio
+            conf_cell = "%s x%.2f" % (conf.get("verdict", "?")[:4],
+                                      conf.get("ratio", 0.0))
         lines.append(
-            "%-5s %-4s %-6s %-7s %-8s %-8s %-7s %-8s %-8s %s"
+            "%-5s %-4s %-6s %-7s %-8s %-8s %-7s %-8s %-8s %-11s %s"
             % (rank, row.get("gen", d.get("gen", "-")),
                row.get("step", "-"), row.get("age_sec", "-"),
                sm.get("p50", "-"), sm.get("p95", "-"),
                d.get("throughput_sps", "-"),
                mm.get("live", "-"), mm.get("peak", "-"),
-               d.get("counters", "") or ""))
+               conf_cell, d.get("counters", "") or ""))
     for e in view.get("resize_events") or []:
         lines.append(
             "resize: generation %s -> world %s (from %s, %s) at step %s"
@@ -312,8 +328,23 @@ def render_fleet(view: Optional[dict] = None) -> str:
                                  for g in ghosts))
     strag = (view.get("straggler") or {}).get("step_time")
     if strag:
-        lines.append("step-time straggler: rank %s (p50 skew x%.2f)"
-                     % (strag.get("slowest_rank"), strag.get("skew", 0.0)))
+        if strag.get("slowest_rank") is not None:
+            lines.append("step-time straggler: rank %s (p50 skew x%.2f)"
+                         % (strag.get("slowest_rank"),
+                            strag.get("skew", 0.0)))
+        low = strag.get("low_sample_ranks")
+        if low:
+            lines.append(
+                "skew excludes rank(s) %s: < %s step samples (warming up)"
+                % (", ".join(str(r) for r in low),
+                   strag.get("min_samples", "?")))
+        viol = strag.get("budget_violators")
+        if viol:
+            conf = strag.get("conformance") or {}
+            lines.append("over budget: " + "; ".join(
+                "rank %s %s x%.2f" % (r, (conf.get(r) or {}).get(
+                    "metric", "?"), (conf.get(r) or {}).get("ratio", 0.0))
+                for r in viol))
     serving = view.get("serving")
     if serving is None and "replicas" in view:
         serving = view          # a bare serving_fleet_view() renders too

@@ -42,9 +42,9 @@ from typing import Dict, Optional
 
 __all__ = ["AttributionReport", "attribute_after_steps",
            "attribute_compiled", "attribute_fn", "attribute_module",
-           "enabled", "maybe_attribute", "maybe_attribute_fn",
-           "maybe_attribute_module", "phases_block", "report_dir",
-           "reset_attributed"]
+           "enabled", "input_verdict", "maybe_attribute",
+           "maybe_attribute_fn", "maybe_attribute_module",
+           "phases_block", "report_dir", "reset_attributed"]
 
 _SEQ = [0]
 _DONE_LOCK = threading.Lock()
@@ -143,6 +143,12 @@ class AttributionReport:
             events.append({"name": base + "/memory_bytes", "ph": "C",
                            "ts": ts, "pid": 2, "tid": 0,
                            "args": {"peak": peak}})
+        conf = self.data.get("conformance")
+        if conf:
+            events.append({"name": base + "/conformance", "ph": "C",
+                           "ts": ts, "pid": 2, "tid": 0,
+                           "args": {m: info["ratio"] for m, info
+                                    in conf["metrics"].items()}})
         return events
 
     def pretty(self) -> str:
@@ -242,6 +248,22 @@ class AttributionReport:
                     r.get("measured_vs_analytic", "n/a")))
         if s.get("mfu") is not None:
             lines.append("MFU vs chip peak: %.4f" % s["mfu"])
+        if r.get("input_share") is not None:
+            lines.append(
+                "input pipeline: fetch p50 %s, share %.0f%% of "
+                "(fetch+step)%s" % (
+                    "%.4fs" % s["io_s"] if s.get("io_s") is not None
+                    else "n/a", 100 * r["input_share"],
+                    "  -> INPUT-BOUND" if r.get("bound") == "input"
+                    else ""))
+        conf = d.get("conformance")
+        if conf:
+            lines.append("conformance vs budget [%s]: %s" % (
+                conf.get("verdict", "?"),
+                ", ".join("%s x%.2f %s"
+                          % (m, info["ratio"], info["verdict"])
+                          for m, info in sorted(
+                              conf.get("metrics", {}).items()))))
         lines.append("")
         return "\n".join(lines)
 
@@ -277,6 +299,44 @@ def _measured_from_telemetry():
 
     return (p50("train.step_seconds"), p50("train.host_enqueue_seconds"),
             p50("train.device_wait_seconds"))
+
+
+def input_verdict(step_s: Optional[float] = None,
+                  io_s: Optional[float] = None,
+                  min_samples: int = 2) -> Optional[Dict]:
+    """ROADMAP item 4's rule: the run is **input-bound** when the data
+    pipeline's synchronous fetch (the ``data.next_seconds`` span every
+    iterator records) rivals the step itself — no device roofline
+    position matters if the accelerator is waiting on the host loader.
+
+    Returns ``{"io_s", "step_s", "input_share", "bound_input"}`` with
+    ``input_share = io / (io + step)`` (both p50), ``bound_input`` when
+    the share crosses 0.5; None when either histogram is missing or the
+    io histogram holds fewer than ``min_samples`` samples (a single
+    cold fetch is warmup, not a verdict)."""
+    from . import registry as _registry
+
+    def h50(name):
+        try:
+            h = _registry.histogram(name)
+        except TypeError:
+            return None, 0
+        s = h.summary()
+        return s.get("p50"), s.get("count") or 0
+
+    if io_s is None:
+        io_s, n = h50("data.next_seconds")
+        if io_s is None or n < min_samples:
+            return None
+    if step_s is None:
+        step_s, _ = h50("train.step_seconds")
+    if not step_s or not io_s:
+        return None
+    share = float(io_s) / (float(io_s) + float(step_s))
+    return {"io_s": round(float(io_s), 6),
+            "step_s": round(float(step_s), 6),
+            "input_share": round(share, 4),
+            "bound_input": share > 0.5}
 
 
 def attribute_compiled(compiled, name: str, n_devices: int = 1,
@@ -369,15 +429,30 @@ def attribute_compiled(compiled, name: str, n_devices: int = 1,
 
     step: Dict = {}
     if measured_step_s:
-        step["measured_s"] = round(float(measured_step_s), 6)
+        # ns precision: toy programs step in the sub-microsecond range
+        # and a 6-digit round would zero them out (killing conformance)
+        step["measured_s"] = round(float(measured_step_s), 9)
         step["mfu"] = round(fl["flops"] / measured_step_s
                             / peaks["flops"], 6)
     if host_s is not None:
-        step["host_enqueue_s"] = round(float(host_s), 6)
+        step["host_enqueue_s"] = round(float(host_s), 9)
     if device_s is not None:
-        step["device_wait_s"] = round(float(device_s), 6)
+        step["device_wait_s"] = round(float(device_s), 9)
     if measured_step_s and host_s is not None:
         step["host_share"] = round(float(host_s) / measured_step_s, 4)
+
+    # input-bound verdict (ROADMAP item 4): the io span p50 vs the step
+    # p50 — overrides the device roofline's bound when fetch dominates,
+    # because no amount of on-chip optimisation helps a starved step
+    try:
+        iv = input_verdict(step_s=measured_step_s)
+    except Exception:
+        iv = None
+    if iv:
+        roof["input_share"] = iv["input_share"]
+        step["io_s"] = iv["io_s"]
+        if iv["bound_input"]:
+            roof["bound"] = "input"
 
     topo = {"n_devices": int(n_devices), "ring_n": int(ring_n)}
     try:
@@ -413,6 +488,27 @@ def attribute_compiled(compiled, name: str, n_devices: int = 1,
     }
     if extra:
         data.update(extra)
+
+    # conformance vs the budget of record (predict.py): only possible
+    # with a measured step; exported per-metric as the
+    # perf.conformance{entry,metric} gauge family so dashboards and the
+    # heartbeat digest column see drift without parsing reports
+    try:
+        from ..analysis import predict as _predict
+        conf = _predict.runtime_conformance(name, data)
+    except Exception:
+        logging.debug("conformance pass failed for %s", name,
+                      exc_info=True)
+        conf = None
+    if conf:
+        data["conformance"] = conf
+        try:
+            from . import registry as _registry
+            for metric, info in conf["metrics"].items():
+                _registry.set_gauge("perf.conformance", info["ratio"],
+                                    entry=name, metric=metric)
+        except Exception:
+            pass
     return AttributionReport(data)
 
 
@@ -569,6 +665,14 @@ def phases_block(report: AttributionReport,
         # (ungated, like peak_hbm_bytes) so wire-traffic trends are
         # tracked without an improvement ever reading as a regression
         out["collective_bytes_per_step"] = int(wire)
+    if roof.get("input_share") is not None:
+        out["input_share"] = roof["input_share"]
+    conf = d.get("conformance")
+    if conf:
+        out["conformance"] = conf.get("verdict")
+        st = (conf.get("metrics") or {}).get("step_time_s")
+        if st:
+            out["conformance_step_ratio"] = st["ratio"]
     if report_path:
         out["report"] = report_path
     return out

@@ -1,0 +1,46 @@
+"""SL108 fixture: synchronous-iterator training loops, seeded + clean.
+
+Each ``bad_*`` function must produce exactly one SL108 finding; every
+other function must stay clean (prefetch-wrapped, eval-only, or
+suppressed).  Kept import-free on purpose — srclint never executes the
+file.
+"""
+import mxnet_tpu as mx
+from mxnet_tpu.io import NDArrayIter, PrefetchingIter
+
+
+def bad_module_loop(x, y, mod):
+    it = NDArrayIter(x, y, batch_size=8)
+    for batch in it:                       # SL108: sync fetch per step
+        mod.forward_backward(batch)
+        mod.update()
+
+
+def bad_trainer_loop(x, trainer, state):
+    it = mx.io.CSVIter(data_csv=x, batch_size=8)
+    for batch in it:                       # SL108: sync fetch per step
+        state = trainer.step(state, batch)
+    return state
+
+
+def good_prefetched_loop(x, y, mod):
+    it = NDArrayIter(x, y, batch_size=8)
+    it = PrefetchingIter(it)
+    for batch in it:                       # wrapped: fetch overlaps
+        mod.forward_backward(batch)
+        mod.update()
+
+
+def good_eval_sweep(x, y, mod):
+    it = NDArrayIter(x, y, batch_size=8)
+    preds = []
+    for batch in it:                       # no optimizer advance: eval
+        preds.append(mod.predict(batch))
+    return preds
+
+
+def good_suppressed(x, y, mod):
+    it = NDArrayIter(x, y, batch_size=8)
+    for batch in it:  # tpulint: disable=SL108
+        mod.forward_backward(batch)
+        mod.update()

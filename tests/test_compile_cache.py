@@ -447,6 +447,26 @@ def test_benchwatch_extracts_and_merges_compile_seconds(tmp_path):
     assert not ok and results["compile_seconds"]["regression"]
 
 
+def test_benchwatch_single_excursion_uses_floor_band():
+    """One bad round in an otherwise-flat history used to widen the σ
+    band to 4x its own drawdown and wave the next regression through;
+    a single excursion now contributes no σ and the 5% floor gates."""
+    bw = _benchwatch()
+    assert bw.drawdown_sigma([100.0, 60.0]) == 0.0
+    assert bw.rise_sigma([60.0, 100.0]) == 0.0
+    # flat-then-drop: the 5% floor (not a self-sized band) catches it
+    r = bw.check_series([100.0, 100.0, 92.0])
+    assert r["checked"] and r["regression"]
+    assert r["band_basis"] == "floor"
+    # a genuinely noisy series still gets the wider σ band
+    noisy = bw.check_series([100.0, 80.0, 110.0, 75.0, 105.0, 75.0])
+    assert noisy["band_basis"] == "sigma"
+    assert not noisy["regression"]
+    # the too-short series contract is unchanged (and basis-free)
+    assert bw.check_series([1.0]) == {"checked": False,
+                                      "regression": False, "n": 1}
+
+
 def test_committed_ledger_still_green():
     bw = _benchwatch()
     ok, results = bw.check_ledger(bw.read_ledger(

@@ -117,6 +117,13 @@ def extract_extra(doc):
         for field in ("peak_hbm_bytes", "collective_bytes_per_step"):
             if isinstance(phases.get(field), (int, float)):
                 out[field] = int(phases[field])
+        # measured/predicted step ratio from the conformance pass:
+        # ungated for the same reason — drift toward 1.0 (a better
+        # calibration) must never read as a regression
+        if isinstance(phases.get("conformance_step_ratio"),
+                      (int, float)):
+            out["conformance_step_ratio"] = round(
+                float(phases["conformance_step_ratio"]), 4)
         # compile_seconds moved from here into extract_metrics when it
         # was promoted to a (lower-is-better) gated metric
     sub = doc.get("transformer")
@@ -194,7 +201,10 @@ def drawdown_sigma(history):
         run_max = max(run_max, v)
         draws.append((run_max - v) / run_max if run_max > 0 else 0.0)
     if len(draws) < 2:
-        return draws[0] if draws else 0.0
+        # one excursion is a data point, not a noise scale — returning
+        # it as sigma let a single bad historical round widen the band
+        # 4x; report zero and let the caller's floor take over
+        return 0.0
     return statistics.stdev(draws)
 
 
@@ -210,7 +220,8 @@ def rise_sigma(history):
         run_min = min(run_min, v)
         rises.append((v - run_min) / run_min if run_min > 0 else 0.0)
     if len(rises) < 2:
-        return rises[0] if rises else 0.0
+        # mirror of drawdown_sigma: a lone rise is not a noise scale
+        return 0.0
     return statistics.stdev(rises)
 
 
@@ -222,7 +233,11 @@ def check_series(values, sigma_mult=SIGMA_MULT, floor=FLOOR, lower=False):
     blow-up cannot hide.
 
     Returns {"checked", "regression", "latest", "best", "drop",
-    "threshold", "noise_sigma", "direction"}."""
+    "threshold", "noise_sigma", "band_basis", "direction"}.
+    ``band_basis`` says which side of ``max(sigma*noise, floor)`` won:
+    a single-row history has no sigma at all (noise 0.0) and gates on
+    the explicit 5% floor — the calibration store reads these series,
+    so the one-row edge case is load-bearing, not cosmetic."""
     if len(values) < 2:
         return {"checked": False, "regression": False,
                 "n": len(values)}
@@ -241,6 +256,8 @@ def check_series(values, sigma_mult=SIGMA_MULT, floor=FLOOR, lower=False):
             "latest": latest, "best": best,
             "drop": round(move, 4), "threshold": round(threshold, 4),
             "noise_sigma": round(noise, 4), "n": len(values),
+            "band_basis": "sigma" if sigma_mult * noise > floor
+            else "floor",
             "direction": "lower" if lower else "higher"}
 
 

@@ -379,6 +379,87 @@ def print_compile_timeline(target, cache_dir=None):
     return 0
 
 
+def find_predict_reports(target):
+    if os.path.isfile(target):
+        if os.path.basename(target).startswith("predict-"):
+            return [target]
+        target = os.path.dirname(os.path.abspath(target))
+    return sorted(glob.glob(os.path.join(target, "predict-*.json")))
+
+
+def print_predict_view(target):
+    """Render each pre-flight budget (predict-*.json, written by
+    ``tpulint --predict`` / analysis/predict.py) next to the measured
+    conformance outcome from any matching attribution report in the same
+    directory — predicted vs actual, per metric, with the verdict."""
+    paths = find_predict_reports(target)
+    if not paths:
+        print("no predict-*.json under %r" % target, file=sys.stderr)
+        return 1
+    # conformance sections by program, from attribution reports alongside
+    adir = target if os.path.isdir(target) \
+        else os.path.dirname(os.path.abspath(target))
+    conf_by_program = {}
+    for apath in sorted(glob.glob(os.path.join(adir,
+                                               "attribution-*.json"))):
+        try:
+            with open(apath) as f:
+                a = json.load(f)
+        except (OSError, ValueError):
+            continue
+        conf = a.get("conformance")
+        if conf:
+            conf_by_program[a.get("program")] = (conf, apath)
+    hrule("=")
+    print("PRE-FLIGHT BUDGETS vs MEASURED (%d budget(s))" % len(paths))
+    hrule("=")
+    for path in paths:
+        try:
+            with open(path) as f:
+                rep = json.load(f)
+        except (OSError, ValueError) as e:
+            print("unreadable budget %s: %r" % (path, e), file=sys.stderr)
+            continue
+        b = rep.get("budget") or {}
+        basis = rep.get("basis") or {}
+        prog = rep.get("program", "?")
+        print()
+        print("%s  (%s, %s-bound; calibration %s n=%s f=%s)"
+              % (prog, fmt_ts(rep.get("time")), basis.get("bound", "?"),
+                 basis.get("calibration_source", "?"),
+                 basis.get("calibration_n", "?"),
+                 basis.get("achievable_fraction", "?")))
+        over = set(rep.get("over_budget") or [])
+        conf = (conf_by_program.get(prog) or ({}, None))[0]
+        cm = conf.get("metrics") or {}
+        print("    %-22s %14s %14s %8s %s"
+              % ("metric", "budget", "measured", "ratio", "verdict"))
+        for metric in ("step_time_s", "peak_hbm_bytes",
+                       "wire_bytes_per_step", "throughput_per_s"):
+            if b.get(metric) is None:
+                continue
+            m = cm.get(metric) or {}
+            verdict = m.get("verdict", "-")
+            if metric in over:
+                verdict += "  OVER PRE-FLIGHT LIMIT"
+            print("    %-22s %14.6g %14s %8s %s"
+                  % (metric, b[metric],
+                     "%.6g" % m["measured"] if m.get("measured") is not None
+                     else "-",
+                     "x%.2f" % m["ratio"] if m.get("ratio") is not None
+                     else "-", verdict))
+        src = conf_by_program.get(prog)
+        if src:
+            print("    conformance: %s (from %s)"
+                  % (conf.get("verdict", "?"),
+                     os.path.basename(src[1])))
+        else:
+            print("    conformance: no measured attribution report for "
+                  "this program in %s" % adir)
+    hrule()
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("target", help="a post-mortem .json or a directory "
@@ -407,7 +488,14 @@ def main(argv=None):
                          "or the file itself): launches/epochs, push/"
                          "pull traffic, staleness waits, checkpoints, "
                          "restores, evictions")
+    ap.add_argument("--predict", action="store_true",
+                    help="render pre-flight budgets (predict-*.json) "
+                         "side by side with the measured conformance "
+                         "outcome from matching attribution reports in "
+                         "the same directory")
     args = ap.parse_args(argv)
+    if args.predict:
+        return print_predict_view(args.target)
     if args.kvstore:
         return print_kvstore_timeline(args.target)
     if args.elastic:

@@ -331,6 +331,28 @@ def _main_decode(args):
     report["continuous_vs_static"] = round(
         report["continuous"]["tokens_per_sec_per_chip"] /
         max(report["static"]["tokens_per_sec_per_chip"], 1e-9), 3)
+    # prediction-conformance mirror: measured decode tokens/s vs the
+    # analytic decode budget (analysis/predict.py), plus the input-bound
+    # verdict when an input pipeline fed this process — same sections
+    # the attribution reports carry
+    try:
+        from mxnet_tpu.analysis import predict as _predict
+        from mxnet_tpu.telemetry import perf as _perf
+        budget = _predict.predict_decode_budget(
+            cfg.num_layers, cfg.hidden, cfg.vocab_size, S,
+            cfg.max_seq_len, name="servebench.decode",
+            quant_bits={"int8": 8, "int4": 4}.get(cfg.quantize, 32))
+        conf = _predict.conformance(budget, {
+            "decode_tokens_per_s":
+                report["continuous"]["tokens_per_sec_per_chip"]})
+        if conf:
+            report["conformance"] = conf
+        iv = _perf.input_verdict(
+            step_s=cont_wall / max(cont_tokens, 1))
+        if iv:
+            report["input_bound"] = iv
+    except Exception:
+        pass
     if args.json:
         json.dump(report, sys.stdout, indent=2, sort_keys=True)
         print()
@@ -599,6 +621,15 @@ def main(argv=None):
         "device_utilization": stats.get("device_utilization"),
         "runtime_stats": stats,
     }
+    # input-bound mirror (attribution report schema): present only when
+    # a data pipeline's fetch span was measured in this process
+    try:
+        from mxnet_tpu.telemetry import perf as _perf
+        iv = _perf.input_verdict()
+        if iv:
+            report["input_bound"] = iv
+    except Exception:
+        pass
     if args.json:
         json.dump(report, sys.stdout, indent=2, sort_keys=True)
         print()

@@ -14,6 +14,15 @@ Usage:
     --graphcheck          additionally trace + check the built-in sharded
                           entry points (ShardedTrainer toy step, ring,
                           pipeline, moe) — needs jax and a few seconds
+    --predict             compile the same entry points and print their
+                          calibrated pre-flight budgets (predicted
+                          step-time / peak-HBM / wire-bytes / throughput,
+                          analysis/predict.py) as a table; each budget is
+                          also written as an atomic predict-*.json into
+                          the forensics dir and gated against the
+                          MXNET_TPU_DEVICE_HBM_GB / _STEP_BUDGET_MS /
+                          _WIRE_BUDGET_MB / _THROUGHPUT_FLOOR limits
+                          (exit 1 when any budget is over)
     --max-findings N      cap pretty output (0 = all)
 
 Exit status: 0 = clean at the gate severity, 1 = findings, 2 = usage/IO
@@ -21,6 +30,7 @@ error.  ``--format json`` emits ONE JSON document on stdout so CI can
 both gate on the exit code and archive the findings.
 """
 import argparse
+import json
 import os
 import sys
 
@@ -197,6 +207,150 @@ def _graphcheck_builtin(report):
     report.extend(graphcheck.check_registry())
 
 
+def _predict_builtin():
+    """Compile the standard entry points and emit their pre-flight
+    budgets (ROADMAP item 1(a)): the same programs --graphcheck traces,
+    run through analysis/predict.py's calibrated cost model.  Returns
+    (reports, any_over_budget); an entry that fails to compile is
+    skipped with a note on stderr, never fatal."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.analysis import predict
+    from mxnet_tpu.parallel.mesh import MeshSpec, make_mesh
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+    from mxnet_tpu.parallel.ring import local_ring_attention_fn
+    from mxnet_tpu.parallel import moe as moe_mod
+
+    n = min(2, jax.device_count())
+    mesh = make_mesh((n,), ("dp",))
+    compat = {} if hasattr(jax.lax, "pvary") else {"check_rep": False}
+    # one calibration pass against the committed ledger so the budgets
+    # carry a fitted fraction even on a box that never ran telemetry
+    store = predict.fit_from_ledger()
+    predict.save_store(store)
+    reports = []
+
+    def run(tag, fn):
+        try:
+            reports.append(fn())
+        except Exception as e:
+            print("tpulint: --predict %s skipped: %r" % (tag, e),
+                  file=sys.stderr)
+
+    def trainer_budget():
+        data = mx.sym.Variable("data")
+        fc = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+        net = mx.sym.SoftmaxOutput(fc, name="softmax")
+        trainer = ShardedTrainer(net, MeshSpec(mesh))
+        shapes = {"data": (2 * n, 4), "softmax_label": (2 * n,)}
+        params, mom, aux = trainer.init_state(shapes)
+        inputs = {k: jax.ShapeDtypeStruct(v, jnp.float32)
+                  for k, v in shapes.items()}
+        jitted = trainer._step or trainer._build_step()
+        compiled = jitted.lower(
+            params, mom, aux, inputs, trainer._keys(),
+            trainer._guard_arrays()).compile()
+        rep = predict.predict_budget(compiled, "trainer", n_devices=n,
+                                     mesh=mesh, items_per_step=2 * n,
+                                     store=store)
+        predict.save_report(rep)
+        return rep
+
+    def ring_budget():
+        ring_mesh = make_mesh((n,), ("sp",))
+        fn = local_ring_attention_fn("sp", causal=True, scale=1.0,
+                                     num_devices=n)
+        mapped = shard_map(fn, mesh=ring_mesh,
+                           in_specs=(P(None, "sp"),) * 3,
+                           out_specs=P(None, "sp"), **compat)
+        blk = jax.ShapeDtypeStruct((1, 2 * n, 2, 4), jnp.float32)
+        compiled = jax.jit(mapped).lower(blk, blk, blk).compile()
+        rep = predict.predict_budget(compiled, "ring", n_devices=n,
+                                     mesh=ring_mesh, store=store)
+        predict.save_report(rep)
+        return rep
+
+    def moe_budget():
+        ep_mesh = make_mesh((n,), ("ep",))
+        local = moe_mod._moe_local_fn("ep", capacity=2,
+                                      activation=jax.nn.relu)
+        mapped = shard_map(local, mesh=ep_mesh,
+                           in_specs=(P("ep"), P(), P("ep"), P("ep")),
+                           out_specs=(P("ep"), P()), **compat)
+        compiled = jax.jit(mapped).lower(
+            jax.ShapeDtypeStruct((4 * n, 8), jnp.float32),
+            jax.ShapeDtypeStruct((8, n * 2), jnp.float32),
+            jax.ShapeDtypeStruct((n * 2, 8, 16), jnp.float32),
+            jax.ShapeDtypeStruct((n * 2, 16, 8), jnp.float32)).compile()
+        rep = predict.predict_budget(compiled, "moe", n_devices=n,
+                                     mesh=ep_mesh,
+                                     items_per_step=4 * n, store=store)
+        predict.save_report(rep)
+        return rep
+
+    def pipeline_budget():
+        from mxnet_tpu.parallel.pipeline import pipeline_apply
+        pp_mesh = make_mesh((n,), ("pp",))
+        stacked = jax.ShapeDtypeStruct((n, 4), jnp.float32)
+        x = jax.ShapeDtypeStruct((2, 1, 4), jnp.float32)
+
+        def run_pp(p, xm):
+            return pipeline_apply(lambda pl, v: v * pl.sum(), n, pp_mesh,
+                                  "pp", p, xm)
+        compiled = jax.jit(run_pp).lower(stacked, x).compile()
+        rep = predict.predict_budget(compiled, "pipeline", n_devices=n,
+                                     mesh=pp_mesh, store=store)
+        predict.save_report(rep)
+        return rep
+
+    def recommender_budget():
+        from mxnet_tpu.sparse import ShardedEmbedding
+        emb = ShardedEmbedding(16 * n, 8, MeshSpec(mesh), axis="dp",
+                               name="tpulint_predict")
+        table = emb.init_state(seed=0)
+        mom = emb.zeros_slot()
+        ids = jax.device_put(
+            jnp.arange(4 * n, dtype=jnp.int32) % (16 * n),
+            jax.sharding.NamedSharding(mesh, P("dp")))
+
+        def emb_step(t, m, i):
+            rows = emb.lookup(t, i)
+            return emb.apply_sgd(t, m, i, 2.0 * rows, lr=0.1,
+                                 momentum=0.9)
+        with mesh:
+            compiled = jax.jit(emb_step).lower(table, mom, ids).compile()
+        rep = predict.predict_budget(compiled, "recommender",
+                                     n_devices=n, mesh=mesh,
+                                     items_per_step=4 * n, store=store)
+        predict.save_report(rep)
+        return rep
+
+    def decode_budget():
+        from mxnet_tpu.serving.decode import DecodeConfig
+        dcfg = DecodeConfig(32, 1, 16, 2, 16, page_size=4, max_seqs=2)
+        rep = predict.predict_decode_budget(
+            dcfg.num_layers, dcfg.hidden, dcfg.vocab_size, dcfg.max_seqs,
+            dcfg.max_seq_len, name="decode", store=store)
+        predict.save_report(rep)
+        return rep
+
+    run("trainer", trainer_budget)
+    run("ring", ring_budget)
+    run("moe", moe_budget)
+    run("pipeline", pipeline_budget)
+    run("recommender", recommender_budget)
+    run("decode", decode_budget)
+    over = any(r.get("over_budget") for r in reports)
+    return reports, over
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description=__doc__.splitlines()[0],
@@ -210,6 +364,10 @@ def main(argv=None):
     ap.add_argument("--out", help="also write JSON report here")
     ap.add_argument("--graphcheck", action="store_true",
                     help="also trace+check built-in sharded entry points")
+    ap.add_argument("--predict", action="store_true",
+                    help="also print calibrated pre-flight budgets for "
+                         "the built-in entry points (exit 1 when over "
+                         "budget)")
     ap.add_argument("--max-findings", type=int, default=0)
     args = ap.parse_args(argv)
 
@@ -230,15 +388,30 @@ def main(argv=None):
             print("tpulint: --graphcheck failed: %r" % e, file=sys.stderr)
             return 2
 
+    over_budget = False
+    predict_reports = []
+    if args.predict:
+        try:
+            from mxnet_tpu.analysis import predict as predict_mod
+            predict_reports, over_budget = _predict_builtin()
+        except Exception as e:                      # noqa: BLE001
+            print("tpulint: --predict failed: %r" % e, file=sys.stderr)
+            return 2
+
     if args.out:
         report.save(args.out)
     if args.format == "json":
-        print(report.to_json())
+        doc = json.loads(report.to_json())
+        if args.predict:
+            doc["predict"] = predict_reports
+        print(json.dumps(doc, indent=2, default=repr))
     else:
         print(report.pretty(max_findings=args.max_findings))
+        if args.predict:
+            print(predict_mod.budget_table(predict_reports))
 
     gated = report.at_or_above(args.severity)
-    return 1 if gated else 0
+    return 1 if (gated or over_budget) else 0
 
 
 if __name__ == "__main__":
